@@ -1,0 +1,45 @@
+"""Test harness: force an 8-device CPU mesh before JAX initializes.
+
+This is the direct analog of the reference stack's
+``local-cluster[2,1,1024]`` test masters (SURVEY.md §4): multi-device
+semantics exercised in one process, no real TPU pod required.  Must run
+before any ``import jax`` in the test session.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The axon TPU plugin in this environment ignores JAX_PLATFORMS=cpu from the
+# environment; the config knob does work and must be set before first use.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_ratings(rng, num_users=60, num_items=40, rank=4, density=0.3, noise=0.0):
+    """Synthetic low-rank ground truth — the reference test protocol
+    (ALSSuite.genFactors/testALS, SURVEY.md §4.1)."""
+    Ustar = rng.normal(0, 1.0 / np.sqrt(rank), (num_users, rank)).astype(np.float32)
+    Vstar = rng.normal(0, 1.0 / np.sqrt(rank), (num_items, rank)).astype(np.float32)
+    full = Ustar @ Vstar.T
+    mask = rng.random((num_users, num_items)) < density
+    # guarantee every user/item has at least one rating
+    mask[np.arange(num_users), rng.integers(0, num_items, num_users)] = True
+    mask[rng.integers(0, num_users, num_items), np.arange(num_items)] = True
+    u, i = np.nonzero(mask)
+    r = full[u, i] + noise * rng.normal(size=len(u)).astype(np.float32)
+    return u.astype(np.int64), i.astype(np.int64), r.astype(np.float32), Ustar, Vstar

@@ -1,0 +1,103 @@
+"""End-to-end training quality tests — the reference protocol (SURVEY.md §4):
+synthetic low-rank ground truth, train, assert held-out RMSE below threshold
+(the analog of ALSSuite.testALS's targetRMSE assertions).
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from tpu_als.core.als import AlsConfig, predict, train
+from tpu_als.core.ratings import build_csr_buckets
+
+from conftest import make_ratings
+
+
+def split(rng, u, i, r, frac=0.2):
+    test = rng.random(len(u)) < frac
+    return (u[~test], i[~test], r[~test]), (u[test], i[test], r[test])
+
+
+def fit(u, i, r, num_users, num_items, cfg):
+    user_csr = build_csr_buckets(u, i, r, num_users, min_width=4, chunk_elems=1 << 12)
+    item_csr = build_csr_buckets(i, u, r, num_items, min_width=4, chunk_elems=1 << 12)
+    return train(user_csr, item_csr, cfg)
+
+
+def rmse(U, V, u, i, r, num_users, num_items):
+    p = predict(
+        U, V, jnp.array(u), jnp.array(i),
+        jnp.ones(len(u), bool), jnp.ones(len(i), bool),
+    )
+    return float(jnp.sqrt(jnp.nanmean((p - jnp.array(r)) ** 2)))
+
+
+def test_explicit_recovers_low_rank(rng):
+    u, i, r, _, _ = make_ratings(rng, 80, 60, rank=3, density=0.4, noise=0.01)
+    (tu, ti, tr), (eu, ei, er) = split(rng, u, i, r)
+    cfg = AlsConfig(rank=3, max_iter=12, reg_param=0.01, seed=1)
+    U, V = fit(tu, ti, tr, 80, 60, cfg)
+    err = rmse(U, V, eu, ei, er, 80, 60)
+    scale = float(np.std(r))
+    assert err < 0.15 * scale + 0.05, f"held-out rmse {err} vs scale {scale}"
+
+
+def test_more_iterations_reduce_train_rmse(rng):
+    u, i, r, _, _ = make_ratings(rng, 60, 40, rank=4, density=0.5, noise=0.0)
+    errs = []
+    for iters in (1, 4, 10):
+        cfg = AlsConfig(rank=4, max_iter=iters, reg_param=0.005, seed=3)
+        U, V = fit(u, i, r, 60, 40, cfg)
+        errs.append(rmse(U, V, u, i, r, 60, 40))
+    assert errs[2] < errs[1] < errs[0]
+    assert errs[2] < 0.05
+
+
+def test_implicit_ranks_positives_above_negatives(rng):
+    # implicit protocol: observed entries get confidence, preference 1;
+    # model scores for observed pairs should exceed unobserved ones on average
+    num_users, num_items = 50, 40
+    u, i, r, Ustar, Vstar = make_ratings(rng, num_users, num_items, rank=3, density=0.3)
+    r_impl = np.abs(r) * 5 + 0.1  # positive interaction strengths
+    cfg = AlsConfig(rank=8, max_iter=10, reg_param=0.01, implicit_prefs=True,
+                    alpha=10.0, seed=5)
+    U, V = fit(u, i, r_impl, num_users, num_items, cfg)
+    scores = np.asarray(U @ jnp.transpose(V))
+    obs = np.zeros((num_users, num_items), bool)
+    obs[u, i] = True
+    assert scores[obs].mean() > scores[~obs].mean() + 0.1
+    # predictions live in the preference range [~0, ~1]
+    assert scores[obs].mean() < 1.5
+
+
+def test_nonnegative_factors(rng):
+    u, i, r, _, _ = make_ratings(rng, 40, 30, rank=3, density=0.4)
+    r = np.abs(r) + 0.1
+    cfg = AlsConfig(rank=3, max_iter=8, reg_param=0.05, nonnegative=True, seed=2)
+    U, V = fit(u, i, r, 40, 30, cfg)
+    assert float(jnp.min(U)) >= -1e-5
+    assert float(jnp.min(V)) >= -1e-5
+    err = rmse(U, V, u, i, r, 40, 30)
+    assert err < 0.5
+
+
+def test_seed_determinism(rng):
+    u, i, r, _, _ = make_ratings(rng, 30, 20, rank=2, density=0.5)
+    cfg = AlsConfig(rank=2, max_iter=3, seed=7)
+    U1, V1 = fit(u, i, r, 30, 20, cfg)
+    U2, V2 = fit(u, i, r, 30, 20, cfg)
+    np.testing.assert_array_equal(np.asarray(U1), np.asarray(U2))
+    np.testing.assert_array_equal(np.asarray(V1), np.asarray(V2))
+
+
+def test_predict_cold_start_nan(rng):
+    u, i, r, _, _ = make_ratings(rng, 20, 15, rank=2, density=0.5)
+    cfg = AlsConfig(rank=2, max_iter=2, seed=0)
+    U, V = fit(u, i, r, 20, 15, cfg)
+    u_valid = jnp.ones(3, bool)
+    p = predict(U, V, jnp.array([0, 1, -1]), jnp.array([0, 99, 2]),
+                u_valid, jnp.array([True, True, True]))
+    p = np.asarray(p)
+    assert np.isfinite(p[0])
+    assert np.isnan(p[1])  # item idx out of range -> NaN, even if mask says ok
+    assert np.isnan(p[2])  # negative id -> NaN

@@ -1,0 +1,92 @@
+"""Blocking/bucketing unit tests — SURVEY.md §4 mapping item 3.
+
+The reference suite round-trips LocalIndexEncoder and the in-block
+compression (ALSSuite); here the analogous invariants are: CSR
+blockify/unblockify round-trip, padding invariants, and id-remap round-trip.
+"""
+
+import numpy as np
+
+from tpu_als.core.ratings import build_csr_buckets, remap_ids
+
+
+def coo_from_buckets(csr):
+    rows, cols, vals = [], [], []
+    for b in csr.buckets:
+        r, c = np.nonzero(b.mask)
+        rows.append(b.rows[r])
+        cols.append(b.cols[r, c])
+        vals.append(b.vals[r, c])
+    return (
+        np.concatenate(rows),
+        np.concatenate(cols),
+        np.concatenate(vals),
+    )
+
+
+def test_roundtrip(rng):
+    n_rows, n_cols, nnz = 50, 30, 400
+    row = rng.integers(0, n_rows, nnz)
+    col = rng.integers(0, n_cols, nnz)
+    val = rng.normal(size=nnz).astype(np.float32)
+    csr = build_csr_buckets(row, col, val, n_rows, min_width=4)
+    assert csr.nnz == nnz
+    r2, c2, v2 = coo_from_buckets(csr)
+    assert len(r2) == nnz
+    order_a = np.lexsort((v2, c2, r2))
+    order_b = np.lexsort((val, col, row))
+    np.testing.assert_array_equal(r2[order_a], row[order_b])
+    np.testing.assert_array_equal(c2[order_a], col[order_b])
+    np.testing.assert_allclose(v2[order_a], val[order_b])
+
+
+def test_bucket_invariants(rng):
+    row = rng.integers(0, 100, 1000)
+    col = rng.integers(0, 60, 1000)
+    val = np.ones(1000, dtype=np.float32)
+    csr = build_csr_buckets(row, col, val, 100, min_width=8)
+    widths = [b.width for b in csr.buckets]
+    assert widths == sorted(widths)
+    for b in csr.buckets:
+        # width is a power of two >= min_width
+        assert b.width >= 8 and (b.width & (b.width - 1)) == 0
+        # per-row entry counts fit the width and exceed half of it (or min)
+        per_row = b.mask.sum(axis=1)
+        real = b.rows < csr.num_rows
+        assert np.all(per_row[real] <= b.width)
+        if b.width > 8:
+            assert np.all(per_row[real] > b.width // 2)
+        # padding rows are fully masked out and scatter out-of-bounds
+        assert np.all(per_row[~real] == 0)
+        assert np.all(b.rows[~real] == csr.num_rows)
+    # counts match
+    np.testing.assert_array_equal(csr.counts, np.bincount(row, minlength=100))
+
+
+def test_rows_with_zero_ratings_absent(rng):
+    row = np.array([0, 0, 2, 5])
+    col = np.array([1, 2, 0, 3])
+    val = np.ones(4, dtype=np.float32)
+    csr = build_csr_buckets(row, col, val, 7, min_width=2)
+    present = np.concatenate([b.rows[b.rows < 7] for b in csr.buckets])
+    assert set(present.tolist()) == {0, 2, 5}
+    assert csr.counts[1] == 0 and csr.counts[6] == 0
+
+
+def test_remap_roundtrip(rng):
+    raw = rng.choice(np.array([7, 42, 1000000007, -3, 8]), size=200)
+    dense, idmap = remap_ids(raw)
+    assert dense.min() >= 0 and dense.max() < len(idmap)
+    np.testing.assert_array_equal(idmap.to_original(dense), raw)
+    np.testing.assert_array_equal(idmap.to_dense(raw), dense)
+    # unseen ids map to missing
+    assert idmap.to_dense(np.array([999]))[0] == -1
+
+
+def test_duplicate_entries_kept(rng):
+    row = np.array([1, 1, 1])
+    col = np.array([2, 2, 3])
+    val = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    csr = build_csr_buckets(row, col, val, 3, min_width=2)
+    r2, c2, v2 = coo_from_buckets(csr)
+    assert sorted(v2.tolist()) == [1.0, 2.0, 3.0]

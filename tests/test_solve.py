@@ -1,0 +1,122 @@
+"""Solver unit tests vs numpy/scipy oracles — SURVEY.md §4 mapping item 2.
+
+The reference suite tests CholeskySolver/NNLSSolver against exact rank-1
+reconstructions and known QP solutions (ALSSuite / NNLSSuite); here the
+batched solvers are checked against direct dense solves and scipy's nnls.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_als.ops.solve import (
+    compute_yty,
+    normal_eq_explicit,
+    normal_eq_implicit,
+    solve_nnls,
+    solve_spd,
+)
+
+
+def dense_reference_explicit(Vg, vals, mask, reg):
+    n, w, r = Vg.shape
+    A = np.zeros((n, r, r))
+    b = np.zeros((n, r))
+    for u in range(n):
+        cnt = 0
+        for k in range(w):
+            if mask[u, k] > 0:
+                v = Vg[u, k]
+                A[u] += np.outer(v, v)
+                b[u] += vals[u, k] * v
+                cnt += 1
+        A[u] += reg * cnt * np.eye(r)
+    return A, b
+
+
+def test_normal_eq_explicit_matches_loop(rng):
+    n, w, r = 7, 12, 5
+    Vg = rng.normal(size=(n, w, r)).astype(np.float32)
+    vals = rng.normal(size=(n, w)).astype(np.float32)
+    mask = (rng.random((n, w)) < 0.7).astype(np.float32)
+    A, b, count = normal_eq_explicit(jnp.array(Vg), jnp.array(vals), jnp.array(mask), 0.3)
+    A_ref, b_ref = dense_reference_explicit(Vg, vals, mask, 0.3)
+    np.testing.assert_allclose(np.asarray(A), A_ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(b), b_ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(count), mask.sum(-1))
+
+
+def test_normal_eq_implicit_matches_loop(rng):
+    n, w, r = 5, 9, 4
+    alpha, reg = 2.0, 0.1
+    Vg = rng.normal(size=(n, w, r)).astype(np.float32)
+    vals = (rng.normal(size=(n, w)) * 2).astype(np.float32)
+    mask = (rng.random((n, w)) < 0.8).astype(np.float32)
+    Y = rng.normal(size=(20, r)).astype(np.float32)
+    YtY = Y.T @ Y
+    A, b, count = normal_eq_implicit(
+        jnp.array(Vg), jnp.array(vals), jnp.array(mask), reg, alpha, jnp.array(YtY)
+    )
+    A_ref = np.zeros((n, r, r))
+    b_ref = np.zeros((n, r))
+    for u in range(n):
+        cnt = 0
+        for k in range(w):
+            if mask[u, k] > 0:
+                v = Vg[u, k]
+                c = 1 + alpha * abs(vals[u, k])
+                A_ref[u] += (c - 1) * np.outer(v, v)
+                if vals[u, k] > 0:
+                    b_ref[u] += c * v
+                    cnt += 1  # reference's numExplicits: only positives
+        A_ref[u] += YtY + reg * cnt * np.eye(r)
+    np.testing.assert_allclose(np.asarray(A), A_ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(b), b_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_solve_spd_matches_numpy(rng):
+    n, r = 16, 8
+    M = rng.normal(size=(n, r, r)).astype(np.float32)
+    A = M @ np.transpose(M, (0, 2, 1)) + 0.5 * np.eye(r, dtype=np.float32)
+    b = rng.normal(size=(n, r)).astype(np.float32)
+    count = np.ones(n, dtype=np.float32)
+    x = np.asarray(solve_spd(jnp.array(A), jnp.array(b), jnp.array(count)))
+    x_ref = np.stack([np.linalg.solve(A[k], b[k]) for k in range(n)])
+    np.testing.assert_allclose(x, x_ref, rtol=1e-3, atol=1e-3)
+
+
+def test_solve_spd_empty_rows_are_zero(rng):
+    n, r = 4, 6
+    A = np.zeros((n, r, r), dtype=np.float32)
+    b = np.zeros((n, r), dtype=np.float32)
+    count = np.zeros(n, dtype=np.float32)
+    x = np.asarray(solve_spd(jnp.array(A), jnp.array(b), jnp.array(count)))
+    assert np.all(np.isfinite(x))
+    np.testing.assert_allclose(x, 0.0)
+
+
+def test_solve_nnls_matches_scipy(rng):
+    scipy_opt = pytest.importorskip("scipy.optimize")
+    n, r = 6, 5
+    M = rng.normal(size=(n, r, r)).astype(np.float32)
+    A = M @ np.transpose(M, (0, 2, 1)) + 0.1 * np.eye(r, dtype=np.float32)
+    b = rng.normal(size=(n, r)).astype(np.float32)
+    count = np.ones(n, dtype=np.float32)
+    x = np.asarray(
+        solve_nnls(jnp.array(A), jnp.array(b), jnp.array(count), sweeps=400)
+    )
+    assert np.all(x >= -1e-6)
+    for k in range(n):
+        # scipy solves min ||Gz - h||; our problem min 1/2 zᵀAz - bᵀz with A=GᵀG, b=Gᵀh
+        G = np.linalg.cholesky(A[k]).T
+        h = np.linalg.solve(G.T, b[k])
+        z_ref, _ = scipy_opt.nnls(G, h)
+        np.testing.assert_allclose(x[k], z_ref, rtol=2e-2, atol=2e-2)
+
+
+def test_compute_yty(rng):
+    V = rng.normal(size=(30, 7)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(compute_yty(jnp.array(V))), V.T @ V, rtol=1e-4, atol=1e-4
+    )

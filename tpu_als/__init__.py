@@ -1,0 +1,25 @@
+"""tpu_als — a TPU-native recommender framework.
+
+Reimplements the full capability surface of the reference repo
+(``amy-leaf/Recommender-System-using-Apache-Spark-MLlib-``, a Spark MLlib ALS
+recommender — see SURVEY.md; the reference mount was empty, so the spec is the
+``pyspark.ml.recommendation.ALS`` stack it delegates to) as an idiomatic
+JAX/XLA stack:
+
+- factor matrices are sharded ``jax.Array``s on a named device mesh,
+- each ALS half-step is one batched normal-equation build + Cholesky solve,
+- the Spark shuffle is replaced by on-device collectives
+  (``all_gather`` / ring ``ppermute``),
+- new ratings fold in via a jitted incremental update instead of a refit.
+
+Package map (SURVEY.md §7):
+  ops/       batched numerics: normal equations, Cholesky/NNLS solves, top-k
+  core/      ratings containers (bucketed padded CSR), ALS loop, fold-in
+  parallel/  mesh helpers + gather strategies (replicate/all_gather/ring)
+  api/       Param system, ALS Estimator / ALSModel, evaluators, tuning
+  io/        MovieLens loaders, checkpoint/persistence
+  stream/    micro-batch fold-in driver
+  models/    two-tower retrieval model warm-started from ALS factors
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+from tpu_als.core.ratings import (  # noqa: F401
+    Bucket,
+    CsrBuckets,
+    IdMap,
+    build_csr_buckets,
+    remap_ids,
+)
+from tpu_als.core.als import AlsConfig, train, predict  # noqa: F401
+from tpu_als.core.foldin import fold_in  # noqa: F401

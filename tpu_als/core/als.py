@@ -1,0 +1,183 @@
+"""The ALS training engine: jitted half-steps over bucketed padded CSR.
+
+This is the TPU-native replacement for the reference stack's ``computeFactors``
+loop (Spark MLlib ``ml/recommendation/ALS.scala`` — SURVEY.md §3.1): where
+Spark runs, per iteration, two RDD shuffles moving factor messages between
+user-blocks and item-blocks and then per-row scalar solves inside tasks, here
+each half-step is one jitted function: gather the opposite factor rows per
+degree-bucket, build all normal equations with one einsum per bucket, and
+solve them with one batched Cholesky (or fixed-sweep NNLS) per chunk.
+
+Single-device and sharded training share :func:`local_half_step`; the sharded
+path (tpu_als.parallel.trainer) wraps it in ``shard_map`` with an
+``all_gather`` of the opposite factor shard in place of the shuffle.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from tpu_als.ops.solve import (
+    compute_yty,
+    normal_eq_explicit,
+    normal_eq_implicit,
+    solve_nnls,
+    solve_spd,
+)
+
+
+@dataclass(frozen=True)
+class AlsConfig:
+    """Algorithm knobs.  Names/defaults mirror the Estimator params (§2.D)."""
+
+    rank: int = 10
+    max_iter: int = 10
+    reg_param: float = 0.1
+    implicit_prefs: bool = False
+    alpha: float = 1.0
+    nonnegative: bool = False
+    seed: int = 0
+    nnls_sweeps: int = 32
+    compute_dtype: str = "float32"  # or "bfloat16" for the A/b einsums
+
+
+def init_factors(key, num_rows, rank, dtype=jnp.float32):
+    """Seeded init: unit-norm gaussian rows, like the reference stack's
+    XORShiftRandom + normalize init (SURVEY.md §3.1 ``initialize``)."""
+    x = jax.random.normal(key, (num_rows, rank), dtype=jnp.float32)
+    nrm = jnp.linalg.norm(x, axis=1, keepdims=True)
+    return (x / jnp.maximum(nrm, 1e-12)).astype(dtype)
+
+
+def _bucket_chunk(nb, w, chunk_elems):
+    chunk = max(1, min(chunk_elems // w, nb))
+    if nb % chunk:
+        chunk = math.gcd(nb, chunk)
+    return chunk
+
+
+def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
+                    chunk_elems=1 << 19):
+    """Solve all rows of one side given the full opposite factor matrix.
+
+    V_full [N_opposite, r]; buckets: list[Bucket] (device arrays); returns
+    new factors [num_rows, r].  Everything static-shaped; per bucket the rows
+    are processed in scan chunks so the gathered [chunk, w, r] tensor stays
+    within the HBM budget set by ``chunk_elems`` — pass the value the buckets
+    were built with (``CsrBuckets.chunk_elems``) so row padding divides the
+    chunk exactly.
+    """
+    r = V_full.shape[-1]
+    cdt = jnp.dtype(cfg.compute_dtype)
+    out = jnp.zeros((num_rows, r), dtype=jnp.float32)
+
+    for b in buckets:
+        nb, w = b.cols.shape
+        chunk = _bucket_chunk(nb, w, chunk_elems)
+        nchunks = nb // chunk
+        cols = b.cols.reshape(nchunks, chunk, w)
+        vals = b.vals.reshape(nchunks, chunk, w)
+        mask = b.mask.reshape(nchunks, chunk, w)
+
+        def solve_chunk(args):
+            c, v, m = args
+            Vg = V_full[c].astype(cdt)
+            if cfg.implicit_prefs:
+                A, rhs, count = normal_eq_implicit(
+                    Vg, v.astype(cdt), m.astype(cdt), cfg.reg_param, cfg.alpha,
+                    YtY.astype(jnp.float32),
+                )
+            else:
+                A, rhs, count = normal_eq_explicit(
+                    Vg, v.astype(cdt), m.astype(cdt), cfg.reg_param
+                )
+            A = A.astype(jnp.float32)
+            rhs = rhs.astype(jnp.float32)
+            if cfg.nonnegative:
+                return solve_nnls(A, rhs, count, sweeps=cfg.nnls_sweeps)
+            return solve_spd(A, rhs, count)
+
+        if nchunks == 1:
+            x = solve_chunk((cols[0], vals[0], mask[0]))
+            xs = x[None]
+        else:
+            xs = jax.lax.map(solve_chunk, (cols, vals, mask))
+        # padding rows carry index num_rows -> out of bounds -> dropped
+        out = out.at[b.rows].set(
+            xs.reshape(nb, r), mode="drop", unique_indices=True
+        )
+    return out
+
+
+def make_step(user_buckets, item_buckets, num_users, num_items, cfg: AlsConfig,
+              user_chunk_elems=1 << 19, item_chunk_elems=1 << 19):
+    """Build the jitted full ALS iteration (item half-step then user
+    half-step, the reference stack's order — SURVEY.md §3.1)."""
+
+    def step(U, V):
+        if cfg.implicit_prefs:
+            YtY_u = compute_yty(U)
+            V = local_half_step(U, item_buckets, num_items, cfg, YtY_u,
+                                item_chunk_elems)
+            YtY_v = compute_yty(V)
+            U = local_half_step(V, user_buckets, num_users, cfg, YtY_v,
+                                user_chunk_elems)
+        else:
+            V = local_half_step(U, item_buckets, num_items, cfg,
+                                chunk_elems=item_chunk_elems)
+            U = local_half_step(V, user_buckets, num_users, cfg,
+                                chunk_elems=user_chunk_elems)
+        return U, V
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def train(user_csr, item_csr, cfg: AlsConfig, callback=None):
+    """Single-device ALS training loop.
+
+    ``user_csr``: CsrBuckets keyed by user (cols = item idx) — solves U.
+    ``item_csr``: CsrBuckets keyed by item (cols = user idx) — solves V.
+    ``callback(iteration, U, V)`` runs between iterations (logging,
+    checkpointing); the per-iteration compute itself is one jitted call with
+    zero host round-trips inside.
+    """
+    num_users = user_csr.num_rows
+    num_items = item_csr.num_rows
+    key = jax.random.PRNGKey(cfg.seed)
+    ku, kv = jax.random.split(key)
+    U = init_factors(ku, num_users, cfg.rank)
+    V = init_factors(kv, num_items, cfg.rank)
+
+    ub = jax.device_put(user_csr.device_buckets())
+    ib = jax.device_put(item_csr.device_buckets())
+    step = make_step(ub, ib, num_users, num_items, cfg,
+                     user_csr.chunk_elems, item_csr.chunk_elems)
+
+    for it in range(cfg.max_iter):
+        U, V = step(U, V)
+        if callback is not None:
+            callback(it + 1, U, V)
+    return U, V
+
+
+@jax.jit
+def predict(U, V, u_idx, i_idx, u_valid, i_valid):
+    """Gather-dot scoring: the TPU replacement for the reference stack's two
+    distributed hash joins in ``ALSModel.transform`` (SURVEY.md §3.2).
+
+    Out-of-range / cold ids (valid mask False) yield NaN — the
+    ``coldStartStrategy='nan'`` semantic; 'drop' filters host-side.
+    """
+    u = jnp.clip(u_idx, 0, U.shape[0] - 1)
+    i = jnp.clip(i_idx, 0, V.shape[0] - 1)
+    scores = jnp.einsum("nr,nr->n", U[u], V[i])
+    ok = (
+        u_valid & i_valid
+        & (u_idx >= 0) & (u_idx < U.shape[0])
+        & (i_idx >= 0) & (i_idx < V.shape[0])
+    )
+    return jnp.where(ok, scores, jnp.nan)

@@ -1,0 +1,59 @@
+"""Jitted incremental fold-in: update touched user factors without a refit.
+
+The reference stack has no streaming path — Spark MLlib requires a full refit
+when new ratings arrive (SURVEY.md §3.5).  The north-star (BASELINE.json
+configs[3]) replaces that with the standard ALS fold-in: for each touched
+user u with rating rows against the *fixed* item factors V,
+
+    u* = (VᵤᵀCᵤVᵤ + λ·n·I)⁻¹ VᵤᵀCᵤp(u)
+
+— exactly one batched half-step restricted to the touched rows, served as a
+single jitted kernel.  Shapes are padded to power-of-two (rows and width) by
+the stream driver so repeated micro-batches hit the jit cache.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from tpu_als.ops.solve import (
+    compute_yty,
+    normal_eq_explicit,
+    normal_eq_implicit,
+    solve_nnls,
+    solve_spd,
+)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("implicit_prefs", "nonnegative", "nnls_sweeps")
+)
+def fold_in(
+    V,
+    cols,
+    vals,
+    mask,
+    reg_param,
+    implicit_prefs=False,
+    alpha=1.0,
+    nonnegative=False,
+    nnls_sweeps=32,
+    YtY=None,
+):
+    """Solve factors for a batch of touched entities against fixed ``V``.
+
+    cols/vals/mask: [n, w] padded CSR rows (same convention as
+    tpu_als.core.ratings).  Returns new factors [n, rank].
+    """
+    Vg = V[cols]
+    if implicit_prefs:
+        if YtY is None:
+            YtY = compute_yty(V)
+        A, b, count = normal_eq_implicit(Vg, vals, mask, reg_param, alpha, YtY)
+    else:
+        A, b, count = normal_eq_explicit(Vg, vals, mask, reg_param)
+    if nonnegative:
+        return solve_nnls(A, b, count, sweeps=nnls_sweeps)
+    return solve_spd(A, b, count)

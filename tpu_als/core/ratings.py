@@ -1,0 +1,185 @@
+"""Ratings containers: id remapping + bucketed, padded CSR shards.
+
+This is the TPU-native replacement for the reference stack's blocking
+machinery (Spark MLlib's ``RatingBlock``/``InBlock``/``OutBlock``/
+``LocalIndexEncoder`` inside ``ml/recommendation/ALS.scala`` — SURVEY.md
+§2.B4): where Spark compresses ratings into a ``numUserBlocks ×
+numItemBlocks`` grid of CSC-like structures and shuffles factor messages
+between them, we lay ratings out as **statically-shaped, degree-bucketed,
+padded CSR** resident in HBM, so every ALS half-step is a fixed set of
+gather→einsum→cholesky calls with no dynamic shapes (SURVEY.md §7 hard-part 1:
+"raggedness on a static-shape machine").
+
+Bucketing: entity rows are grouped by rating count into power-of-two width
+buckets (width = next_pow2(count), floored at ``min_width``), each padded to
+its width.  Power-law degree skew therefore costs at most 2× padding per row
+instead of max-degree× padding for a single rectangle.
+
+All structures here are host-side numpy; the trainer moves them to device
+once (the "pulled … into device-sharded CSR blocks once" step of the
+north-star in BASELINE.json).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import numpy as np
+
+
+class Bucket(NamedTuple):
+    """One fixed-width padded CSR bucket.  A pytree of arrays.
+
+    rows [nb]      entity index per row; padding rows hold ``oob_row`` (one
+                   past the last valid index) so factor scatters can use
+                   ``mode='drop'`` instead of a mask.
+    cols [nb, w]   opposite-entity indices (0 in padding slots)
+    vals [nb, w]   ratings (0 in padding slots)
+    mask [nb, w]   1.0 real / 0.0 padding
+    """
+
+    rows: np.ndarray
+    cols: np.ndarray
+    vals: np.ndarray
+    mask: np.ndarray
+
+    @property
+    def width(self):
+        return self.cols.shape[1]
+
+
+@dataclass
+class CsrBuckets:
+    """All buckets for one side (users or items) of one shard."""
+
+    buckets: list  # list[Bucket], ascending width
+    num_rows: int  # entity count this shard (valid scatter targets)
+    counts: np.ndarray  # [num_rows] rating count per entity
+    nnz: int
+    chunk_elems: int  # scan-chunk budget the padding was built for; the
+    # trainer must chunk with this same value (rows are pre-padded to it)
+
+    @property
+    def padded_nnz(self):
+        return sum(b.mask.size for b in self.buckets)
+
+    def device_buckets(self):
+        """Buckets as a plain list of NamedTuples (already a pytree)."""
+        return list(self.buckets)
+
+
+@dataclass
+class IdMap:
+    """Dense-index ↔ original-id mapping, persisted with the model.
+
+    The reference stack requires ids to fit in int range and keeps them as-is
+    (SURVEY.md §7 hard-part 5); we additionally densify to 0..N-1 so factor
+    matrices are plain arrays.  ``ids[dense] == original``.
+    """
+
+    ids: np.ndarray  # [n] original ids, position = dense index
+
+    def __post_init__(self):
+        self._lookup = None
+
+    def __len__(self):
+        return len(self.ids)
+
+    def to_dense(self, original, missing=-1):
+        """Map original ids -> dense indices; unseen ids -> ``missing``."""
+        original = np.asarray(original)
+        if self._lookup is None:
+            order = np.argsort(self.ids, kind="stable")
+            self._lookup = (self.ids[order], order)
+        sorted_ids, order = self._lookup
+        pos = np.searchsorted(sorted_ids, original)
+        pos = np.clip(pos, 0, len(sorted_ids) - 1)
+        hit = sorted_ids[pos] == original
+        return np.where(hit, order[pos], missing).astype(np.int64)
+
+    def to_original(self, dense):
+        return self.ids[np.asarray(dense)]
+
+
+def remap_ids(raw):
+    """Densify one id column.  Returns (dense_idx [n], IdMap)."""
+    raw = np.asarray(raw)
+    uniq, inv = np.unique(raw, return_inverse=True)
+    return inv.astype(np.int64), IdMap(ids=uniq)
+
+
+def _next_pow2(x):
+    return 1 << int(max(0, int(np.ceil(np.log2(max(1, x))))))
+
+
+def build_csr_buckets(
+    row_idx,
+    col_idx,
+    vals,
+    num_rows,
+    min_width=8,
+    chunk_elems=1 << 19,
+    dtype=np.float32,
+):
+    """Build degree-bucketed padded CSR from COO triples.
+
+    Duplicate (row, col) entries are kept as-is (they contribute twice, same
+    as duplicate ratings fed to the reference stack's blocking).
+
+    Rows per bucket are padded to a multiple of the bucket's scan chunk
+    (``max(1, chunk_elems // width)``) so the trainer can reshape to
+    [nchunks, chunk, w] without tracing-time pads; padding rows carry
+    ``rows == num_rows`` (out-of-bounds ⇒ scatter-dropped).
+    """
+    row_idx = np.asarray(row_idx, dtype=np.int64)
+    col_idx = np.asarray(col_idx, dtype=np.int64)
+    vals = np.asarray(vals, dtype=dtype)
+    nnz = len(row_idx)
+    counts = np.bincount(row_idx, minlength=num_rows).astype(np.int64)
+
+    order = np.argsort(row_idx, kind="stable")
+    s_rows = row_idx[order]
+    s_cols = col_idx[order]
+    s_vals = vals[order]
+
+    uniq, starts, ucounts = np.unique(s_rows, return_index=True, return_counts=True)
+    # per-entry: rank of its row among unique rows, and offset within the row
+    entry_rank = np.repeat(np.arange(len(uniq)), ucounts)
+    entry_off = np.arange(nnz) - starts[entry_rank]
+
+    widths = np.maximum(
+        min_width,
+        1 << np.ceil(np.log2(np.maximum(ucounts, 1))).astype(np.int64),
+    )
+    buckets = []
+    for w in sorted(set(widths.tolist())):
+        sel_rows = np.flatnonzero(widths == w)  # indices into uniq
+        nb = len(sel_rows)
+        # chunk never exceeds the row count: small buckets must not be padded
+        # up to a full scan chunk (that costs orders of magnitude in padding)
+        chunk = max(1, min(chunk_elems // w, nb))
+        nb_pad = -(-nb // chunk) * chunk
+        rows = np.full(nb_pad, num_rows, dtype=np.int32)
+        rows[:nb] = uniq[sel_rows]
+        cols = np.zeros((nb_pad, w), dtype=np.int32)
+        v = np.zeros((nb_pad, w), dtype=dtype)
+        m = np.zeros((nb_pad, w), dtype=dtype)
+        # local row position within this bucket for each selected unique row
+        local = np.full(len(uniq), -1, dtype=np.int64)
+        local[sel_rows] = np.arange(nb)
+        emask = local[entry_rank] >= 0
+        er = local[entry_rank[emask]]
+        eo = entry_off[emask]
+        cols[er, eo] = s_cols[emask]
+        v[er, eo] = s_vals[emask]
+        m[er, eo] = 1.0
+        buckets.append(Bucket(rows=rows, cols=cols, vals=v, mask=m))
+
+    return CsrBuckets(
+        buckets=buckets,
+        num_rows=num_rows,
+        counts=counts,
+        nnz=nnz,
+        chunk_elems=chunk_elems,
+    )

@@ -1,0 +1,8 @@
+from tpu_als.ops.solve import (  # noqa: F401
+    normal_eq_explicit,
+    normal_eq_implicit,
+    solve_spd,
+    solve_nnls,
+    compute_yty,
+)
+from tpu_als.ops.topk import chunked_topk_scores  # noqa: F401

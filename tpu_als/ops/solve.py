@@ -1,0 +1,141 @@
+"""Batched normal-equation build + least-squares solves — the numerics core.
+
+This replaces the reference stack's per-row scalar path (Spark MLlib's
+``NormalEquation`` accumulating ``A += x xᵀ`` one rating at a time via BLAS
+``dspr``, then one LAPACK ``dppsv`` packed-Cholesky call *per entity row* —
+canonical upstream ``mllib/src/main/scala/org/apache/spark/ml/recommendation/
+ALS.scala``, ``NormalEquation`` / ``CholeskySolver`` / ``NNLSSolver``;
+SURVEY.md §2.B5) with one **batched** einsum + Cholesky over every row of a
+shard at once, which is the shape the TPU MXU wants: a handful of large
+contractions instead of millions of rank-2 BLAS calls.
+
+Shapes use the padded-CSR convention from :mod:`tpu_als.core.ratings`:
+
+  ``Vg``   [n, w, r]  gathered opposite-side factor rows per entity
+  ``vals`` [n, w]     ratings (0 in padding slots)
+  ``mask`` [n, w]     1.0 for real entries, 0.0 for padding
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def normal_eq_explicit(Vg, vals, mask, reg):
+    """Normal equations for explicit-feedback ALS (ALS-WR weighting).
+
+    For each entity u with rated factor rows ``v_k`` and ratings ``r_k``:
+
+        A_u = Σ_k v_k v_kᵀ + λ·n_u·I        b_u = Σ_k r_k v_k
+
+    λ is scaled by the per-entity rating count ``n_u`` — the "weighted-λ"
+    scheme Spark ALS uses (``regParam * ne.k`` in the reference stack's solver,
+    SURVEY.md §2.B5), which makes regParam roughly scale-free in dataset size.
+
+    Returns ``(A [n,r,r], b [n,r], count [n])``.
+    """
+    Vm = Vg * mask[..., None]
+    # Σ v vᵀ over the w axis. One MXU-friendly contraction for all n rows.
+    A = jnp.einsum("nwr,nws->nrs", Vm, Vm, preferred_element_type=jnp.float32)
+    b = jnp.einsum("nw,nwr->nr", vals * mask, Vg, preferred_element_type=jnp.float32)
+    count = jnp.sum(mask, axis=-1)
+    r = Vg.shape[-1]
+    eye = jnp.eye(r, dtype=A.dtype)
+    A = A + (reg * count)[:, None, None] * eye
+    return A, b, count
+
+
+def normal_eq_implicit(Vg, vals, mask, reg, alpha, YtY):
+    """Normal equations for implicit-feedback ALS (Hu–Koren–Volinsky).
+
+    Confidence ``c_k = 1 + α·|r_k|``, preference ``p_k = 1 if r_k > 0 else 0``.
+    Using the YᵀY trick (SURVEY.md §3.1 — the reference stack computes YtY
+    once per half-step via ``treeAggregate``; here it's one einsum + psum):
+
+        A_u = YᵀY + Σ_k (c_k − 1) v_k v_kᵀ + λ·n_u·I
+        b_u = Σ_k c_k p_k v_k
+
+    Negative ratings contribute confidence but preference 0, and — matching
+    the reference solver's ``numExplicits`` — only ratings > 0 count toward
+    the λ·n regularization scaling.
+
+    Returns ``(A [n,r,r], b [n,r], count [n])``.
+    """
+    conf_m1 = alpha * jnp.abs(vals) * mask          # c - 1, zeroed in padding
+    pref = (vals > 0).astype(Vg.dtype)
+    A = jnp.einsum(
+        "nw,nwr,nws->nrs", conf_m1, Vg, Vg, preferred_element_type=jnp.float32
+    )
+    b = jnp.einsum(
+        "nw,nwr->nr", (1.0 + conf_m1) * pref * mask, Vg,
+        preferred_element_type=jnp.float32,
+    )
+    count = jnp.sum(pref * mask, axis=-1)
+    r = Vg.shape[-1]
+    eye = jnp.eye(r, dtype=A.dtype)
+    A = A + YtY[None] + (reg * count)[:, None, None] * eye
+    return A, b, count
+
+
+def compute_yty(V):
+    """YᵀY over all (valid) factor rows; invalid rows must be zero.
+
+    [N, r] -> [r, r].  Under ``shard_map`` callers ``psum`` the result over the
+    mesh axis — the analog of the reference stack's ``treeAggregate``.
+    """
+    return jnp.einsum("nr,ns->rs", V, V, preferred_element_type=jnp.float32)
+
+
+def solve_spd(A, b, count, jitter=1e-6):
+    """Batched SPD solve via Cholesky: x = A⁻¹ b for each row.
+
+    Rows with ``count == 0`` (entities with no ratings in this shard — padding
+    rows or cold entities) get A replaced by I so the factorization stays
+    finite; their b is 0 so the solution is exactly 0.  This is the batched
+    equivalent of the reference solver's per-row ``dppsv`` (SURVEY.md §2.C1).
+    """
+    r = A.shape[-1]
+    eye = jnp.eye(r, dtype=A.dtype)
+    empty = (count <= 0)[:, None, None]
+    A = jnp.where(empty, eye, A) + jitter * eye
+    L = jnp.linalg.cholesky(A)
+    y = jax.scipy.linalg.solve_triangular(L, b[..., None], lower=True)
+    x = jax.scipy.linalg.solve_triangular(
+        L, y, lower=True, trans=1
+    )[..., 0]
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("sweeps",))
+def solve_nnls(A, b, count, sweeps=32):
+    """Batched nonnegative least squares via cyclic coordinate descent.
+
+    Replaces the reference stack's projected-CG ``NNLSSolver``
+    (``mllib/.../optimization/NNLS.scala``, SURVEY.md §2.B5) with a
+    fixed-iteration, jittable scheme: for SPD A, cyclic CD on
+    ½xᵀAx − bᵀx subject to x ≥ 0 converges monotonically; a fixed number of
+    sweeps keeps shapes/trip-counts static for XLA (SURVEY.md §7 hard-part 4).
+    """
+    r = A.shape[-1]
+    eye = jnp.eye(r, dtype=A.dtype)
+    empty = (count <= 0)[:, None, None]
+    A = jnp.where(empty, eye, A) + 1e-6 * eye
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)  # [n, r]
+
+    x0 = jnp.zeros_like(b)
+
+    def sweep(x, _):
+        def coord(j, x):
+            # residual_j = (A x - b)_j ; x_j <- max(0, x_j - residual_j / A_jj)
+            Ax_j = jnp.einsum("nr,nr->n", A[:, j, :], x)
+            xj = jnp.maximum(0.0, x[:, j] - (Ax_j - b[:, j]) / diag[:, j])
+            return x.at[:, j].set(xj)
+
+        x = jax.lax.fori_loop(0, r, coord, x)
+        return x, None
+
+    x, _ = jax.lax.scan(sweep, x0, None, length=sweeps)
+    return x

@@ -1,0 +1,56 @@
+"""Chunked GEMM + running top-k — the recommendation serving kernel.
+
+Replaces the reference stack's ``recommendForAll`` path (blockify both factor
+sets, crossJoin all block pairs, per-pair BLAS3 GEMM, per-row
+``BoundedPriorityQueue`` merge across a shuffle — SURVEY.md §3.3) with a
+single jitted scan: stream item-factor tiles through an MXU GEMM against the
+resident user block and fold each tile's scores into a running
+``jax.lax.top_k``.  No queues, no shuffle, no host round-trips.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-3.4e38)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "item_chunk"))
+def chunked_topk_scores(U, V, item_valid, k, item_chunk=8192):
+    """Top-k items per user row of ``U``.
+
+    U [n, r]; V [Ni, r]; item_valid [Ni] bool (False rows never recommended —
+    padding rows and cold items).  Returns (scores [n, k], indices [n, k]).
+    """
+    n, r = U.shape
+    Ni = V.shape[0]
+    nchunks = -(-Ni // item_chunk)
+    pad = nchunks * item_chunk - Ni
+    Vp = jnp.pad(V, ((0, pad), (0, 0)))
+    validp = jnp.pad(item_valid, (0, pad)).astype(jnp.bool_)
+    Vc = Vp.reshape(nchunks, item_chunk, r)
+    validc = validp.reshape(nchunks, item_chunk)
+    base = jnp.arange(nchunks, dtype=jnp.int32) * item_chunk
+
+    init_s = jnp.full((n, k), NEG_INF, dtype=jnp.float32)
+    init_i = jnp.zeros((n, k), dtype=jnp.int32)
+
+    def step(carry, chunk):
+        best_s, best_i = carry
+        Vt, valid, off = chunk
+        scores = jnp.einsum(
+            "nr,cr->nc", U, Vt, preferred_element_type=jnp.float32
+        )
+        scores = jnp.where(valid[None, :], scores, NEG_INF)
+        ids = off + jnp.arange(Vt.shape[0], dtype=jnp.int32)
+        cat_s = jnp.concatenate([best_s, scores], axis=1)
+        cat_i = jnp.concatenate([best_i, jnp.broadcast_to(ids, (n, Vt.shape[0]))], axis=1)
+        new_s, sel = jax.lax.top_k(cat_s, k)
+        new_i = jnp.take_along_axis(cat_i, sel, axis=1)
+        return (new_s, new_i), None
+
+    (best_s, best_i), _ = jax.lax.scan(step, (init_s, init_i), (Vc, validc, base))
+    return best_s, best_i

@@ -1,0 +1,77 @@
+"""Distributed-without-a-pod tests — SURVEY.md §4 item 4: an 8-device forced
+CPU mesh (the analog of the reference suite's ``local-cluster[...]`` masters)
+must reproduce the single-device result to fp tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_als.core.als import AlsConfig, train
+from tpu_als.core.ratings import build_csr_buckets
+from tpu_als.parallel.data import partition_balanced, shard_csr
+from tpu_als.parallel.mesh import make_mesh
+from tpu_als.parallel.trainer import train_sharded
+
+from conftest import make_ratings
+
+
+def _both(rng, cfg, num_users=50, num_items=35, implicit=False, n_dev=8):
+    u, i, r, _, _ = make_ratings(rng, num_users, num_items, rank=3, density=0.4)
+    if implicit:
+        r = np.abs(r) * 4 + 0.1
+
+    ucsr = build_csr_buckets(u, i, r, num_users, min_width=4)
+    icsr = build_csr_buckets(i, u, r, num_items, min_width=4)
+    U1, V1 = train(ucsr, icsr, cfg)
+
+    mesh = make_mesh(n_dev)
+    upart = partition_balanced(np.bincount(u, minlength=num_users), n_dev)
+    ipart = partition_balanced(np.bincount(i, minlength=num_items), n_dev)
+    ush = shard_csr(upart, ipart, u, i, r, min_width=4)
+    ish = shard_csr(ipart, upart, i, u, r, min_width=4)
+    Us, Vs = train_sharded(mesh, upart, ipart, ush, ish, cfg)
+    # slot space -> entity space
+    U8 = np.asarray(Us)[upart.slot]
+    V8 = np.asarray(Vs)[ipart.slot]
+    return (np.asarray(U1), np.asarray(V1)), (U8, V8)
+
+
+@pytest.mark.parametrize("implicit", [False, True])
+def test_sharded_equals_single_device(rng, implicit):
+    assert len(jax.devices()) == 8, "conftest must force an 8-device CPU mesh"
+    cfg = AlsConfig(rank=3, max_iter=4, reg_param=0.05,
+                    implicit_prefs=implicit, alpha=8.0, seed=11)
+    (U1, V1), (U8, V8) = _both(np.random.default_rng(1), cfg, implicit=implicit)
+    np.testing.assert_allclose(U8, U1, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(V8, V1, rtol=2e-3, atol=2e-3)
+
+
+def test_sharded_nonnegative(rng):
+    cfg = AlsConfig(rank=3, max_iter=3, reg_param=0.05, nonnegative=True, seed=2)
+    (U1, V1), (U8, V8) = _both(np.random.default_rng(3), cfg)
+    assert U8.min() >= -1e-5
+    np.testing.assert_allclose(U8, U1, rtol=5e-3, atol=5e-3)
+
+
+def test_partition_balance():
+    rng = np.random.default_rng(0)
+    # power-law counts
+    counts = (rng.pareto(1.2, size=1000) * 10).astype(np.int64) + 1
+    part = partition_balanced(counts, 8)
+    loads = np.bincount(part.owner, weights=counts, minlength=8)
+    avg = counts.sum() / 8
+    assert loads.max() <= avg + counts.max()
+    # slots are unique and in range
+    slots = part.slot
+    assert len(np.unique(slots)) == len(slots)
+    assert slots.max() < part.padded_rows
+
+
+def test_uneven_entity_count(rng):
+    # num_users not divisible by device count; some devices get fewer rows
+    cfg = AlsConfig(rank=2, max_iter=2, reg_param=0.1, seed=5)
+    (U1, V1), (U8, V8) = _both(np.random.default_rng(7), cfg,
+                               num_users=13, num_items=9, n_dev=8)
+    np.testing.assert_allclose(U8, U1, rtol=2e-3, atol=2e-3)

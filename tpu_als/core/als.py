@@ -15,11 +15,12 @@ path (tpu_als.parallel.trainer) wraps it in ``shard_map`` with an
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+
+from tpu_als.core.ratings import scan_chunk
 
 from tpu_als.ops.solve import (
     compute_yty,
@@ -53,13 +54,6 @@ def init_factors(key, num_rows, rank, dtype=jnp.float32):
     return (x / jnp.maximum(nrm, 1e-12)).astype(dtype)
 
 
-def _bucket_chunk(nb, w, chunk_elems):
-    chunk = max(1, min(chunk_elems // w, nb))
-    if nb % chunk:
-        chunk = math.gcd(nb, chunk)
-    return chunk
-
-
 def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
                     chunk_elems=1 << 19):
     """Solve all rows of one side given the full opposite factor matrix.
@@ -77,7 +71,7 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
 
     for b in buckets:
         nb, w = b.cols.shape
-        chunk = _bucket_chunk(nb, w, chunk_elems)
+        chunk = scan_chunk(nb, w, chunk_elems)
         nchunks = nb // chunk
         cols = b.cols.reshape(nchunks, chunk, w)
         vals = b.vals.reshape(nchunks, chunk, w)

@@ -22,6 +22,7 @@ north-star in BASELINE.json).
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import NamedTuple
 
@@ -113,6 +114,20 @@ def _next_pow2(x):
     return 1 << int(max(0, int(np.ceil(np.log2(max(1, x))))))
 
 
+def scan_chunk(nb, width, chunk_elems):
+    """Rows per scan step for a bucket of ``nb`` rows of ``width``.
+
+    The single source of truth shared by the bucket builders (which pad row
+    counts to a multiple of this) and the trainer (which reshapes by it) —
+    they must agree exactly or the [nchunks, chunk, w] reshape fails.
+    Never exceeds ``nb`` so small buckets aren't padded up to a full chunk.
+    """
+    chunk = max(1, min(chunk_elems // width, nb))
+    if nb % chunk:
+        chunk = math.gcd(nb, chunk)
+    return chunk
+
+
 def build_csr_buckets(
     row_idx,
     col_idx,
@@ -156,9 +171,7 @@ def build_csr_buckets(
     for w in sorted(set(widths.tolist())):
         sel_rows = np.flatnonzero(widths == w)  # indices into uniq
         nb = len(sel_rows)
-        # chunk never exceeds the row count: small buckets must not be padded
-        # up to a full scan chunk (that costs orders of magnitude in padding)
-        chunk = max(1, min(chunk_elems // w, nb))
+        chunk = scan_chunk(nb, w, chunk_elems)
         nb_pad = -(-nb // chunk) * chunk
         rows = np.full(nb_pad, num_rows, dtype=np.int32)
         rows[:nb] = uniq[sel_rows]

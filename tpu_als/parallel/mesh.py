@@ -1,0 +1,36 @@
+"""Device mesh helpers — the substrate for the sharded trainer.
+
+The reference stack's scale-out substrate is Spark's cluster runtime
+(executors + netty RPC + sort shuffle, SURVEY.md §2.B8/§2.C2).  Here the
+substrate is a 1-D ``jax.sharding.Mesh`` with a single ``"d"`` axis: user
+factors, item factors, and rating shards are all partitioned along it, and
+each ALS half-step all-gathers the opposite factor shard over ICI (ring
+``ppermute`` streaming at the scale where a full gather no longer fits —
+tpu_als.parallel.comm).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
+
+AXIS = "d"
+
+
+def make_mesh(n_devices=None, devices=None, axis=AXIS):
+    """1-D mesh over the first ``n_devices`` (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def shard_leading(mesh, axis=AXIS):
+    """NamedSharding that splits the leading array axis over the mesh."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())

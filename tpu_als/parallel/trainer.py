@@ -1,0 +1,128 @@
+"""Sharded ALS trainer: ``shard_map`` half-steps with on-device collectives.
+
+This is the replacement for the reference stack's distributed hot loop
+(SURVEY.md §3.1): where Spark's ``computeFactors`` runs an executor↔executor
+sort shuffle of factor messages twice per iteration, here each half-step is
+
+    1. ``all_gather`` the opposite factor shard over the mesh (ICI), and
+    2. a purely local bucketed solve for the rows this device owns,
+
+inside one jitted ``shard_map`` — the exact design the north-star names
+("every iteration runs on-device with an ``all_gather`` instead of a Spark
+shuffle", BASELINE.json).  For implicit feedback the YᵀY precompute is a
+``psum`` of per-shard partials — the analog of Spark's ``treeAggregate``.
+
+Factor layout: slot space (tpu_als.parallel.data) — entity e's row lives at
+``slot[e]`` in a ``[D*rows_per_shard, r]`` array sharded on the leading axis,
+so the device-major gather is directly indexable by the slot ids stored in
+the rating shards.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_als.core.als import AlsConfig, init_factors, local_half_step
+from tpu_als.ops.solve import compute_yty
+from tpu_als.parallel.mesh import AXIS
+
+shard_map = jax.shard_map
+
+
+def _squeeze0(tree):
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def make_sharded_step(mesh, user_sharded, item_sharded, cfg: AlsConfig):
+    """Jitted full ALS iteration over the mesh.
+
+    user_sharded/item_sharded: ShardedCsr (stacked host arrays; placed on
+    device by the caller with a leading-axis sharding).
+    Returns ``step(U, V) -> (U, V)`` on slot-space factor arrays sharded
+    over ``mesh``.
+    """
+    n_shards = user_sharded.buckets[0].rows.shape[0]
+    if mesh.devices.size != n_shards:
+        raise ValueError(
+            f"mesh has {mesh.devices.size} devices but the rating shards were "
+            f"built for {n_shards}; a mismatch would silently drop shards"
+        )
+    per_u = user_sharded.rows_per_shard
+    per_i = item_sharded.rows_per_shard
+    u_chunk = user_sharded.chunk_elems
+    i_chunk = item_sharded.chunk_elems
+
+    def step_body(U_loc, V_loc, ubuckets, ibuckets):
+        ubuckets = _squeeze0(ubuckets)
+        ibuckets = _squeeze0(ibuckets)
+        # --- item half-step: gather U, solve owned item rows ---
+        U_full = jax.lax.all_gather(U_loc, AXIS, axis=0, tiled=True)
+        if cfg.implicit_prefs:
+            YtY_u = jax.lax.psum(compute_yty(U_loc), AXIS)
+            V_new = local_half_step(U_full, ibuckets, per_i, cfg, YtY_u, i_chunk)
+        else:
+            V_new = local_half_step(U_full, ibuckets, per_i, cfg,
+                                    chunk_elems=i_chunk)
+        # --- user half-step: gather V, solve owned user rows ---
+        V_full = jax.lax.all_gather(V_new, AXIS, axis=0, tiled=True)
+        if cfg.implicit_prefs:
+            YtY_v = jax.lax.psum(compute_yty(V_new), AXIS)
+            U_new = local_half_step(V_full, ubuckets, per_u, cfg, YtY_v, u_chunk)
+        else:
+            U_new = local_half_step(V_full, ubuckets, per_u, cfg,
+                                    chunk_elems=u_chunk)
+        return U_new, V_new
+
+    sharded = shard_map(
+        step_body,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
+                  cfg: AlsConfig, callback=None):
+    """Distributed ALS training loop.  Returns slot-space (U, V) jax.Arrays
+    sharded over ``mesh``; index with ``Partition.slot`` to get entity rows.
+    """
+    leading = NamedSharding(mesh, P(AXIS))
+    ub = jax.device_put(user_sharded.device_buckets(), leading)
+    ib = jax.device_put(item_sharded.device_buckets(), leading)
+
+    key = jax.random.PRNGKey(cfg.seed)
+    ku, kv = jax.random.split(key)
+    # init in slot space: entity e's initial row is a function of its slot;
+    # padding slots start at zero and stay zero (count==0 rows solve to 0)
+    U = jax.device_put(
+        _slot_init(ku, user_part, cfg.rank), leading
+    )
+    V = jax.device_put(
+        _slot_init(kv, item_part, cfg.rank), leading
+    )
+
+    step = make_sharded_step(mesh, user_sharded, item_sharded, cfg)
+    for it in range(cfg.max_iter):
+        U, V = step(U, V, ub, ib)
+        if callback is not None:
+            callback(it + 1, U, V)
+    return U, V
+
+
+def _slot_init(key, part, rank):
+    """Unit-norm gaussian rows scattered into slot positions.
+
+    Row e of the dense init lands at slot[e], so a sharded run and a
+    single-device run started from the same seed see identical per-entity
+    initial factors (the equivalence tests rely on this).
+    """
+    import numpy as np
+
+    n = len(part.owner)
+    dense = init_factors(key, n, rank)
+    out = np.zeros((part.padded_rows, rank), dtype=np.float32)
+    out[np.asarray(part.slot)] = np.asarray(dense)
+    return out

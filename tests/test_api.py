@@ -1,0 +1,217 @@
+"""API conformance tests — SURVEY.md §4 item 6: param names/defaults/
+validation exactly per §2.D, plus transform/coldStart/recommend/persistence
+semantics of the reference surface.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_als import ALS, ALSModel, ColumnarFrame, RegressionEvaluator
+from tpu_als.utils.frame import as_frame
+
+from conftest import make_ratings
+
+
+EXPECTED_DEFAULTS = {
+    "rank": 10, "maxIter": 10, "regParam": 0.1, "numUserBlocks": 10,
+    "numItemBlocks": 10, "implicitPrefs": False, "alpha": 1.0,
+    "userCol": "user", "itemCol": "item", "ratingCol": "rating",
+    "predictionCol": "prediction", "nonnegative": False,
+    "checkpointInterval": 10, "intermediateStorageLevel": "MEMORY_AND_DISK",
+    "finalStorageLevel": "MEMORY_AND_DISK", "coldStartStrategy": "nan",
+    "blockSize": 4096, "solver": "jax_tpu",
+}
+
+
+def small_frame(rng, nU=40, nI=30):
+    u, i, r, _, _ = make_ratings(rng, nU, nI, rank=3, density=0.4)
+    return ColumnarFrame({"user": u, "item": i, "rating": r})
+
+
+def test_param_defaults_match_reference():
+    als = ALS()
+    for name, expected in EXPECTED_DEFAULTS.items():
+        assert als.getOrDefault(als.getParam(name)) == expected, name
+
+
+def test_param_setters_getters():
+    als = ALS()
+    als.setRank(32).setMaxIter(5).setRegParam(0.01).setImplicitPrefs(True)
+    assert als.getRank() == 32
+    assert als.getMaxIter() == 5
+    assert als.getRegParam() == 0.01
+    assert als.getImplicitPrefs() is True
+    als2 = ALS(rank=7, alpha=40.0)
+    assert als2.getRank() == 7 and als2.getAlpha() == 40.0
+
+
+def test_param_validation():
+    with pytest.raises(ValueError):
+        ALS(rank=0).fit(ColumnarFrame({"user": np.array([0]),
+                                       "item": np.array([0]),
+                                       "rating": np.array([1.0])}))
+    with pytest.raises(ValueError):
+        ALS(coldStartStrategy="bogus").fit(
+            ColumnarFrame({"user": np.array([0]), "item": np.array([0]),
+                           "rating": np.array([1.0])}))
+    with pytest.raises(TypeError):
+        ALS(notAParam=3)
+    with pytest.raises(ValueError):
+        # non-integer id columns rejected (reference int-range restriction)
+        ALS().fit(ColumnarFrame({"user": np.array([0.5]),
+                                 "item": np.array([0]),
+                                 "rating": np.array([1.0])}))
+
+
+def test_copy_with_extra_grid_semantics():
+    als = ALS(rank=5)
+    c = als.copy({als.regParam: 0.9})
+    assert c.getRegParam() == 0.9
+    assert als.getRegParam() == 0.1  # original untouched
+    assert c.getRank() == 5
+
+
+def test_fit_transform_rmse(rng):
+    frame = small_frame(rng)
+    als = ALS(rank=4, maxIter=8, regParam=0.02, seed=3)
+    model = als.fit(frame)
+    out = model.transform(frame)
+    assert "prediction" in out.columns
+    ev = RegressionEvaluator(labelCol="rating")
+    rmse = ev.evaluate(out)
+    assert rmse < 0.3
+
+
+def test_cold_start_nan_vs_drop(rng):
+    frame = small_frame(rng)
+    model = ALS(rank=3, maxIter=3, seed=0).fit(frame)
+    unseen = ColumnarFrame({"user": np.array([10**6]),
+                            "item": np.array([0])})
+    p = model.transform(unseen)
+    assert np.isnan(p["prediction"][0])
+    model_drop = ALS(rank=3, maxIter=3, seed=0,
+                     coldStartStrategy="drop").fit(frame)
+    p2 = model_drop.transform(unseen)
+    assert len(p2) == 0
+
+
+def test_original_ids_roundtrip(rng):
+    # non-contiguous original ids must round-trip through the model
+    u = np.array([100, 100, 2000, 2000, 55])
+    i = np.array([7, 9000, 7, 9000, 7])
+    r = np.array([5.0, 1.0, 1.0, 5.0, 3.0], dtype=np.float32)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    model = ALS(rank=2, maxIter=5, regParam=0.01, seed=1).fit(frame)
+    out = model.transform(frame)
+    assert np.isfinite(out["prediction"]).all()
+    uf = model.userFactors
+    assert set(uf["id"].tolist()) == {55, 100, 2000}
+
+
+def test_recommend_for_all_users(rng):
+    frame = small_frame(rng)
+    model = ALS(rank=3, maxIter=4, seed=2).fit(frame)
+    recs = model.recommendForAllUsers(5)
+    assert len(recs) == len(model.userFactors)
+    first = recs["recommendations"][0]
+    assert len(first) == 5
+    scores = [s for _, s in first]
+    assert scores == sorted(scores, reverse=True)
+    item_ids = set(model.itemFactors["id"].tolist())
+    assert all(iid in item_ids for iid, _ in first)
+
+
+def test_recommend_subset(rng):
+    frame = small_frame(rng)
+    model = ALS(rank=3, maxIter=4, seed=2).fit(frame)
+    users = np.unique(frame["user"])[:3]
+    recs = model.recommendForUserSubset(
+        ColumnarFrame({"user": users}), 4)
+    assert len(recs) == 3
+    assert set(recs["user"].tolist()) == set(users.tolist())
+    # unseen users silently excluded (reference behavior)
+    recs2 = model.recommendForUserSubset(
+        ColumnarFrame({"user": np.array([users[0], 10**7])}), 4)
+    assert len(recs2) == 1
+
+
+def test_model_save_load_roundtrip(rng, tmp_path):
+    frame = small_frame(rng)
+    model = ALS(rank=3, maxIter=3, seed=4).fit(frame)
+    path = str(tmp_path / "als_model")
+    model.save(path)
+    loaded = ALSModel.load(path)
+    out1 = model.transform(frame)
+    out2 = loaded.transform(frame)
+    np.testing.assert_allclose(out1["prediction"], out2["prediction"],
+                               rtol=1e-6)
+    assert loaded.rank == 3
+
+
+def test_sharded_fit_via_mesh(rng):
+    import jax
+
+    from tpu_als.parallel.mesh import make_mesh
+
+    frame = small_frame(rng)
+    assert len(jax.devices()) == 8
+    m1 = ALS(rank=3, maxIter=4, seed=5).fit(frame)
+    m8 = ALS(rank=3, maxIter=4, seed=5, mesh=make_mesh(8)).fit(frame)
+    o1 = m1.transform(frame)
+    o8 = m8.transform(frame)
+    np.testing.assert_allclose(o1["prediction"], o8["prediction"],
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_checkpoint_written(rng, tmp_path):
+    frame = small_frame(rng)
+    als = ALS(rank=3, maxIter=4, seed=0, checkpointInterval=2,
+              checkpointDir=str(tmp_path))
+    als.fit(frame)
+    from tpu_als.io.checkpoint import load_factors
+    manifest, u_ids, U, i_ids, V = load_factors(
+        str(tmp_path / "als_checkpoint"))
+    assert manifest["iteration"] == 4
+    assert U.shape[1] == 3
+
+
+def test_frame_random_split(rng):
+    frame = small_frame(rng, nU=100, nI=50)
+    a, b = frame.randomSplit([0.8, 0.2], seed=42)
+    assert len(a) + len(b) == len(frame)
+    assert 0.6 < len(a) / len(frame) < 0.95
+    a2, b2 = frame.randomSplit([0.8, 0.2], seed=42)
+    np.testing.assert_array_equal(a["user"], a2["user"])
+
+
+def test_as_frame_accepts_dict(rng):
+    d = {"user": np.array([0, 1]), "item": np.array([0, 1]),
+         "rating": np.array([1.0, 2.0], dtype=np.float32)}
+    f = as_frame(d)
+    assert f.columns == ["user", "item", "rating"]
+    model = ALS(rank=2, maxIter=2).fit(d)  # plain dict accepted by fit
+    assert model.rank == 2
+
+
+def test_missing_rating_col_raises_and_empty_means_ones(rng):
+    frame = ColumnarFrame({"user": np.array([0, 1]), "item": np.array([0, 1]),
+                           "wrong_name": np.array([1.0, 2.0], np.float32)})
+    with pytest.raises(ValueError, match="rating"):
+        ALS(rank=2, maxIter=1).fit(frame)
+    m = ALS(rank=2, maxIter=1, ratingCol="").fit(frame)  # unit ratings
+    assert np.isfinite(m.transform(frame)["prediction"]).all()
+
+
+def test_checkpoint_survives_swap_window(rng, tmp_path):
+    import os
+    from tpu_als.io.checkpoint import load_factors, save_factors
+
+    path = str(tmp_path / "ck")
+    ids = np.arange(3)
+    save_factors(path, ids, np.ones((3, 2)), ids, np.ones((3, 2)),
+                 iteration=1)
+    # simulate a crash between the two renames: new never installed,
+    # old still at path.old
+    os.rename(path, path + ".old")
+    manifest, *_ = load_factors(path)
+    assert manifest["iteration"] == 1

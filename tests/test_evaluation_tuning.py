@@ -1,0 +1,143 @@
+"""Evaluator + tuning tests — RankingMetrics vs hand-computed values (the
+reference's RankingMetricsSuite protocol, SURVEY.md §4) and grid/CV drivers.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_als import (
+    ALS,
+    ColumnarFrame,
+    CrossValidator,
+    ParamGridBuilder,
+    RegressionEvaluator,
+    TrainValidationSplit,
+)
+from tpu_als.api.evaluation import RankingEvaluator, RankingMetrics
+
+from conftest import make_ratings
+
+
+def test_regression_evaluator_metrics():
+    frame = ColumnarFrame({
+        "prediction": np.array([1.0, 2.0, 3.0, np.nan]),
+        "label": np.array([1.5, 2.0, 2.0, 9.0]),
+    })
+    ev = RegressionEvaluator(labelCol="label")
+    # NaN prediction row excluded
+    np.testing.assert_allclose(ev.evaluate(frame),
+                               np.sqrt((0.25 + 0 + 1.0) / 3))
+    assert ev.evaluate(frame, {ev.getParam("metricName"): "mae"}) == pytest.approx(
+        (0.5 + 0 + 1.0) / 3)
+    mse = ev.copy({ev.getParam("metricName"): "mse"}).evaluate(frame)
+    assert mse == pytest.approx((0.25 + 0 + 1.0) / 3)
+    r2 = ev.copy({ev.getParam("metricName"): "r2"}).evaluate(frame)
+    label = np.array([1.5, 2.0, 2.0])
+    ss_tot = ((label - label.mean()) ** 2).sum()
+    assert r2 == pytest.approx(1 - 1.25 / ss_tot)
+    assert not ev.isLargerBetter()
+
+
+def test_ranking_metrics_hand_computed():
+    # one query: predicted [1,2,3], relevant {1,3}
+    m = RankingMetrics([([1, 2, 3], [1, 3])])
+    assert m.precisionAt(1) == 1.0
+    assert m.precisionAt(2) == 0.5
+    assert m.precisionAt(3) == pytest.approx(2 / 3)
+    assert m.recallAt(2) == 0.5
+    # AP = (1/1 + 2/3)/2
+    assert m.meanAveragePrecision == pytest.approx((1 + 2 / 3) / 2)
+    # NDCG@2: DCG = 1/log2(2); IDCG = 1/log2(2)+1/log2(3)
+    expected = (1 / np.log2(2)) / (1 / np.log2(2) + 1 / np.log2(3))
+    assert m.ndcgAt(2) == pytest.approx(expected)
+    # empty relevant set contributes 0
+    m2 = RankingMetrics([([1, 2], []), ([1, 2], [1])])
+    assert m2.precisionAt(1) == pytest.approx(0.5)
+
+
+def test_ranking_evaluator():
+    frame = ColumnarFrame({
+        "prediction": np.array([[1, 2, 3], [4, 5, 6]], dtype=object),
+        "label": np.array([[1, 3], [9]], dtype=object),
+    })
+    ev = RankingEvaluator(metricName="precisionAtK", k=2)
+    assert ev.evaluate(frame) == pytest.approx((0.5 + 0.0) / 2)
+    assert ev.isLargerBetter()
+
+
+def test_param_grid_builder():
+    als = ALS()
+    grid = (ParamGridBuilder()
+            .addGrid(als.rank, [2, 4])
+            .addGrid(als.regParam, [0.01, 0.1])
+            .build())
+    assert len(grid) == 4
+    assert {m[als.rank] for m in grid} == {2, 4}
+
+
+def test_cross_validator_picks_sane_rank(rng):
+    u, i, r, _, _ = make_ratings(rng, 60, 40, rank=3, density=0.5, noise=0.02)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    als = ALS(maxIter=5, seed=0)
+    grid = (ParamGridBuilder()
+            .addGrid(als.rank, [1, 4])
+            .addGrid(als.regParam, [0.02])
+            .build())
+    ev = RegressionEvaluator(labelCol="rating")
+    cv = CrossValidator(estimator=als, estimatorParamMaps=grid,
+                        evaluator=ev, numFolds=2, seed=7)
+    cvm = cv.fit(frame)
+    assert len(cvm.avgMetrics) == 2
+    # rank=4 must beat rank=1 on rank-3 ground truth
+    assert cvm.avgMetrics[1] < cvm.avgMetrics[0]
+    out = cvm.transform(frame)
+    assert "prediction" in out.columns
+
+
+def test_train_validation_split(rng):
+    u, i, r, _, _ = make_ratings(rng, 50, 30, rank=2, density=0.5)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    als = ALS(maxIter=4, seed=0)
+    grid = ParamGridBuilder().addGrid(als.regParam, [0.01, 5.0]).build()
+    ev = RegressionEvaluator(labelCol="rating")
+    tvs = TrainValidationSplit(estimator=als, estimatorParamMaps=grid,
+                               evaluator=ev, trainRatio=0.75, seed=1)
+    model = tvs.fit(frame)
+    assert len(model.validationMetrics) == 2
+    # absurd regularization must lose
+    assert model.validationMetrics[0] < model.validationMetrics[1]
+
+
+def test_legacy_mllib_api(rng):
+    from tpu_als.api.legacy import ALS as LegacyALS, Rating
+
+    u, i, r, _, _ = make_ratings(rng, 30, 20, rank=2, density=0.5)
+    ratings = [Rating(int(a), int(b), float(c)) for a, b, c in zip(u, i, r)]
+    model = LegacyALS.train(ratings, rank=3, iterations=5, lambda_=0.01, seed=0)
+    p = model.predict(int(u[0]), int(i[0]))
+    assert np.isfinite(p)
+    preds = model.predictAll([(int(u[0]), int(i[0])), (int(u[1]), int(i[1]))])
+    assert len(preds) == 2 and isinstance(preds[0], Rating)
+    recs = model.recommendProducts(int(u[0]), 5)
+    assert len(recs) == 5
+    assert all(rec.user == int(u[0]) for rec in recs)
+    scores = [rec.rating for rec in recs]
+    assert scores == sorted(scores, reverse=True)
+    uf = model.userFeatures()
+    assert len(uf[0][1]) == 3
+    # implicit variant
+    model2 = LegacyALS.trainImplicit(ratings, rank=2, iterations=3, alpha=10.0)
+    assert np.isfinite(model2.predict(int(u[0]), int(i[0])))
+
+
+def test_legacy_save_load(rng, tmp_path):
+    from tpu_als.api.legacy import ALS as LegacyALS, MatrixFactorizationModel, Rating
+
+    u, i, r, _, _ = make_ratings(rng, 20, 15, rank=2, density=0.5)
+    ratings = [Rating(int(a), int(b), float(c)) for a, b, c in zip(u, i, r)]
+    model = LegacyALS.train(ratings, rank=2, iterations=3, seed=0)
+    path = str(tmp_path / "mf_model")
+    model.save(path)
+    loaded = MatrixFactorizationModel.load(path)
+    assert loaded.predict(int(u[0]), int(i[0])) == pytest.approx(
+        model.predict(int(u[0]), int(i[0])), rel=1e-5)

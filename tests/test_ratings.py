@@ -90,3 +90,22 @@ def test_duplicate_entries_kept(rng):
     csr = build_csr_buckets(row, col, val, 3, min_width=2)
     r2, c2, v2 = coo_from_buckets(csr)
     assert sorted(v2.tolist()) == [1.0, 2.0, 3.0]
+
+
+def test_large_bucket_chunking_pads_instead_of_collapsing(rng):
+    # odd row count larger than the scan chunk: the builder must pad rows up
+    # to a chunk multiple, not shrink the chunk (a gcd fallback to 1 would
+    # serialize the hot loop)
+    from tpu_als.core.ratings import scan_chunk, scan_chunk_for_padded
+
+    nnz_rows = 101  # odd
+    row = np.repeat(np.arange(nnz_rows), 3)
+    col = rng.integers(0, 10, len(row))
+    val = np.ones(len(row), dtype=np.float32)
+    csr = build_csr_buckets(row, col, val, nnz_rows, min_width=4,
+                            chunk_elems=4 * 10)  # chunk = 10 rows
+    b = csr.buckets[0]
+    chunk = scan_chunk(b.rows.shape[0], b.width, csr.chunk_elems)
+    assert chunk == 10
+    assert b.rows.shape[0] == 110  # padded to a chunk multiple
+    assert scan_chunk_for_padded(b.rows.shape[0], b.width, csr.chunk_elems) == 10

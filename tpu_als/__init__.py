@@ -23,3 +23,18 @@ Package map (SURVEY.md §7):
 """
 
 __version__ = "0.1.0"
+
+from tpu_als.api.estimator import ALS, ALSModel  # noqa: F401
+from tpu_als.api.evaluation import (  # noqa: F401
+    RankingEvaluator,
+    RankingMetrics,
+    RegressionEvaluator,
+)
+from tpu_als.api.tuning import (  # noqa: F401
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
+from tpu_als.utils.frame import ColumnarFrame  # noqa: F401

@@ -1,0 +1,15 @@
+from tpu_als.api.estimator import ALS, ALSModel  # noqa: F401
+from tpu_als.api.evaluation import (  # noqa: F401
+    RankingEvaluator,
+    RankingMetrics,
+    RegressionEvaluator,
+)
+from tpu_als.api.params import Param, Params, TypeConverters  # noqa: F401
+from tpu_als.api.tuning import (  # noqa: F401
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
+from tpu_als.api import legacy  # noqa: F401

@@ -1,0 +1,368 @@
+"""The ALS Estimator / ALSModel — the frozen API surface of the reference.
+
+Mirrors ``pyspark.ml.recommendation.{ALS, ALSModel}`` (canonical upstream
+``python/pyspark/ml/recommendation.py`` — SURVEY.md §2.B1/§2.D): same param
+names, defaults, and method surface (``fit``, ``transform``,
+``recommendForAllUsers/Items``, ``recommendForUserSubset/ItemSubset``,
+``save/load``), plus the north-star's ``solver`` param (``'jax_tpu'``,
+BASELINE.json).  Instead of delegating over Py4J to a JVM, ``fit`` drives the
+TPU-native core: remap ids → bucketed CSR shards → jitted batched-Cholesky
+half-steps (single device or a mesh).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from tpu_als.api.params import Params, TypeConverters
+from tpu_als.core.als import AlsConfig, predict as _predict_kernel, train as _train
+from tpu_als.core.ratings import IdMap, build_csr_buckets, remap_ids
+from tpu_als.io.checkpoint import load_factors, save_factors
+from tpu_als.ops.topk import chunked_topk_scores
+from tpu_als.utils.frame import ColumnarFrame, as_frame
+
+_STORAGE_LEVELS = {
+    "NONE", "DISK_ONLY", "MEMORY_ONLY", "MEMORY_AND_DISK",
+    "MEMORY_ONLY_SER", "MEMORY_AND_DISK_SER", "OFF_HEAP",
+}
+
+# (name, doc, converter, default) — names/defaults per SURVEY.md §2.D
+_ALS_PARAMS = [
+    ("rank", "rank of the factorization", TypeConverters.toInt, 10),
+    ("maxIter", "max number of iterations (>= 0)", TypeConverters.toInt, 10),
+    ("regParam", "regularization parameter (>= 0)", TypeConverters.toFloat, 0.1),
+    ("numUserBlocks", "number of user blocks", TypeConverters.toInt, 10),
+    ("numItemBlocks", "number of item blocks", TypeConverters.toInt, 10),
+    ("implicitPrefs", "whether to use implicit preference",
+     TypeConverters.toBoolean, False),
+    ("alpha", "alpha for implicit preference", TypeConverters.toFloat, 1.0),
+    ("userCol", "column name for user ids", TypeConverters.toString, "user"),
+    ("itemCol", "column name for item ids", TypeConverters.toString, "item"),
+    ("ratingCol", "column name for ratings", TypeConverters.toString, "rating"),
+    ("predictionCol", "prediction column name", TypeConverters.toString,
+     "prediction"),
+    ("nonnegative", "whether to use nonnegative constraint for least squares",
+     TypeConverters.toBoolean, False),
+    ("checkpointInterval", "checkpoint interval (>= 1), -1 disables",
+     TypeConverters.toInt, 10),
+    ("intermediateStorageLevel",
+     "storage level for intermediate datasets (accepted for API parity; "
+     "factors live in device HBM here)", TypeConverters.toString,
+     "MEMORY_AND_DISK"),
+    ("finalStorageLevel", "storage level for final factors (API parity)",
+     TypeConverters.toString, "MEMORY_AND_DISK"),
+    ("coldStartStrategy",
+     "strategy for unknown/unfitted ids at predict time: 'nan' or 'drop'",
+     TypeConverters.toString, "nan"),
+    ("seed", "random seed", TypeConverters.toInt, 0),
+    ("blockSize", "block size for blocked top-k scoring", TypeConverters.toInt,
+     4096),
+    ("solver", "'jax_tpu' (batched-Cholesky TPU core, the only backend here)",
+     TypeConverters.toString, "jax_tpu"),
+]
+
+
+class _ALSParams(Params):
+    def __init__(self):
+        super().__init__()
+        for name, doc, conv, default in _ALS_PARAMS:
+            self._declareParam(name, doc, conv, default)
+
+    def _validate(self):
+        m = self.extractParamMap()
+        get = lambda n: m[self.getParam(n)]  # noqa: E731
+        if get("rank") <= 0:
+            raise ValueError("rank must be > 0")
+        if get("maxIter") < 0:
+            raise ValueError("maxIter must be >= 0")
+        if get("regParam") < 0:
+            raise ValueError("regParam must be >= 0")
+        if get("coldStartStrategy") not in ("nan", "drop"):
+            raise ValueError("coldStartStrategy must be 'nan' or 'drop'")
+        if get("solver") not in ("jax_tpu", "als"):
+            raise ValueError("solver must be 'jax_tpu' or 'als'")
+        for lvl in ("intermediateStorageLevel", "finalStorageLevel"):
+            if get(lvl) not in _STORAGE_LEVELS:
+                raise ValueError(f"{lvl}: unknown storage level {get(lvl)!r}")
+        if get("checkpointInterval") == 0 or get("checkpointInterval") < -1:
+            raise ValueError("checkpointInterval must be >= 1 or -1")
+
+
+def _attach_accessors(cls, names):
+    for name in names:
+        cap = name[0].upper() + name[1:]
+
+        def getter(self, _n=name):
+            return self.getOrDefault(self.getParam(_n))
+
+        def setter(self, value, _n=name):
+            return self._set(**{_n: value})
+
+        setattr(cls, f"get{cap}", getter)
+        setattr(cls, f"set{cap}", setter)
+
+
+class ALS(_ALSParams):
+    """ALS matrix-factorization Estimator (explicit + implicit feedback).
+
+    Runtime-only (non-Param) knobs: ``mesh`` — a ``jax.sharding.Mesh`` to
+    train sharded over devices (None = single device; ``numUserBlocks`` /
+    ``numItemBlocks`` are then API-parity hints only); ``checkpointDir`` —
+    where ``checkpointInterval`` writes resumable factor snapshots.
+    """
+
+    def __init__(self, *, mesh=None, checkpointDir=None, **kwargs):
+        super().__init__()
+        self.mesh = mesh
+        self.checkpointDir = checkpointDir
+        self.setParams(**kwargs)
+
+    def setParams(self, **kwargs):
+        unknown = [k for k in kwargs if not self.hasParam(k)]
+        if unknown:
+            raise TypeError(f"unknown param(s): {unknown}")
+        return self._set(**kwargs)
+
+    def _config(self):
+        m = self.extractParamMap()
+        get = lambda n: m[self.getParam(n)]  # noqa: E731
+        return AlsConfig(
+            rank=get("rank"),
+            max_iter=get("maxIter"),
+            reg_param=get("regParam"),
+            implicit_prefs=get("implicitPrefs"),
+            alpha=get("alpha"),
+            nonnegative=get("nonnegative"),
+            seed=get("seed") or 0,
+        )
+
+    def fit(self, dataset, params=None):
+        if params:
+            return self.copy(params).fit(dataset)
+        self._validate()
+        frame = as_frame(dataset)
+        userCol, itemCol = self.getUserCol(), self.getItemCol()
+        ratingCol = self.getRatingCol()
+        for c in (userCol, itemCol):
+            if c not in frame:
+                raise ValueError(f"column {c!r} not in dataset "
+                                 f"(columns: {frame.columns})")
+            if not np.issubdtype(frame[c].dtype, np.integer):
+                raise ValueError(
+                    f"ALS only supports integer ids; column {c!r} has dtype "
+                    f"{frame[c].dtype} (the reference API has the same "
+                    "integer-range restriction)")
+        u_raw, i_raw = frame[userCol], frame[itemCol]
+        if ratingCol == "":
+            # reference semantic: empty ratingCol means unit ratings
+            r = np.ones(len(frame), dtype=np.float32)
+        elif ratingCol in frame:
+            r = np.asarray(frame[ratingCol], dtype=np.float32)
+        else:
+            raise ValueError(f"column {ratingCol!r} not in dataset "
+                             f"(columns: {frame.columns}); set ratingCol='' "
+                             "for unit ratings")
+
+        u_idx, user_map = remap_ids(u_raw)
+        i_idx, item_map = remap_ids(i_raw)
+        cfg = self._config()
+
+        callback = self._checkpoint_callback(user_map, item_map)
+        if self.mesh is not None:
+            from tpu_als.parallel.data import partition_balanced, shard_csr
+            from tpu_als.parallel.trainer import train_sharded
+
+            D = self.mesh.devices.size
+            upart = partition_balanced(
+                np.bincount(u_idx, minlength=len(user_map)), D)
+            ipart = partition_balanced(
+                np.bincount(i_idx, minlength=len(item_map)), D)
+            ush = shard_csr(upart, ipart, u_idx, i_idx, r)
+            ish = shard_csr(ipart, upart, i_idx, u_idx, r)
+            sharded_cb = None
+            if callback is not None:
+                def sharded_cb(iteration, U, V):  # slot space -> entity space
+                    callback(iteration,
+                             np.asarray(U)[upart.slot],
+                             np.asarray(V)[ipart.slot])
+            Us, Vs = train_sharded(self.mesh, upart, ipart, ush, ish, cfg,
+                                   callback=sharded_cb)
+            U = np.asarray(Us)[upart.slot]
+            V = np.asarray(Vs)[ipart.slot]
+        else:
+            ucsr = build_csr_buckets(u_idx, i_idx, r, len(user_map))
+            icsr = build_csr_buckets(i_idx, u_idx, r, len(item_map))
+            U, V = _train(ucsr, icsr, cfg, callback=callback)
+            U, V = np.asarray(U), np.asarray(V)
+
+        return ALSModel(
+            rank=cfg.rank, user_map=user_map, item_map=item_map,
+            user_factors=U, item_factors=V,
+            params={p.name: v for p, v in self.extractParamMap().items()},
+            parent=self,
+        )
+
+    def _checkpoint_callback(self, user_map, item_map):
+        interval = self.getCheckpointInterval()
+        if self.checkpointDir is None or interval < 1:
+            return None
+        import os
+
+        def cb(iteration, U, V):
+            if iteration % interval == 0:
+                save_factors(
+                    os.path.join(self.checkpointDir, "als_checkpoint"),
+                    user_map.ids, np.asarray(U), item_map.ids, np.asarray(V),
+                    params={p.name: v for p, v in self.extractParamMap().items()},
+                    iteration=iteration,
+                )
+
+        return cb
+
+
+_attach_accessors(ALS, [n for n, _, _, _ in _ALS_PARAMS])
+
+
+class ALSModel:
+    """Fitted model: factor matrices + id maps.  Mirrors
+    ``pyspark.ml.recommendation.ALSModel`` (SURVEY.md §2.D)."""
+
+    def __init__(self, rank, user_map, item_map, user_factors, item_factors,
+                 params, parent=None):
+        self.rank = rank
+        self._user_map = user_map
+        self._item_map = item_map
+        self._U = np.asarray(user_factors, dtype=np.float32)
+        self._V = np.asarray(item_factors, dtype=np.float32)
+        self._params = dict(params)
+        self.parent = parent
+
+    # -- param passthroughs the reference model exposes ----------------
+    def _get(self, name):
+        return self._params[name]
+
+    @property
+    def userFactors(self):
+        """Frame(id, features) — entity ids are the original ids."""
+        return ColumnarFrame({
+            "id": self._user_map.ids,
+            "features": _to_object_rows(self._U),
+        })
+
+    @property
+    def itemFactors(self):
+        return ColumnarFrame({
+            "id": self._item_map.ids,
+            "features": _to_object_rows(self._V),
+        })
+
+    # -- prediction ----------------------------------------------------
+    def transform(self, dataset):
+        frame = as_frame(dataset)
+        userCol, itemCol = self._get("userCol"), self._get("itemCol")
+        u = self._user_map.to_dense(frame[userCol])
+        i = self._item_map.to_dense(frame[itemCol])
+        preds = np.asarray(_predict_kernel(
+            jnp.asarray(self._U), jnp.asarray(self._V),
+            jnp.asarray(u), jnp.asarray(i),
+            jnp.asarray(u >= 0), jnp.asarray(i >= 0),
+        ), dtype=np.float32)
+        out = frame.withColumn(self._get("predictionCol"), preds)
+        if self._get("coldStartStrategy") == "drop":
+            out = out.filter(~np.isnan(preds))
+        return out
+
+    def predict(self, user, item):
+        """Scalar prediction for one (user, item) pair (legacy surface)."""
+        out = self.transform(ColumnarFrame({
+            self._get("userCol"): np.asarray([user]),
+            self._get("itemCol"): np.asarray([item]),
+        }))
+        return float(out[self._get("predictionCol")][0]) if len(out) else float("nan")
+
+    # -- top-k recommendation ------------------------------------------
+    def recommendForAllUsers(self, numItems):
+        return self._recommend(self._U, self._user_map.ids, numItems,
+                               users=True)
+
+    def recommendForAllItems(self, numUsers):
+        return self._recommend(self._V, self._item_map.ids, numUsers,
+                               users=False)
+
+    def recommendForUserSubset(self, dataset, numItems):
+        ids = np.unique(as_frame(dataset)[self._get("userCol")])
+        dense = self._user_map.to_dense(ids)
+        keep = dense >= 0
+        return self._recommend(self._U[dense[keep]], ids[keep], numItems,
+                               users=True)
+
+    def recommendForItemSubset(self, dataset, numUsers):
+        ids = np.unique(as_frame(dataset)[self._get("itemCol")])
+        dense = self._item_map.to_dense(ids)
+        keep = dense >= 0
+        return self._recommend(self._V[dense[keep]], ids[keep], numUsers,
+                               users=False)
+
+    def _recommend(self, Q, q_ids, k, users):
+        """Blocked top-k: stream `blockSize` query rows at a time through the
+        chunked GEMM+top_k kernel (the reference's blockify+crossJoin+queue
+        path collapsed into one jitted scan — SURVEY.md §3.3)."""
+        other = self._V if users else self._U
+        other_ids = self._item_map.ids if users else self._user_map.ids
+        k = min(k, other.shape[0])
+        block = max(1, int(self._get("blockSize")))
+        valid = jnp.ones(other.shape[0], dtype=bool)
+        other_j = jnp.asarray(other)
+        ids_out = np.empty((Q.shape[0], k), dtype=other_ids.dtype)
+        scores_out = np.empty((Q.shape[0], k), dtype=np.float32)
+        for s in range(0, Q.shape[0], block):
+            sc, ix = chunked_topk_scores(
+                jnp.asarray(Q[s:s + block]), other_j, valid, k=k,
+                item_chunk=block,
+            )
+            ids_out[s:s + block] = other_ids[np.asarray(ix)]
+            scores_out[s:s + block] = np.asarray(sc)
+        rec_col = "recommendations"
+        recs = np.empty(Q.shape[0], dtype=object)
+        for row in range(Q.shape[0]):
+            recs[row] = list(zip(ids_out[row].tolist(),
+                                 scores_out[row].tolist()))
+        key_col = self._get("userCol") if users else self._get("itemCol")
+        return ColumnarFrame({key_col: q_ids, rec_col: recs})
+
+    def recommend_arrays(self, numItems, for_users=True):
+        """Dense variant of recommendForAll*: (query_ids, ids [n,k],
+        scores [n,k]) as plain arrays — the TPU-friendly serving surface."""
+        frame_ids = self._user_map.ids if for_users else self._item_map.ids
+        Q = self._U if for_users else self._V
+        other = self._V if for_users else self._U
+        other_ids = self._item_map.ids if for_users else self._user_map.ids
+        k = min(numItems, other.shape[0])
+        sc, ix = chunked_topk_scores(
+            jnp.asarray(Q), jnp.asarray(other),
+            jnp.ones(other.shape[0], bool), k=k,
+        )
+        return frame_ids, other_ids[np.asarray(ix)], np.asarray(sc)
+
+    # -- persistence ----------------------------------------------------
+    def save(self, path):
+        save_factors(path, self._user_map.ids, self._U,
+                     self._item_map.ids, self._V, params=self._params)
+
+    write = save  # pyspark exposes .write().save(path); keep a direct alias
+
+    @classmethod
+    def load(cls, path):
+        manifest, u_ids, U, i_ids, V = load_factors(path)
+        return cls(rank=manifest["rank"], user_map=IdMap(ids=u_ids),
+                   item_map=IdMap(ids=i_ids), user_factors=U, item_factors=V,
+                   params=manifest["params"])
+
+
+def _to_object_rows(mat):
+    out = np.empty(mat.shape[0], dtype=object)
+    for i in range(mat.shape[0]):
+        out[i] = mat[i].copy()
+    return out

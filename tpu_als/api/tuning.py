@@ -1,0 +1,130 @@
+"""Hyper-parameter tuning: ParamGridBuilder, CrossValidator,
+TrainValidationSplit.
+
+Mirrors the reference stack's ``pyspark.ml.tuning`` (SURVEY.md §2.B12): grid
+construction keyed on Param objects, k-fold cross validation and a single
+train/validation split, each refitting the estimator per param map and
+scoring with an evaluator.  Fits within one host process — each inner fit is
+itself a TPU training run.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from tpu_als.utils.frame import as_frame
+
+
+class ParamGridBuilder:
+    def __init__(self):
+        self._grid = {}
+
+    def addGrid(self, param, values):
+        self._grid[param] = list(values)
+        return self
+
+    def baseOn(self, *args):
+        base = {}
+        for a in args:
+            if isinstance(a, dict):
+                base.update(a)
+            else:
+                k, v = a
+                base[k] = v
+        for k, v in base.items():
+            self._grid[k] = [v]
+        return self
+
+    def build(self):
+        keys = list(self._grid)
+        combos = itertools.product(*(self._grid[k] for k in keys))
+        return [dict(zip(keys, c)) for c in combos]
+
+
+class _ValidatorBase:
+    def __init__(self, estimator=None, estimatorParamMaps=None, evaluator=None,
+                 seed=None):
+        self.estimator = estimator
+        self.estimatorParamMaps = estimatorParamMaps
+        self.evaluator = evaluator
+        self.seed = seed
+
+    def _fit_score(self, train, val):
+        scores = []
+        for pm in self.estimatorParamMaps:
+            model = self.estimator.copy(pm).fit(train)
+            scores.append(self.evaluator.evaluate(model.transform(val)))
+        return scores
+
+    def _best_index(self, avg):
+        avg = np.asarray(avg)
+        return int(np.nanargmax(avg) if self.evaluator.isLargerBetter()
+                   else np.nanargmin(avg))
+
+
+class CrossValidator(_ValidatorBase):
+    """k-fold CV over the param grid; refits the best map on all data."""
+
+    def __init__(self, estimator=None, estimatorParamMaps=None, evaluator=None,
+                 numFolds=3, seed=None, collectSubModels=False):
+        super().__init__(estimator, estimatorParamMaps, evaluator, seed)
+        if numFolds < 2:
+            raise ValueError("numFolds must be >= 2")
+        self.numFolds = numFolds
+        self.collectSubModels = collectSubModels
+
+    def fit(self, dataset):
+        frame = as_frame(dataset)
+        rng = np.random.default_rng(self.seed)
+        fold = rng.integers(0, self.numFolds, len(frame))
+        metrics = np.zeros((len(self.estimatorParamMaps), self.numFolds))
+        for f in range(self.numFolds):
+            train = frame.filter(fold != f)
+            val = frame.filter(fold == f)
+            metrics[:, f] = self._fit_score(train, val)
+        avg = metrics.mean(axis=1)
+        best = self._best_index(avg)
+        best_model = self.estimator.copy(self.estimatorParamMaps[best]).fit(frame)
+        return CrossValidatorModel(best_model, avg.tolist(), metrics.tolist())
+
+
+class CrossValidatorModel:
+    def __init__(self, bestModel, avgMetrics, foldMetrics=None):
+        self.bestModel = bestModel
+        self.avgMetrics = avgMetrics
+        self.foldMetrics = foldMetrics
+
+    def transform(self, dataset):
+        return self.bestModel.transform(dataset)
+
+
+class TrainValidationSplit(_ValidatorBase):
+    """Single split tuning — ``trainRatio`` of the data trains, the rest
+    validates; refits the best map on all data."""
+
+    def __init__(self, estimator=None, estimatorParamMaps=None, evaluator=None,
+                 trainRatio=0.75, seed=None):
+        super().__init__(estimator, estimatorParamMaps, evaluator, seed)
+        if not 0 < trainRatio < 1:
+            raise ValueError("trainRatio must be in (0, 1)")
+        self.trainRatio = trainRatio
+
+    def fit(self, dataset):
+        frame = as_frame(dataset)
+        train, val = frame.randomSplit(
+            [self.trainRatio, 1 - self.trainRatio], seed=self.seed)
+        scores = self._fit_score(train, val)
+        best = self._best_index(scores)
+        best_model = self.estimator.copy(self.estimatorParamMaps[best]).fit(frame)
+        return TrainValidationSplitModel(best_model, list(scores))
+
+
+class TrainValidationSplitModel:
+    def __init__(self, bestModel, validationMetrics):
+        self.bestModel = bestModel
+        self.validationMetrics = validationMetrics
+
+    def transform(self, dataset):
+        return self.bestModel.transform(dataset)

@@ -20,7 +20,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-from tpu_als.core.ratings import scan_chunk
+from tpu_als.core.ratings import scan_chunk_for_padded
 
 from tpu_als.ops.solve import (
     compute_yty,
@@ -71,7 +71,7 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
 
     for b in buckets:
         nb, w = b.cols.shape
-        chunk = scan_chunk(nb, w, chunk_elems)
+        chunk = scan_chunk_for_padded(nb, w, chunk_elems)
         nchunks = nb // chunk
         cols = b.cols.reshape(nchunks, chunk, w)
         vals = b.vals.reshape(nchunks, chunk, w)

@@ -118,13 +118,24 @@ def scan_chunk(nb, width, chunk_elems):
     """Rows per scan step for a bucket of ``nb`` rows of ``width``.
 
     The single source of truth shared by the bucket builders (which pad row
-    counts to a multiple of this) and the trainer (which reshapes by it) —
-    they must agree exactly or the [nchunks, chunk, w] reshape fails.
+    counts up to a multiple of this) and the trainer (which reshapes by it).
     Never exceeds ``nb`` so small buckets aren't padded up to a full chunk.
+    May not divide ``nb`` — builders pad rows up; the trainer uses
+    :func:`scan_chunk_for_padded` on the already-padded count.
     """
-    chunk = max(1, min(chunk_elems // width, nb))
-    if nb % chunk:
-        chunk = math.gcd(nb, chunk)
+    return max(1, min(chunk_elems // width, nb))
+
+
+def scan_chunk_for_padded(nb_padded, width, chunk_elems):
+    """Chunk for a bucket whose row count was already padded by a builder.
+
+    Equals :func:`scan_chunk` when trainer and builder agree on
+    ``chunk_elems``; the gcd fallback only defends against a mismatched
+    value (degrading throughput, never correctness).
+    """
+    chunk = scan_chunk(nb_padded, width, chunk_elems)
+    if nb_padded % chunk:
+        chunk = math.gcd(nb_padded, chunk)
     return chunk
 
 

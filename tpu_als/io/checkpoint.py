@@ -1,0 +1,74 @@
+"""Factor checkpointing + model persistence.
+
+One format serves both roles the reference stack splits in two (SURVEY.md
+§5.4): (a) training-time checkpoints for failure recovery — the analog of
+ALS's ``checkpointInterval`` RDD-lineage cut, except ALS is a fixed-point
+iteration so recovery is literally restart-from-factors; and (b) model
+persistence — the analog of ``ALSModel.save`` (JSON metadata +
+userFactors/itemFactors Parquet, SURVEY.md §2.B11), here a JSON manifest +
+``.npz`` arrays (factors and original-id maps).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def save_factors(path, user_ids, user_factors, item_ids, item_factors,
+                 params=None, iteration=None, extra=None):
+    """Write a checkpoint/model directory (atomic via tmp+rename)."""
+    tmp = path + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    np.savez(os.path.join(tmp, "user_factors.npz"),
+             ids=np.asarray(user_ids), factors=np.asarray(user_factors))
+    np.savez(os.path.join(tmp, "item_factors.npz"),
+             ids=np.asarray(item_ids), factors=np.asarray(item_factors))
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "rank": int(np.asarray(user_factors).shape[1]),
+        "num_users": int(np.asarray(user_factors).shape[0]),
+        "num_items": int(np.asarray(item_factors).shape[0]),
+        "iteration": iteration,
+        "params": params or {},
+        "extra": extra or {},
+    }
+    with open(os.path.join(tmp, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    # swap so a complete checkpoint exists at `path` or `path.old` at every
+    # instant: rename old aside, install new, then delete old.  load_factors
+    # falls back to `.old` if a crash hit the window between the renames.
+    old = path + ".old"
+    import shutil
+
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    if os.path.exists(path):
+        os.rename(path, old)
+    os.rename(tmp, path)
+    if os.path.exists(old):
+        shutil.rmtree(old)
+
+
+def load_factors(path):
+    """Read a checkpoint/model directory.
+
+    Returns (manifest, user_ids, user_factors, item_ids, item_factors).
+    """
+    if not os.path.exists(os.path.join(path, "manifest.json")) and \
+            os.path.exists(os.path.join(path + ".old", "manifest.json")):
+        path = path + ".old"  # crash hit the save_factors swap window
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest["format_version"] > FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {manifest['format_version']} is newer than "
+            f"this build supports ({FORMAT_VERSION})"
+        )
+    u = np.load(os.path.join(path, "user_factors.npz"), allow_pickle=False)
+    i = np.load(os.path.join(path, "item_factors.npz"), allow_pickle=False)
+    return manifest, u["ids"], u["factors"], i["ids"], i["factors"]

@@ -15,7 +15,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
-NEG_INF = jnp.float32(-3.4e38)
+# plain python float: creating a jnp scalar here would initialize the JAX
+# backend as an import side effect
+NEG_INF = -3.4e38
 
 
 @functools.partial(jax.jit, static_argnames=("k", "item_chunk"))

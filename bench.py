@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Headline benchmark: ALS iterations/sec @ rank=128, MovieLens-25M scale,
+implicit feedback (alpha=40) — BASELINE.json config 2 on one TPU core.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "iters/sec", "vs_baseline": N, ...}
+
+``vs_baseline`` caveat (documented in BASELINE.md): the reference publishes
+no numbers and Spark cannot run in this environment, so the baseline is the
+north-star's comparison point — 8-executor Spark ALS on ML-25M at rank=128 —
+taken as 60 s/iteration (0.0167 iters/sec), a deliberately conservative
+figure for a well-tuned 8-executor cluster on a ~25M-rating, rank-128
+problem (Spark shuffles the factor messages twice per iteration and solves
+per-row with LAPACK dppsv).  The north-star bar is >=20x.
+
+Usage: python bench.py [--small] [--iters N]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+SPARK_8EXEC_ITERS_PER_SEC = 1.0 / 60.0  # documented proxy, see module doc
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true",
+                    help="1/25 scale for quick checks")
+    ap.add_argument("--iters", type=int, default=3,
+                    help="timed iterations after warmup")
+    ap.add_argument("--rank", type=int, default=128)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+
+    from tpu_als.core.als import AlsConfig, make_step, init_factors
+    from tpu_als.core.ratings import build_csr_buckets
+    from tpu_als.io.movielens import ML25M_SHAPE, synthetic_movielens
+
+    nU, nI, nnz = ML25M_SHAPE
+    if args.small:
+        nU, nI, nnz = nU // 25, nI // 25, nnz // 25
+
+    log(f"devices: {jax.devices()}")
+    t0 = time.time()
+    frame = synthetic_movielens(nU, nI, nnz, seed=0)
+    u = np.asarray(frame["user"])
+    i = np.asarray(frame["item"])
+    r = np.asarray(frame["rating"])
+    log(f"synthesized {nnz:,} ratings ({time.time()-t0:.1f}s)")
+
+    t0 = time.time()
+    ucsr = build_csr_buckets(u, i, r, nU)
+    icsr = build_csr_buckets(i, u, r, nI)
+    log(f"blocked: user waste {ucsr.padded_nnz/ucsr.nnz:.2f}x, "
+        f"item waste {icsr.padded_nnz/icsr.nnz:.2f}x ({time.time()-t0:.1f}s)")
+
+    cfg = AlsConfig(rank=args.rank, max_iter=1, reg_param=0.01,
+                    implicit_prefs=True, alpha=40.0, seed=0)
+    key = jax.random.PRNGKey(0)
+    ku, kv = jax.random.split(key)
+    U = init_factors(ku, nU, cfg.rank)
+    V = init_factors(kv, nI, cfg.rank)
+    ub = jax.device_put(ucsr.device_buckets())
+    ib = jax.device_put(icsr.device_buckets())
+    step = make_step(ub, ib, nU, nI, cfg, ucsr.chunk_elems, icsr.chunk_elems)
+
+    t0 = time.time()
+    U, V = step(U, V)
+    U.block_until_ready()
+    log(f"warmup (compile + 1 iter): {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    for _ in range(args.iters):
+        U, V = step(U, V)
+    U.block_until_ready()
+    dt = time.time() - t0
+    iters_per_sec = args.iters / dt
+    log(f"{args.iters} iters in {dt:.2f}s -> {iters_per_sec:.3f} iters/sec")
+
+    result = {
+        "metric": "als_iters_per_sec_rank128_ml25m_implicit"
+                  + ("_small" if args.small else ""),
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/sec",
+        "vs_baseline": round(iters_per_sec / SPARK_8EXEC_ITERS_PER_SEC, 2),
+        "baseline_note": "baseline = assumed 60 s/iter for 8-executor Spark "
+                         "ALS on ML-25M rank=128 (reference publishes no "
+                         "numbers; Spark not runnable here — see BASELINE.md)",
+        "config": {
+            "users": nU, "items": nI, "ratings": nnz, "rank": args.rank,
+            "implicit": True, "alpha": 40.0,
+            "device": str(jax.devices()[0]),
+            "seconds_per_iter": round(dt / args.iters, 3),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

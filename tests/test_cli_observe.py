@@ -1,0 +1,67 @@
+"""CLI + observability tests (CPU mesh)."""
+
+import json
+
+import numpy as np
+
+from tpu_als.cli import main as cli_main
+from tpu_als.utils.observe import IterationLogger
+
+
+def test_cli_train_evaluate_recommend(tmp_path, capsys):
+    model_dir = str(tmp_path / "m")
+    cli_main(["train", "--data", "synthetic:200x80x4000", "--rank", "4",
+              "--max-iter", "4", "--reg-param", "0.05",
+              "--output", model_dir])
+    out = capsys.readouterr().out.strip().splitlines()
+    rmse = json.loads(out[-1])["holdout_rmse"]
+    assert 0 < rmse < 2.0
+
+    cli_main(["evaluate", "--model", model_dir,
+              "--data", "synthetic:200x80x4000"])
+    metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert set(metrics) == {"rmse", "mae", "r2"}
+
+    cli_main(["recommend", "--model", model_dir, "--limit", "2", "--k", "3"])
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2
+    assert len(lines[0]["items"]) == 3
+
+    # subset recommend for users known to be in the model
+    known = f'{lines[0]["user"]},{lines[1]["user"]}'
+    cli_main(["recommend", "--model", model_dir, "--users", known,
+              "--k", "3"])
+    lines2 = [json.loads(x) for x in
+              capsys.readouterr().out.strip().splitlines()]
+    assert len(lines2) == 2
+
+
+def test_cli_foldin_bench(tmp_path, capsys):
+    model_dir = str(tmp_path / "m")
+    cli_main(["train", "--data", "synthetic:100x50x2000", "--rank", "3",
+              "--max-iter", "2", "--output", model_dir])
+    capsys.readouterr()
+    cli_main(["foldin-bench", "--model", model_dir, "--batches", "3",
+              "--batch-size", "32"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "foldin_p50_latency"
+    assert np.isfinite(out["value"])
+
+
+def test_iteration_logger(tmp_path, rng):
+    from tpu_als.core.als import AlsConfig, train
+    from tpu_als.core.ratings import build_csr_buckets
+    from conftest import make_ratings
+
+    u, i, r, _, _ = make_ratings(rng, 30, 20, rank=2, density=0.5)
+    log_path = str(tmp_path / "train.jsonl")
+    logger = IterationLogger(probe=(u, i, r), stream=None, path=log_path)
+    cfg = AlsConfig(rank=2, max_iter=3, seed=0)
+    train(build_csr_buckets(u, i, r, 30), build_csr_buckets(i, u, r, 20),
+          cfg, callback=logger)
+    logger.close()
+    recs = [json.loads(x) for x in open(log_path)]
+    assert len(recs) == 3
+    assert recs[-1]["probe_rmse"] < recs[0]["probe_rmse"]
+    assert all("seconds" in x for x in recs)

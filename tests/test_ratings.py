@@ -96,16 +96,28 @@ def test_large_bucket_chunking_pads_instead_of_collapsing(rng):
     # odd row count larger than the scan chunk: the builder must pad rows up
     # to a chunk multiple, not shrink the chunk (a gcd fallback to 1 would
     # serialize the hot loop)
-    from tpu_als.core.ratings import scan_chunk, scan_chunk_for_padded
+    from tpu_als.core.ratings import scan_chunk, trainer_chunk
 
     nnz_rows = 101  # odd
     row = np.repeat(np.arange(nnz_rows), 3)
     col = rng.integers(0, 10, len(row))
     val = np.ones(len(row), dtype=np.float32)
     csr = build_csr_buckets(row, col, val, nnz_rows, min_width=4,
-                            chunk_elems=4 * 10)  # chunk = 10 rows
+                            chunk_elems=4 * 10)  # chunk cap: 10 -> pow2 8
     b = csr.buckets[0]
     chunk = scan_chunk(b.rows.shape[0], b.width, csr.chunk_elems)
-    assert chunk == 10
-    assert b.rows.shape[0] == 110  # padded to a chunk multiple
-    assert scan_chunk_for_padded(b.rows.shape[0], b.width, csr.chunk_elems) == 10
+    assert chunk == 8
+    assert b.rows.shape[0] == 104  # padded to a chunk multiple, not 1-chunks
+    assert trainer_chunk(b.rows.shape[0], b.width, 4, csr.chunk_elems) == 8
+
+
+def test_trainer_chunk_caps_rank_dominated_memory():
+    from tpu_als.core.ratings import trainer_chunk
+
+    # w=8, rank=128: builder chunk is 65536 rows, but A is chunk*r*r —
+    # the trainer must halve until chunk*r*max(w,r) fits the budget
+    c = trainer_chunk(131072, 8, 128, 1 << 19, mem_elems=1 << 28)
+    assert c * 128 * 128 <= 1 << 28
+    assert c >= 1 and 131072 % c == 0
+    # rank smaller than width: gathered factors dominate, chunk unchanged
+    assert trainer_chunk(1024, 512, 16, 1 << 19) == 1024

@@ -1,0 +1,149 @@
+"""Streaming fold-in driver + MovieLens IO tests."""
+
+import numpy as np
+
+from tpu_als import ALS, ColumnarFrame
+from tpu_als.io.movielens import (
+    load_movielens_100k,
+    load_movielens_csv,
+    synthetic_movielens,
+)
+from tpu_als.stream.microbatch import FoldInServer
+
+from conftest import make_ratings
+
+
+def _fitted(rng):
+    u, i, r, _, _ = make_ratings(rng, 50, 40, rank=3, density=0.4)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    return ALS(rank=3, maxIter=6, regParam=0.05, seed=0).fit(frame), frame
+
+
+def test_foldin_server_improves_new_user(rng):
+    model, frame = _fitted(rng)
+    V = model._V
+    # a brand-new user whose tastes follow item-factor direction 0
+    pref = V[:, 0]
+    top_items = np.argsort(-pref)[:8]
+    item_ids = model._item_map.to_original(top_items)
+    batch = ColumnarFrame({
+        "user": np.full(8, 777_777),
+        "item": item_ids,
+        "rating": np.full(8, 5.0, dtype=np.float32),
+    })
+    srv = FoldInServer(model)
+    touched = srv.update(batch)
+    assert touched.tolist() == [777_777]
+    # the new user now exists and predicts high on their liked items
+    preds = model.transform(batch)["prediction"]
+    assert np.isfinite(preds).all()
+    other_items = model._item_map.to_original(np.argsort(pref)[:8])
+    low = model.transform(ColumnarFrame({
+        "user": np.full(8, 777_777), "item": other_items,
+        "rating": np.zeros(8, dtype=np.float32)}))["prediction"]
+    assert preds.mean() > low.mean()
+
+
+def test_foldin_server_existing_user_history_merge(rng):
+    model, frame = _fitted(rng)
+    uid = int(model._user_map.ids[0])
+    before = model._U[0].copy()
+    batch = ColumnarFrame({
+        "user": np.array([uid]),
+        "item": np.array([int(model._item_map.ids[0])]),
+        "rating": np.array([5.0], dtype=np.float32),
+    })
+    srv = FoldInServer(model)
+    srv.update(batch)
+    after = model._U[model._user_map.to_dense(np.array([uid]))[0]]
+    assert not np.allclose(before, after)
+    assert len(srv.stats) == 1
+    assert np.isfinite(srv.p50_latency())
+
+
+def test_foldin_server_unknown_items_ignored(rng):
+    model, _ = _fitted(rng)
+    srv = FoldInServer(model)
+    batch = ColumnarFrame({
+        "user": np.array([1, 2]),
+        "item": np.array([10**9, 10**9 + 1]),  # never trained
+        "rating": np.array([5.0, 5.0], dtype=np.float32),
+    })
+    touched = srv.update(batch)
+    assert len(touched) == 0
+
+
+def test_synthetic_movielens_shape_and_determinism():
+    f1 = synthetic_movielens(200, 100, 5000, seed=3)
+    f2 = synthetic_movielens(200, 100, 5000, seed=3)
+    assert len(f1) == 5000
+    np.testing.assert_array_equal(f1["user"], f2["user"])
+    np.testing.assert_array_equal(f1["rating"], f2["rating"])
+    assert f1["rating"].min() >= 0.5 and f1["rating"].max() <= 5.0
+    # half-star grid
+    assert np.all((f1["rating"] * 2) == np.round(f1["rating"] * 2))
+    assert f1["user"].max() < 200 and f1["item"].max() < 100
+
+
+def test_movielens_loaders(tmp_path):
+    # u.data format
+    udata = tmp_path / "u.data"
+    udata.write_text("1\t10\t5\t100\n2\t20\t3\t200\n")
+    f = load_movielens_100k(str(tmp_path))
+    assert f["user"].tolist() == [1, 2]
+    assert f["rating"].tolist() == [5.0, 3.0]
+    # ratings.csv format
+    csv = tmp_path / "ratings.csv"
+    csv.write_text("userId,movieId,rating,timestamp\n1,10,4.5,99\n3,11,2.0,98\n")
+    f2 = load_movielens_csv(str(csv))
+    assert f2["user"].tolist() == [1, 3]
+    assert f2["rating"].tolist() == [4.5, 2.0]
+    # trainable end-to-end
+    model = ALS(rank=2, maxIter=2).fit(f)
+    assert model.rank == 2
+
+
+def test_fastcsv_native_parser(tmp_path):
+    import time
+
+    from tpu_als.io.fastcsv import load_ratings_csv, load_u_data
+
+    rng = np.random.default_rng(0)
+    n = 200_000
+    u = rng.integers(1, 10000, n)
+    i = rng.integers(1, 5000, n)
+    r = np.round(rng.uniform(0.5, 5.0, n) * 2) / 2
+    t = rng.integers(10**9, 2 * 10**9, n)
+    csv = tmp_path / "ratings.csv"
+    with open(csv, "w") as f:
+        f.write("userId,movieId,rating,timestamp\n")
+        for k in range(n):
+            f.write(f"{u[k]},{i[k]},{r[k]},{t[k]}\n")
+
+    t0 = time.perf_counter()
+    pu, pi, pr, pt = load_ratings_csv(str(csv))
+    dt = time.perf_counter() - t0
+    np.testing.assert_array_equal(pu, u)
+    np.testing.assert_array_equal(pi, i)
+    np.testing.assert_allclose(pr, r.astype(np.float32), rtol=1e-6)
+    np.testing.assert_array_equal(pt, t)
+    assert dt < 5.0  # 200k rows well under 5s
+
+    tsv = tmp_path / "u.data"
+    with open(tsv, "w") as f:
+        for k in range(100):
+            f.write(f"{u[k]}\t{i[k]}\t{int(r[k])}\t{t[k]}\n")
+    pu2, _, pr2, _ = load_u_data(str(tsv))
+    assert len(pu2) == 100
+    np.testing.assert_array_equal(pu2, u[:100])
+
+
+def test_fastcsv_no_trailing_newline(tmp_path):
+    from tpu_als.io.fastcsv import load_ratings_csv
+
+    csv = tmp_path / "r.csv"
+    csv.write_text("userId,movieId,rating,timestamp\n1,2,3.5,100\n7,8,1.0,200")
+    pu, pi, pr, pt = load_ratings_csv(str(csv))
+    assert pu.tolist() == [1, 7]
+    assert pr.tolist() == [3.5, 1.0]
+    assert pt.tolist() == [100, 200]

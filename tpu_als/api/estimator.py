@@ -110,13 +110,17 @@ class ALS(_ALSParams):
     Runtime-only (non-Param) knobs: ``mesh`` — a ``jax.sharding.Mesh`` to
     train sharded over devices (None = single device; ``numUserBlocks`` /
     ``numItemBlocks`` are then API-parity hints only); ``checkpointDir`` —
-    where ``checkpointInterval`` writes resumable factor snapshots.
+    where ``checkpointInterval`` writes resumable factor snapshots;
+    ``fitCallback(iteration, U, V)`` — per-iteration observer (e.g.
+    tpu_als.utils.observe.IterationLogger).
     """
 
-    def __init__(self, *, mesh=None, checkpointDir=None, **kwargs):
+    def __init__(self, *, mesh=None, checkpointDir=None, fitCallback=None,
+                 **kwargs):
         super().__init__()
         self.mesh = mesh
         self.checkpointDir = checkpointDir
+        self.fitCallback = fitCallback
         self.setParams(**kwargs)
 
     def setParams(self, **kwargs):
@@ -206,12 +210,15 @@ class ALS(_ALSParams):
 
     def _checkpoint_callback(self, user_map, item_map):
         interval = self.getCheckpointInterval()
-        if self.checkpointDir is None or interval < 1:
+        ckpt = self.checkpointDir is not None and interval >= 1
+        if not ckpt and self.fitCallback is None:
             return None
         import os
 
         def cb(iteration, U, V):
-            if iteration % interval == 0:
+            if self.fitCallback is not None:
+                self.fitCallback(iteration, U, V)
+            if ckpt and iteration % interval == 0:
                 save_factors(
                     os.path.join(self.checkpointDir, "als_checkpoint"),
                     user_map.ids, np.asarray(U), item_map.ids, np.asarray(V),

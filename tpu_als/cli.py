@@ -1,0 +1,180 @@
+"""Command-line entry points: train / evaluate / recommend / foldin-bench.
+
+The reference app layer is a runnable script (SURVEY.md §2.A); this CLI is
+that surface for the TPU framework:
+
+    python -m tpu_als.cli train --data ml-100k:/path/u.data --rank 16 \\
+        --max-iter 10 --output /tmp/model
+    python -m tpu_als.cli train --data synthetic:10000x2000x500000 ...
+    python -m tpu_als.cli evaluate --model /tmp/model --data ...
+    python -m tpu_als.cli recommend --model /tmp/model --users 1,2,3 --k 10
+    python -m tpu_als.cli foldin-bench --model /tmp/model
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _load_data(spec):
+    from tpu_als.io.movielens import (
+        load_movielens_100k,
+        load_movielens_csv,
+        synthetic_movielens,
+    )
+
+    kind, _, arg = spec.partition(":")
+    if kind == "ml-100k":
+        return load_movielens_100k(arg)
+    if kind == "csv":
+        return load_movielens_csv(arg)
+    if kind == "synthetic":
+        nu, ni, nnz = (int(x) for x in arg.split("x"))
+        return synthetic_movielens(nu, ni, nnz)
+    raise SystemExit(f"unknown data spec {spec!r} "
+                     "(use ml-100k:PATH | csv:PATH | synthetic:UxIxN)")
+
+
+def cmd_train(args):
+    from tpu_als import ALS, RegressionEvaluator
+    from tpu_als.utils.observe import IterationLogger
+
+    frame = _load_data(args.data)
+    train, test = frame.randomSplit([1 - args.holdout, args.holdout],
+                                    seed=args.seed)
+    logger = IterationLogger(path=args.log_file) if args.log_file else None
+    als = ALS(rank=args.rank, maxIter=args.max_iter, regParam=args.reg_param,
+              implicitPrefs=args.implicit, alpha=args.alpha,
+              nonnegative=args.nonnegative, seed=args.seed,
+              coldStartStrategy="drop", fitCallback=logger)
+    print(f"training on {len(train):,} ratings "
+          f"({len(test):,} held out)", file=sys.stderr)
+    model = als.fit(train)
+    if len(test):
+        rmse = RegressionEvaluator(labelCol="rating").evaluate(
+            model.transform(test))
+        print(json.dumps({"holdout_rmse": round(rmse, 4)}))
+    if args.output:
+        model.save(args.output)
+        print(f"model saved to {args.output}", file=sys.stderr)
+    return model
+
+
+def cmd_evaluate(args):
+    from tpu_als import ALSModel, RegressionEvaluator
+
+    model = ALSModel.load(args.model)
+    frame = _load_data(args.data)
+    out = model.transform(frame)
+    result = {}
+    for metric in ("rmse", "mae", "r2"):
+        ev = RegressionEvaluator(labelCol="rating", metricName=metric)
+        result[metric] = round(ev.evaluate(out), 4)
+    print(json.dumps(result))
+
+
+def cmd_recommend(args):
+    from tpu_als import ALSModel
+    from tpu_als.utils.frame import ColumnarFrame
+
+    model = ALSModel.load(args.model)
+    if args.users:
+        ids = np.array([int(x) for x in args.users.split(",")])
+        recs = model.recommendForUserSubset(
+            ColumnarFrame({model._params["userCol"]: ids}), args.k)
+    else:
+        recs = model.recommendForAllUsers(args.k)
+    key = recs.columns[0]
+    limit = args.limit if args.limit > 0 else len(recs)
+    for row in range(min(limit, len(recs))):
+        print(json.dumps({
+            "user": int(recs[key][row]),
+            "items": [[int(i), round(float(s), 4)]
+                      for i, s in recs["recommendations"][row]],
+        }))
+
+
+def cmd_foldin_bench(args):
+    import time
+
+    from tpu_als import ALSModel
+    from tpu_als.stream.microbatch import FoldInServer
+    from tpu_als.utils.frame import ColumnarFrame
+
+    model = ALSModel.load(args.model)
+    srv = FoldInServer(model)
+    rng = np.random.default_rng(0)
+    item_ids = model._item_map.ids
+    p = model._params
+    base_user = int(model._user_map.ids.max()) + 1
+    for b in range(args.batches):
+        n = args.batch_size
+        batch = ColumnarFrame({
+            p["userCol"]: rng.integers(base_user, base_user + 1000, n),
+            p["itemCol"]: rng.choice(item_ids, n),
+            p["ratingCol"]: rng.uniform(0.5, 5.0, n).astype(np.float32),
+        })
+        t0 = time.perf_counter()
+        srv.update(batch)
+        if b == 0:
+            print(f"warmup batch: {time.perf_counter()-t0:.3f}s",
+                  file=sys.stderr)
+    lat = sorted(s[2] for s in srv.stats[1:]) or [float("nan")]
+    print(json.dumps({
+        "metric": "foldin_p50_latency",
+        "value": round(lat[len(lat) // 2], 4),
+        "unit": "seconds",
+        "batches": args.batches,
+        "batch_size": args.batch_size,
+    }))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="tpu_als")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="fit an ALS model")
+    t.add_argument("--data", required=True)
+    t.add_argument("--rank", type=int, default=10)
+    t.add_argument("--max-iter", type=int, default=10)
+    t.add_argument("--reg-param", type=float, default=0.1)
+    t.add_argument("--implicit", action="store_true")
+    t.add_argument("--alpha", type=float, default=1.0)
+    t.add_argument("--nonnegative", action="store_true")
+    t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--holdout", type=float, default=0.2)
+    t.add_argument("--output", default=None)
+    t.add_argument("--log-file", default=None,
+                   help="write per-iteration JSON log lines here")
+    t.set_defaults(fn=cmd_train)
+
+    e = sub.add_parser("evaluate", help="score a dataset with a saved model")
+    e.add_argument("--model", required=True)
+    e.add_argument("--data", required=True)
+    e.set_defaults(fn=cmd_evaluate)
+
+    r = sub.add_parser("recommend", help="top-k recommendations")
+    r.add_argument("--model", required=True)
+    r.add_argument("--users", default=None,
+                   help="comma-separated original user ids (default: all)")
+    r.add_argument("--k", type=int, default=10)
+    r.add_argument("--limit", type=int, default=20,
+                   help="max users to print (0 = all)")
+    r.set_defaults(fn=cmd_recommend)
+
+    f = sub.add_parser("foldin-bench", help="fold-in latency micro-benchmark")
+    f.add_argument("--model", required=True)
+    f.add_argument("--batches", type=int, default=20)
+    f.add_argument("--batch-size", type=int, default=512)
+    f.set_defaults(fn=cmd_foldin_bench)
+
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

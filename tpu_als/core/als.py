@@ -20,7 +20,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-from tpu_als.core.ratings import scan_chunk_for_padded
+from tpu_als.core.ratings import trainer_chunk
 
 from tpu_als.ops.solve import (
     compute_yty,
@@ -71,7 +71,7 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
 
     for b in buckets:
         nb, w = b.cols.shape
-        chunk = scan_chunk_for_padded(nb, w, chunk_elems)
+        chunk = trainer_chunk(nb, w, r, chunk_elems)
         nchunks = nb // chunk
         cols = b.cols.reshape(nchunks, chunk, w)
         vals = b.vals.reshape(nchunks, chunk, w)
@@ -79,21 +79,24 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
 
         def solve_chunk(args):
             c, v, m = args
-            Vg = V_full[c].astype(cdt)
-            if cfg.implicit_prefs:
-                A, rhs, count = normal_eq_implicit(
-                    Vg, v.astype(cdt), m.astype(cdt), cfg.reg_param, cfg.alpha,
-                    YtY.astype(jnp.float32),
-                )
-            else:
-                A, rhs, count = normal_eq_explicit(
-                    Vg, v.astype(cdt), m.astype(cdt), cfg.reg_param
-                )
+            with jax.named_scope("gather_factors"):
+                Vg = V_full[c].astype(cdt)
+            with jax.named_scope("normal_eq"):
+                if cfg.implicit_prefs:
+                    A, rhs, count = normal_eq_implicit(
+                        Vg, v.astype(cdt), m.astype(cdt), cfg.reg_param,
+                        cfg.alpha, YtY.astype(jnp.float32),
+                    )
+                else:
+                    A, rhs, count = normal_eq_explicit(
+                        Vg, v.astype(cdt), m.astype(cdt), cfg.reg_param
+                    )
             A = A.astype(jnp.float32)
             rhs = rhs.astype(jnp.float32)
-            if cfg.nonnegative:
-                return solve_nnls(A, rhs, count, sweeps=cfg.nnls_sweeps)
-            return solve_spd(A, rhs, count)
+            with jax.named_scope("solve"):
+                if cfg.nonnegative:
+                    return solve_nnls(A, rhs, count, sweeps=cfg.nnls_sweeps)
+                return solve_spd(A, rhs, count)
 
         if nchunks == 1:
             x = solve_chunk((cols[0], vals[0], mask[0]))

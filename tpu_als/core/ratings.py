@@ -47,7 +47,9 @@ class Bucket(NamedTuple):
 
     @property
     def width(self):
-        return self.cols.shape[1]
+        # last axis so the property also holds for stacked [..., nb, w]
+        # bucket arrays (tpu_als.parallel.data / .comm)
+        return self.cols.shape[-1]
 
 
 @dataclass
@@ -115,28 +117,32 @@ def _next_pow2(x):
 
 
 def scan_chunk(nb, width, chunk_elems):
-    """Rows per scan step for a bucket of ``nb`` rows of ``width``.
-
-    The single source of truth shared by the bucket builders (which pad row
-    counts up to a multiple of this) and the trainer (which reshapes by it).
-    Never exceeds ``nb`` so small buckets aren't padded up to a full chunk.
-    May not divide ``nb`` — builders pad rows up; the trainer uses
-    :func:`scan_chunk_for_padded` on the already-padded count.
+    """Builder-side rows-per-scan-step for a bucket of ``nb`` rows of
+    ``width``.  Always a power of two, so the trainer can halve it freely
+    (any smaller power of two still divides the padded row count) when the
+    rank makes the per-row normal-equation tensor, not the gathered factors,
+    the dominant intermediate.  Builders pad row counts up to a multiple.
     """
-    return max(1, min(chunk_elems // width, nb))
+    cap = max(1, chunk_elems // width)
+    cap = 1 << (cap.bit_length() - 1)  # floor to power of two
+    full = 1 << max(0, nb - 1).bit_length()  # ceil to power of two
+    return max(1, min(cap, full))
 
 
-def scan_chunk_for_padded(nb_padded, width, chunk_elems):
-    """Chunk for a bucket whose row count was already padded by a builder.
+def trainer_chunk(nb_padded, width, rank, chunk_elems, mem_elems=1 << 28):
+    """Trainer-side chunk: the builder chunk, halved until the largest
+    per-chunk intermediate — max(Vg [chunk,w,r], A [chunk,r,r]) — fits in
+    ``mem_elems`` elements (default 2^28 f32 elems = 1 GiB).
 
-    Equals :func:`scan_chunk` when trainer and builder agree on
-    ``chunk_elems``; the gcd fallback only defends against a mismatched
-    value (degrading throughput, never correctness).
+    The gcd fallback only defends against buckets built with a different
+    ``chunk_elems`` (degrades throughput, never correctness).
     """
-    chunk = scan_chunk(nb_padded, width, chunk_elems)
-    if nb_padded % chunk:
-        chunk = math.gcd(nb_padded, chunk)
-    return chunk
+    c = scan_chunk(nb_padded, width, chunk_elems)
+    while c > 1 and c * rank * max(width, rank) > mem_elems:
+        c //= 2
+    if nb_padded % c:
+        c = math.gcd(nb_padded, c)
+    return c
 
 
 def build_csr_buckets(
@@ -154,9 +160,11 @@ def build_csr_buckets(
     as duplicate ratings fed to the reference stack's blocking).
 
     Rows per bucket are padded to a multiple of the bucket's scan chunk
-    (``max(1, chunk_elems // width)``) so the trainer can reshape to
-    [nchunks, chunk, w] without tracing-time pads; padding rows carry
-    ``rows == num_rows`` (out-of-bounds ⇒ scatter-dropped).
+    (:func:`scan_chunk` — a power of two bounded by ``chunk_elems // width``
+    and by the bucket's row count) so the trainer can reshape to
+    [nchunks, chunk, w] without tracing-time pads, halving the chunk if the
+    rank demands it; padding rows carry ``rows == num_rows`` (out-of-bounds
+    ⇒ scatter-dropped).
     """
     row_idx = np.asarray(row_idx, dtype=np.int64)
     col_idx = np.asarray(col_idx, dtype=np.int64)

@@ -1,0 +1,89 @@
+"""ctypes binding for the native fastcsv parser (lazy-built with g++).
+
+pybind11 is not available in this image, so the Python↔C++ boundary is
+ctypes over a tiny ``extern "C"`` surface; arrays are preallocated numpy
+buffers written in place by the library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SRC = os.path.join(_NATIVE_DIR, "fastcsv.cc")
+_LIB = os.path.join(_NATIVE_DIR, "libfastcsv.so")
+
+_lib = None
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", _LIB]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if (not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        _build()
+    lib = ctypes.CDLL(_LIB)
+    lib.fastcsv_count.restype = ctypes.c_int64
+    lib.fastcsv_count.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.c_int]
+    lib.fastcsv_parse.restype = ctypes.c_int64
+    lib.fastcsv_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+    ]
+    _lib = lib
+    return lib
+
+
+def load_ratings_csv(path, delim=",", skip_header=1, n_threads=None):
+    """Parse a ratings file into (users, items, ratings, timestamps)."""
+    lib = _load()
+    if n_threads is None:
+        n_threads = min(16, os.cpu_count() or 1)
+    if os.path.getsize(path) == 0:
+        return (np.empty(0, np.int64), np.empty(0, np.int64),
+                np.empty(0, np.float32), np.empty(0, np.int64))
+    with open(path, "rb") as f:
+        # ACCESS_COPY: buffer-protocol-writable (ctypes.from_buffer needs
+        # that) but copy-on-write — we never write, so reads are zero-copy
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
+        try:
+            length = len(mm)
+            buf = (ctypes.c_char * length).from_buffer(mm)
+            n = lib.fastcsv_count(buf, length, skip_header)
+            users = np.empty(n, dtype=np.int64)
+            items = np.empty(n, dtype=np.int64)
+            ratings = np.empty(n, dtype=np.float32)
+            ts = np.empty(n, dtype=np.int64)
+            wrote = lib.fastcsv_parse(
+                buf, length, delim.encode()[0], skip_header, n_threads,
+                users.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                items.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                ratings.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            )
+        finally:
+            del buf  # release the exported buffer before closing the mmap
+            mm.close()
+    if wrote != n:
+        raise IOError(f"fastcsv parsed {wrote} rows, expected {n} ({path})")
+    return users, items, ratings, ts
+
+
+def load_u_data(path, n_threads=None):
+    """ml-100k ``u.data`` (tab-separated, no header)."""
+    return load_ratings_csv(path, delim="\t", skip_header=0,
+                            n_threads=n_threads)

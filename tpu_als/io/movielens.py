@@ -1,0 +1,89 @@
+"""MovieLens loaders + a scale-faithful synthetic generator.
+
+Covers the reference app's data-ingest step (SURVEY.md §2.A1): ml-100k
+``u.data`` (tab-separated user/item/rating/ts) and ml-latest/ml-25m
+``ratings.csv`` (header ``userId,movieId,rating,timestamp``).  Since this
+environment has no network, :func:`synthetic_movielens` generates
+MovieLens-shaped data (power-law user/item degrees, 0.5–5.0 star ratings on
+a planted low-rank structure) at any scale — it is what the benchmarks use,
+with the real loaders available for when datasets are present on disk.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from tpu_als.utils.frame import ColumnarFrame
+
+# MovieLens-25M's published shape (users, items, ratings) — used by the
+# benchmark harness to synthesize at the exact config-2 scale.
+ML25M_SHAPE = (162_541, 59_047, 25_000_095)
+ML100K_SHAPE = (943, 1_682, 100_000)
+
+
+def load_movielens_100k(path):
+    """Read ml-100k ``u.data`` (or a directory containing it)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "u.data")
+    raw = np.loadtxt(path, dtype=np.int64, delimiter="\t")
+    return ColumnarFrame({
+        "user": raw[:, 0],
+        "item": raw[:, 1],
+        "rating": raw[:, 2].astype(np.float32),
+        "timestamp": raw[:, 3],
+    })
+
+
+def load_movielens_csv(path):
+    """Read a ``ratings.csv`` (ml-latest / ml-25m style, with header)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "ratings.csv")
+    try:
+        from tpu_als.io.fastcsv import load_ratings_csv
+
+        u, i, r, t = load_ratings_csv(path)
+    except (ImportError, OSError):
+        raw = np.genfromtxt(path, delimiter=",", skip_header=1,
+                            dtype=np.float64)
+        u = raw[:, 0].astype(np.int64)
+        i = raw[:, 1].astype(np.int64)
+        r = raw[:, 2].astype(np.float32)
+        t = raw[:, 3].astype(np.int64)
+    return ColumnarFrame({"user": u, "item": i, "rating": r, "timestamp": t})
+
+
+def synthetic_movielens(num_users, num_items, num_ratings, seed=0,
+                        rank=16, noise=0.3, user_power=0.9, item_power=1.1):
+    """MovieLens-shaped synthetic ratings.
+
+    Degrees follow truncated zipf-like power laws (users shallower than
+    items, as in the real datasets); ratings are a planted rank-``rank``
+    structure mapped to the 0.5..5.0 half-star grid.  Deterministic per seed.
+    """
+    rng = np.random.default_rng(seed)
+
+    def power_law_ids(n_entities, n_draws, a):
+        w = (np.arange(1, n_entities + 1, dtype=np.float64)) ** (-a)
+        w /= w.sum()
+        ids = rng.choice(n_entities, size=n_draws, p=w)
+        # random relabeling so popularity isn't correlated with id order
+        perm = rng.permutation(n_entities)
+        return perm[ids]
+
+    u = power_law_ids(num_users, num_ratings, user_power)
+    i = power_law_ids(num_items, num_ratings, item_power)
+    Ustar = rng.normal(0, 1.0, (num_users, rank)).astype(np.float32)
+    Vstar = rng.normal(0, 1.0 / np.sqrt(rank), (num_items, rank)).astype(np.float32)
+    raw = np.einsum("nr,nr->n", Ustar[u], Vstar[i])
+    raw = raw + noise * rng.normal(size=num_ratings).astype(np.float32)
+    # squash to the 0.5..5.0 half-star grid with a MovieLens-like mean
+    stars = np.clip(np.round((3.5 + 1.1 * raw) * 2) / 2, 0.5, 5.0)
+    return ColumnarFrame({
+        "user": u.astype(np.int64),
+        "item": i.astype(np.int64),
+        "rating": stars.astype(np.float32),
+        "timestamp": rng.integers(1_000_000_000, 1_600_000_000,
+                                  num_ratings),
+    })

@@ -1,0 +1,184 @@
+"""Gather strategies: full ``all_gather`` vs ring ``ppermute`` streaming.
+
+The reference stack moves factor messages with a sort-based shuffle over
+netty TCP (SURVEY.md §2.C2).  The TPU-native replacements (§5.7/§5.8):
+
+- **all_gather** (tpu_als.parallel.trainer): each half-step gathers the full
+  opposite factor matrix over ICI.  Simplest and fastest while
+  ``N_opposite × rank`` fits per-device HBM.
+- **ring** (this module): the opposite factors are never materialized in
+  full.  Each device keeps only its own factor shard; shards rotate around
+  the mesh with ``ppermute`` while per-row normal-equation accumulators stay
+  stationary — the same dataflow as ring attention (stationary queries =
+  the accumulators, streaming keys/values = the factor shards).  Total
+  bytes moved equal one all_gather, but peak HBM drops from
+  ``N_opposite × rank`` to ``N_opposite/D × rank``.
+
+Data layout for the ring: ratings are blocked on a 2-D (owner device ×
+source shard) grid — the TPU analog of Spark's ``numUserBlocks ×
+numItemBlocks`` rating grid — with column ids local to the source shard, so
+each ring step's gather indexes only the currently-held shard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_als.core.ratings import Bucket, build_csr_buckets, trainer_chunk
+from tpu_als.ops.solve import solve_nnls, solve_spd
+from tpu_als.parallel.data import stack_shards
+from tpu_als.parallel.mesh import AXIS
+
+
+@dataclass
+class RingCsr:
+    """[D, S, ...] bucketed grid for one side (uniform shapes over both the
+    device axis D and the source-shard axis S)."""
+
+    buckets: list  # list[Bucket]; arrays are [D, S, nb, w]
+    rows_per_shard: int
+    chunk_elems: int
+    nnz: int
+
+    def device_buckets(self):
+        return list(self.buckets)
+
+
+def shard_csr_grid(row_part, col_part, row_idx, col_idx, vals,
+                   min_width=8, chunk_elems=1 << 19):
+    """Build the (owner device × source shard) grid with shard-local cols."""
+    D = row_part.n_shards
+    S = col_part.n_shards
+    owner = row_part.owner[row_idx]
+    local_rows = row_part.local[row_idx]
+    src = col_part.owner[col_idx]
+    local_cols = col_part.local[col_idx]
+
+    vals = np.asarray(vals)
+    # per (d, s): a CsrBuckets; then unify across d for each s, then across s
+    per_s = []
+    for s in range(S):
+        shards = []
+        for d in range(D):
+            sel = (owner == d) & (src == s)
+            shards.append(build_csr_buckets(
+                local_rows[sel], local_cols[sel], vals[sel],
+                num_rows=row_part.rows_per_shard,
+                min_width=min_width, chunk_elems=chunk_elems,
+            ))
+        per_s.append(stack_shards(shards, chunk_elems))  # [D, nb_s, w]
+
+    # unify bucket shapes across the S axis so a traced shard index can
+    # dynamic-slice into a single stacked array
+    widths = sorted({b.width for sh in per_s for b in sh.buckets})
+    stacked = []
+    num_rows = row_part.rows_per_shard
+    for w in widths:
+        per = [next((b for b in sh.buckets if b.width == w), None)
+               for sh in per_s]
+        nb_max = max(b.rows.shape[1] for b in per if b is not None)
+        rows = np.full((D, S, nb_max), num_rows, dtype=np.int32)
+        cols = np.zeros((D, S, nb_max, w), dtype=np.int32)
+        v = np.zeros((D, S, nb_max, w), dtype=np.float32)
+        m = np.zeros((D, S, nb_max, w), dtype=np.float32)
+        for s, b in enumerate(per):
+            if b is None:
+                continue
+            nb = b.rows.shape[1]
+            rows[:, s, :nb] = b.rows
+            cols[:, s, :nb] = b.cols
+            v[:, s, :nb] = b.vals
+            m[:, s, :nb] = b.mask
+        stacked.append(Bucket(rows=rows, cols=cols, vals=v, mask=m))
+    return RingCsr(buckets=stacked, rows_per_shard=num_rows,
+                   chunk_elems=chunk_elems, nnz=len(row_idx))
+
+
+def _accumulate_shard(V_shard, buckets, shard_sel, num_rows, cfg, chunk_elems,
+                      A_acc, b_acc):
+    """Add one source shard's normal-equation contributions.
+
+    ``buckets`` arrays are [S, nb, w]; ``shard_sel`` is the traced source
+    shard index currently held by this device.  Raw sums only — the λ·n·I
+    ridge (and implicit YᵀY) are added once at solve time.
+    """
+    r = V_shard.shape[-1]
+    cdt = jnp.dtype(cfg.compute_dtype)
+    for b in buckets:
+        _, nb, w = b.cols.shape
+        rows = jax.lax.dynamic_index_in_dim(b.rows, shard_sel, 0, False)
+        cols = jax.lax.dynamic_index_in_dim(b.cols, shard_sel, 0, False)
+        vals = jax.lax.dynamic_index_in_dim(b.vals, shard_sel, 0, False)
+        mask = jax.lax.dynamic_index_in_dim(b.mask, shard_sel, 0, False)
+        chunk = trainer_chunk(nb, w, r, chunk_elems)
+        nchunks = nb // chunk
+
+        def contrib(args):
+            c, v, m = args
+            Vg = V_shard[c].astype(cdt)
+            if cfg.implicit_prefs:
+                conf_m1 = cfg.alpha * jnp.abs(v) * m
+                pref = (v > 0).astype(cdt)
+                A = jnp.einsum("nw,nwr,nws->nrs", conf_m1.astype(cdt), Vg, Vg,
+                               preferred_element_type=jnp.float32)
+                bb = jnp.einsum("nw,nwr->nr",
+                                ((1.0 + conf_m1) * pref * m).astype(cdt), Vg,
+                                preferred_element_type=jnp.float32)
+            else:
+                Vm = Vg * m[..., None].astype(cdt)
+                A = jnp.einsum("nwr,nws->nrs", Vm, Vm,
+                               preferred_element_type=jnp.float32)
+                bb = jnp.einsum("nw,nwr->nr", (v * m).astype(cdt), Vg,
+                                preferred_element_type=jnp.float32)
+            return A, bb
+
+        if nchunks == 1:
+            A, bb = contrib((cols, vals, mask))
+        else:
+            A, bb = jax.lax.map(
+                contrib,
+                (cols.reshape(nchunks, chunk, w),
+                 vals.reshape(nchunks, chunk, w),
+                 mask.reshape(nchunks, chunk, w)),
+            )
+            A = A.reshape(nb, r, r)
+            bb = bb.reshape(nb, r)
+        A_acc = A_acc.at[rows].add(A, mode="drop")
+        b_acc = b_acc.at[rows].add(bb, mode="drop")
+    return A_acc, b_acc
+
+
+def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
+                   chunk_elems, YtY=None):
+    """One half-step with streaming factor shards (inside ``shard_map``).
+
+    V_shard [per_opposite, r]: this device's shard of the opposite factors.
+    ring_buckets: [S, ...] bucket arrays (this device's slice of a RingCsr).
+    counts [num_rows]: per-row rating counts (for the λ·n ridge; for
+    implicit feedback, the positive-rating counts).
+    """
+    r = V_shard.shape[-1]
+    me = jax.lax.axis_index(AXIS)
+    A = jnp.zeros((num_rows, r, r), dtype=jnp.float32)
+    b = jnp.zeros((num_rows, r), dtype=jnp.float32)
+
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+    V_cur = V_shard
+    for t in range(n_shards):
+        src = (me - t) % n_shards  # shard currently held after t rotations
+        A, b = _accumulate_shard(V_cur, ring_buckets, src, num_rows, cfg,
+                                 chunk_elems, A, b)
+        if t + 1 < n_shards:
+            V_cur = jax.lax.ppermute(V_cur, AXIS, perm)
+
+    eye = jnp.eye(r, dtype=jnp.float32)
+    A = A + (cfg.reg_param * counts)[:, None, None] * eye
+    if cfg.implicit_prefs:
+        A = A + YtY[None]
+    if cfg.nonnegative:
+        return solve_nnls(A, b, counts, sweeps=cfg.nnls_sweeps)
+    return solve_spd(A, b, counts)

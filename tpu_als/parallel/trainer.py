@@ -84,10 +84,73 @@ def make_sharded_step(mesh, user_sharded, item_sharded, cfg: AlsConfig):
     return jax.jit(sharded, donate_argnums=(0, 1))
 
 
+def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig):
+    """Jitted full ALS iteration with the ring (``ppermute``) strategy:
+    factor shards stream around the mesh, normal-equation accumulators stay
+    stationary, the full opposite factor matrix is never materialized
+    (tpu_als.parallel.comm).  Signature: ``step(U, V, ub, ib, uc, ic)``.
+    """
+    from tpu_als.parallel.comm import ring_half_step
+
+    D = mesh.devices.size
+    if user_ring.buckets[0].rows.shape[0] != D:
+        raise ValueError(
+            f"mesh has {D} devices but the ring grid was built for "
+            f"{user_ring.buckets[0].rows.shape[0]}")
+    per_u = user_ring.rows_per_shard
+    per_i = item_ring.rows_per_shard
+    u_chunk = user_ring.chunk_elems
+    i_chunk = item_ring.chunk_elems
+
+    def step_body(U_loc, V_loc, ubuckets, ibuckets, ucounts, icounts):
+        ubuckets = _squeeze0(ubuckets)
+        ibuckets = _squeeze0(ibuckets)
+        ucounts = ucounts[0]
+        icounts = icounts[0]
+        YtY_u = (jax.lax.psum(compute_yty(U_loc), AXIS)
+                 if cfg.implicit_prefs else None)
+        V_new = ring_half_step(U_loc, ibuckets, icounts, per_i, D, cfg,
+                               i_chunk, YtY_u)
+        YtY_v = (jax.lax.psum(compute_yty(V_new), AXIS)
+                 if cfg.implicit_prefs else None)
+        U_new = ring_half_step(V_new, ubuckets, ucounts, per_u, D, cfg,
+                               u_chunk, YtY_v)
+        return U_new, V_new
+
+    sharded = shard_map(
+        step_body,
+        mesh=mesh,
+        in_specs=(P(AXIS),) * 6,
+        out_specs=(P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+def stacked_counts(part, row_idx, vals=None, positive_only=False):
+    """Per-row rating counts in [D, rows_per_shard] layout (for the ring
+    strategy's λ·n ridge; ``positive_only`` mirrors the implicit-feedback
+    ``numExplicits`` semantic)."""
+    import numpy as np
+
+    if positive_only and vals is None:
+        raise ValueError("vals is required when positive_only=True")
+    sel = (np.asarray(vals) > 0) if positive_only else slice(None)
+    rows = np.asarray(row_idx)[sel] if positive_only else np.asarray(row_idx)
+    out = np.zeros((part.n_shards, part.rows_per_shard), dtype=np.float32)
+    np.add.at(out, (part.owner[rows], part.local[rows]), 1.0)
+    return out
+
+
 def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
-                  cfg: AlsConfig, callback=None):
+                  cfg: AlsConfig, callback=None, strategy="all_gather",
+                  ring_counts=None):
     """Distributed ALS training loop.  Returns slot-space (U, V) jax.Arrays
     sharded over ``mesh``; index with ``Partition.slot`` to get entity rows.
+
+    strategy: 'all_gather' (full opposite-factor gather per half-step) or
+    'ring' (ppermute streaming; pass RingCsr containers and
+    ``ring_counts=(user_counts, item_counts)`` from :func:`stacked_counts`).
     """
     leading = NamedSharding(mesh, P(AXIS))
     ub = jax.device_put(user_sharded.device_buckets(), leading)
@@ -104,9 +167,23 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
         _slot_init(kv, item_part, cfg.rank), leading
     )
 
-    step = make_sharded_step(mesh, user_sharded, item_sharded, cfg)
+    if strategy not in ("all_gather", "ring"):
+        raise ValueError(f"unknown strategy {strategy!r} "
+                         "(expected 'all_gather' or 'ring')")
+    if strategy == "ring":
+        if ring_counts is None:
+            raise ValueError("strategy='ring' requires ring_counts="
+                             "(user_counts, item_counts) from stacked_counts")
+        uc, ic = ring_counts
+        uc = jax.device_put(uc, leading)
+        ic = jax.device_put(ic, leading)
+        step = make_ring_step(mesh, user_sharded, item_sharded, cfg)
+        args = (ub, ib, uc, ic)
+    else:
+        step = make_sharded_step(mesh, user_sharded, item_sharded, cfg)
+        args = (ub, ib)
     for it in range(cfg.max_iter):
-        U, V = step(U, V, ub, ib)
+        U, V = step(U, V, *args)
         if callback is not None:
             callback(it + 1, U, V)
     return U, V

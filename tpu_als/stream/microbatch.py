@@ -1,0 +1,120 @@
+"""Streaming micro-batch driver: serve fold-in updates without a refit.
+
+The capability the reference stack lacks (Spark MLlib requires a full refit
+for new ratings — SURVEY.md §3.5), promised by the north-star (BASELINE.json
+configs[3]: "hourly micro-batches of new ratings → incremental user-factor
+jit update").  The server wraps a fitted ALSModel; each ``update`` call:
+
+1. merges the batch with the per-user rating history it keeps (optional),
+2. pads touched-user rows/widths to powers of two so repeated batches hit
+   the jit cache (bounded compile count),
+3. runs the jitted fold-in kernel against the fixed item factors,
+4. writes the new rows into the model (appending brand-new users).
+
+Item factors stay fixed between refits — the standard fold-in contract.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from tpu_als.core.foldin import fold_in
+from tpu_als.core.ratings import IdMap, _next_pow2
+from tpu_als.ops.solve import compute_yty
+from tpu_als.utils.frame import as_frame
+
+
+class FoldInServer:
+    """Incremental user-factor updates against a fitted model."""
+
+    def __init__(self, model, keep_history=True):
+        self.model = model
+        self.keep_history = keep_history
+        self._history = {}  # original user id -> (item_dense[], rating[])
+        p = model._params
+        self._reg = float(p.get("regParam", 0.1))
+        self._implicit = bool(p.get("implicitPrefs", False))
+        self._alpha = float(p.get("alpha", 1.0))
+        self._nonnegative = bool(p.get("nonnegative", False))
+        self._V = jnp.asarray(model._V)
+        self._YtY = compute_yty(self._V) if self._implicit else None
+        self.stats = []  # (batch_size, touched_users, latency_seconds)
+
+    def update(self, batch):
+        """Process one micro-batch frame (userCol/itemCol/ratingCol of the
+        model).  Returns the original ids of the users whose factors moved.
+        """
+        t0 = time.perf_counter()
+        frame = as_frame(batch)
+        p = self.model._params
+        u_raw = np.asarray(frame[p["userCol"]])
+        i_raw = np.asarray(frame[p["itemCol"]])
+        r = np.asarray(frame[p["ratingCol"]], dtype=np.float32)
+
+        # items never seen in training cannot contribute (no factors); the
+        # reference would equally ignore them until a refit
+        i_dense = self.model._item_map.to_dense(i_raw)
+        known = i_dense >= 0
+        u_raw, i_dense, r = u_raw[known], i_dense[known], r[known]
+        if len(u_raw) == 0:
+            return np.array([], dtype=np.int64)
+
+        touched = np.unique(u_raw)
+        per_user = {u: ([], []) for u in touched}
+        for u, i, v in zip(u_raw, i_dense, r):
+            per_user[u][0].append(i)
+            per_user[u][1].append(v)
+        if self.keep_history:
+            for u in touched:
+                hist = self._history.get(u)
+                if hist is not None:
+                    per_user[u] = (hist[0] + per_user[u][0],
+                                   hist[1] + per_user[u][1])
+                self._history[u] = per_user[u]
+
+        # pad rows and width to powers of two -> bounded jit-cache entries
+        n = len(touched)
+        n_pad = _next_pow2(n)
+        w = _next_pow2(max(len(v[0]) for v in per_user.values()))
+        cols = np.zeros((n_pad, w), dtype=np.int32)
+        vals = np.zeros((n_pad, w), dtype=np.float32)
+        mask = np.zeros((n_pad, w), dtype=np.float32)
+        for row, u in enumerate(touched):
+            ii, vv = per_user[u]
+            cols[row, :len(ii)] = ii
+            vals[row, :len(ii)] = vv
+            mask[row, :len(ii)] = 1.0
+
+        x = np.asarray(fold_in(
+            self._V, jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(mask),
+            self._reg, implicit_prefs=self._implicit, alpha=self._alpha,
+            nonnegative=self._nonnegative, YtY=self._YtY,
+        ))[:n]
+
+        self._write_back(touched, x)
+        self.stats.append((len(u_raw), n, time.perf_counter() - t0))
+        return touched
+
+    def _write_back(self, touched_raw_ids, new_rows):
+        m = self.model
+        if not m._U.flags.writeable:  # np view of a jax array is read-only
+            m._U = m._U.copy()
+        dense = m._user_map.to_dense(touched_raw_ids)
+        new_mask = dense < 0
+        if new_mask.any():  # brand-new users: extend the map and the factors
+            new_ids = touched_raw_ids[new_mask]
+            m._user_map = IdMap(
+                ids=np.concatenate([m._user_map.ids, new_ids]))
+            m._U = np.concatenate(
+                [m._U, np.zeros((len(new_ids), m._U.shape[1]),
+                                dtype=m._U.dtype)])
+            dense = m._user_map.to_dense(touched_raw_ids)
+        m._U[dense] = new_rows
+
+    def p50_latency(self):
+        lat = sorted(s[2] for s in self.stats)
+        return lat[len(lat) // 2] if lat else float("nan")

@@ -1,0 +1,84 @@
+"""Observability: structured training logs + profiling hooks.
+
+The reference stack's observability is the Spark UI / SparkListener event
+bus / Codahale metrics sinks (SURVEY.md §5.1/§5.5).  The TPU-native
+equivalents here:
+
+- :class:`IterationLogger` — a ``callback`` for the training loops that
+  emits one structured JSON line per iteration (iteration, wall time,
+  probe RMSE, factor norms) to a file and/or stderr, the analog of
+  per-stage metrics.
+- :func:`trace` — context manager over ``jax.profiler.trace`` producing a
+  TensorBoard/Perfetto trace of the jitted steps (the analog of the Spark
+  UI's stage timeline).
+- ``jax.named_scope`` annotations are applied inside the half-step phases
+  so traces show gather/normal-eq/solve spans.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import time
+
+import numpy as np
+
+
+class IterationLogger:
+    """Per-iteration structured logging; usable as ``train(callback=...)``.
+
+    probe: optional (u_idx, i_idx, ratings) triple of dense indices — RMSE
+    on it is logged each iteration (the convergence signal the reference
+    app reads off its evaluator).
+    """
+
+    def __init__(self, probe=None, stream=sys.stderr, path=None, tag="als"):
+        self.probe = probe
+        self.stream = stream
+        self.path = path
+        self.tag = tag
+        self._t_last = time.perf_counter()
+        self._file = open(path, "a") if path else None
+        self.records = []
+
+    def __call__(self, iteration, U, V):
+        now = time.perf_counter()
+        rec = {
+            "tag": self.tag,
+            "iteration": int(iteration),
+            "seconds": round(now - self._t_last, 4),
+            "u_norm": float(np.linalg.norm(np.asarray(U)) /
+                            max(1, U.shape[0]) ** 0.5),
+            "v_norm": float(np.linalg.norm(np.asarray(V)) /
+                            max(1, V.shape[0]) ** 0.5),
+        }
+        self._t_last = now
+        if self.probe is not None:
+            u, i, r = self.probe
+            pred = np.einsum("nr,nr->n", np.asarray(U)[u], np.asarray(V)[i])
+            rec["probe_rmse"] = float(np.sqrt(np.mean((pred - r) ** 2)))
+        self.records.append(rec)
+        line = json.dumps(rec)
+        if self.stream is not None:
+            print(line, file=self.stream, flush=True)
+        if self._file is not None:
+            self._file.write(line + "\n")
+            self._file.flush()
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+
+
+@contextlib.contextmanager
+def trace(logdir):
+    """Profile a block into ``logdir`` (TensorBoard / Perfetto readable) —
+    usage: ``with observe.trace('/tmp/trace'): step(U, V)``."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -74,18 +74,28 @@ def main():
     ib = jax.device_put(icsr.device_buckets())
     step = make_step(ub, ib, nU, nI, cfg, ucsr.chunk_elems, icsr.chunk_elems)
 
+    import jax.numpy as jnp
+
+    def fence(x):
+        # scalar device->host readback: block_until_ready alone has been
+        # seen returning early on the experimental axon platform
+        return float(jnp.sum(jnp.abs(x)))
+
     t0 = time.time()
     U, V = step(U, V)
     U.block_until_ready()
+    fence(U)
     log(f"warmup (compile + 1 iter): {time.time()-t0:.1f}s")
 
     t0 = time.time()
     for _ in range(args.iters):
         U, V = step(U, V)
     U.block_until_ready()
+    checksum = fence(U)
     dt = time.time() - t0
     iters_per_sec = args.iters / dt
-    log(f"{args.iters} iters in {dt:.2f}s -> {iters_per_sec:.3f} iters/sec")
+    log(f"{args.iters} iters in {dt:.2f}s -> {iters_per_sec:.3f} iters/sec "
+        f"(checksum {checksum:.4g})")
 
     result = {
         "metric": "als_iters_per_sec_rank128_ml25m_implicit"

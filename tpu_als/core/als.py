@@ -113,24 +113,36 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
 def make_step(user_buckets, item_buckets, num_users, num_items, cfg: AlsConfig,
               user_chunk_elems=1 << 19, item_chunk_elems=1 << 19):
     """Build the jitted full ALS iteration (item half-step then user
-    half-step, the reference stack's order — SURVEY.md §3.1)."""
+    half-step, the reference stack's order — SURVEY.md §3.1).
 
-    def step(U, V):
+    The rating buckets are passed to the jitted function as *arguments*, not
+    closure captures: a closed-over device array is baked into the HLO as a
+    constant, which at ML-25M scale means shipping ~1 GB of rating data
+    inside the compile payload (and re-compiling whenever the data changes).
+    As arguments they stay on device and the compiled step is reusable.
+    """
+
+    def step_impl(U, V, ub, ib):
         if cfg.implicit_prefs:
             YtY_u = compute_yty(U)
-            V = local_half_step(U, item_buckets, num_items, cfg, YtY_u,
+            V = local_half_step(U, ib, num_items, cfg, YtY_u,
                                 item_chunk_elems)
             YtY_v = compute_yty(V)
-            U = local_half_step(V, user_buckets, num_users, cfg, YtY_v,
+            U = local_half_step(V, ub, num_users, cfg, YtY_v,
                                 user_chunk_elems)
         else:
-            V = local_half_step(U, item_buckets, num_items, cfg,
+            V = local_half_step(U, ib, num_items, cfg,
                                 chunk_elems=item_chunk_elems)
-            U = local_half_step(V, user_buckets, num_users, cfg,
+            U = local_half_step(V, ub, num_users, cfg,
                                 chunk_elems=user_chunk_elems)
         return U, V
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    jitted = jax.jit(step_impl, donate_argnums=(0, 1))
+
+    def step(U, V):
+        return jitted(U, V, user_buckets, item_buckets)
+
+    return step
 
 
 def train(user_csr, item_csr, cfg: AlsConfig, callback=None):

@@ -1,0 +1,125 @@
+"""Perf ablation for the ALS half-step: where do the milliseconds go?
+
+Builds the same bucketed step as tpu_als.core.als but with individual stages
+ablatable, so stage cost = full - ablated (single jitted call per variant —
+per-dispatch latency on the tunneled TPU makes micro-timing useless).
+
+Usage: python scripts/ablate.py [--scale 25] [--rank 128] [--variants ...]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+# repo-root import without PYTHONPATH (setting PYTHONPATH breaks the axon
+# TPU plugin discovery in this environment)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tpu_als.core.ratings import build_csr_buckets, trainer_chunk
+from tpu_als.io.movielens import ML25M_SHAPE, synthetic_movielens
+from tpu_als.ops.solve import (
+    compute_yty, normal_eq_explicit, normal_eq_implicit, solve_spd)
+
+
+def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
+    out = jnp.zeros((num_rows, rank), jnp.float32)
+    for b in buckets:
+        nb, w = b.cols.shape
+        chunk = trainer_chunk(nb, w, rank, chunk_elems)
+        nch = nb // chunk
+        cols = b.cols.reshape(nch, chunk, w)
+        vals = b.vals.reshape(nch, chunk, w)
+        mask = b.mask.reshape(nch, chunk, w)
+
+        def f(args):
+            c, v, m = args
+            if ab == "no-gather":
+                Vg = jnp.broadcast_to(V_full[0], (chunk, w, rank))
+            else:
+                Vg = V_full[c]
+            if ab == "no-neq":
+                A = jnp.broadcast_to(
+                    jnp.eye(rank) * 2.0, (chunk, rank, rank))
+                rhs = Vg[:, 0, :]
+                cnt = jnp.sum(m, axis=-1)
+            elif cfgd["implicit"]:
+                A, rhs, cnt = normal_eq_implicit(
+                    Vg, v, m, cfgd["reg"], cfgd["alpha"], YtY)
+            else:
+                A, rhs, cnt = normal_eq_explicit(Vg, v, m, cfgd["reg"])
+            if ab == "no-solve":
+                return rhs
+            return solve_spd(A, rhs, cnt)
+
+        if nch == 1:
+            xs = f((cols[0], vals[0], mask[0]))[None]
+        else:
+            xs = jax.lax.map(f, (cols, vals, mask))
+        if ab != "no-scatter":
+            out = out.at[b.rows].set(
+                xs.reshape(nb, rank), mode="drop", unique_indices=True)
+        else:
+            out = out + jnp.sum(xs) * 0  # keep xs live
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=25, help="divide ML-25M by")
+    ap.add_argument("--rank", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--explicit", action="store_true")
+    ap.add_argument("--variants", nargs="*", default=[
+        "full", "no-solve", "no-gather", "no-neq", "no-scatter"])
+    args = ap.parse_args()
+
+    nU, nI, nnz = (s // args.scale for s in ML25M_SHAPE)
+    frame = synthetic_movielens(nU, nI, nnz, seed=0)
+    u = np.asarray(frame["user"])
+    i = np.asarray(frame["item"])
+    r = np.asarray(frame["rating"])
+    ucsr = build_csr_buckets(u, i, r, nU)
+    icsr = build_csr_buckets(i, u, r, nI)
+    ub = jax.device_put(ucsr.device_buckets())
+    ib = jax.device_put(icsr.device_buckets())
+    cfgd = {"implicit": not args.explicit, "reg": 0.01, "alpha": 40.0}
+    rank = args.rank
+
+    def step_impl(U, V, ub, ib, ab):
+        YtY_u = compute_yty(U) if cfgd["implicit"] else None
+        V = half_step(U, ib, nI, rank, icsr.chunk_elems, YtY_u, ab, cfgd)
+        YtY_v = compute_yty(V) if cfgd["implicit"] else None
+        U = half_step(V, ub, nU, rank, ucsr.chunk_elems, YtY_v, ab, cfgd)
+        return U, V
+
+    base = None
+    for ab in args.variants:
+        key = jax.random.PRNGKey(0)
+        ku, kv = jax.random.split(key)
+        U = jax.random.normal(ku, (nU, rank), jnp.float32)
+        V = jax.random.normal(kv, (nI, rank), jnp.float32)
+        step = jax.jit(lambda U, V, ub, ib: step_impl(U, V, ub, ib, ab),
+                       donate_argnums=(0, 1))
+        t0 = time.time()
+        U, V = step(U, V, ub, ib)
+        jax.block_until_ready((U, V))
+        compile_s = time.time() - t0
+        t0 = time.time()
+        for _ in range(args.iters):
+            U, V = step(U, V, ub, ib)
+        jax.block_until_ready((U, V))
+        dt = (time.time() - t0) / args.iters
+        if ab == "full":
+            base = dt
+        delta = f"  (saves {base - dt:+.3f}s)" if base and ab != "full" else ""
+        print(f"{ab:12s} {dt:7.3f} s/iter  [compile {compile_s:.1f}s]{delta}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

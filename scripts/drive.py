@@ -1,0 +1,140 @@
+"""End-to-end user-style drive on the default (TPU) platform.
+
+Follows .claude/skills/verify/SKILL.md: synthesize -> block -> train ->
+evaluate -> top-k -> fold-in -> Estimator surface, with edge probes
+(cold rows, duplicates, bfloat16, rank=128, nonnegative).
+Exits nonzero on any check failure.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def check(name, ok, detail=""):
+    print(f"{'PASS' if ok else 'FAIL'}  {name}  {detail}", flush=True)
+    if not ok:
+        sys.exit(1)
+
+
+def main():
+    from tpu_als.core.als import AlsConfig, predict, train
+    from tpu_als.core.foldin import fold_in
+    from tpu_als.core.ratings import build_csr_buckets
+    from tpu_als.ops.topk import topk_scores
+
+    print("devices:", jax.devices(), flush=True)
+
+    rng = np.random.default_rng(7)
+    nU, nI, rank = 4000, 1200, 16
+    Ustar = rng.normal(0, 1 / np.sqrt(rank), (nU, rank)).astype(np.float32)
+    Vstar = rng.normal(0, 1 / np.sqrt(rank), (nI, rank)).astype(np.float32)
+    # power-law user degrees with enough support per user for a rank-16
+    # model (>= 3*rank ratings); leave the last 5 users/items cold
+    deg = np.minimum(3 * rank + (rng.zipf(1.6, nU - 5) % 300), nI - 5)
+    u_list, i_list = [], []
+    for u, d in enumerate(deg):
+        items = rng.choice(nI - 5, size=d, replace=False)
+        u_list.append(np.full(d, u))
+        i_list.append(items)
+    u = np.concatenate(u_list)
+    i = np.concatenate(i_list)
+    # a few duplicate pairs on top
+    u = np.concatenate([u, u[:50]])
+    i = np.concatenate([i, i[:50]])
+    r = (Ustar[u] * Vstar[i]).sum(1) + 0.05 * rng.normal(size=len(u)).astype(
+        np.float32)
+    hold = rng.random(len(u)) < 0.1
+    ut, it_, rt = u[~hold], i[~hold], r[~hold]
+
+    ucsr = build_csr_buckets(ut, it_, rt, nU)
+    icsr = build_csr_buckets(it_, ut, rt, nI)
+    waste = max(ucsr.padded_nnz / ucsr.nnz, icsr.padded_nnz / icsr.nnz)
+    check("padding waste < 2.5x", waste < 2.5, f"{waste:.2f}x")
+
+    cfg = AlsConfig(rank=rank, max_iter=10, reg_param=0.005, seed=0)
+    t0 = time.time()
+    U, V = train(ucsr, icsr, cfg)
+    t_first = time.time() - t0
+    t0 = time.time()
+    U, V = train(ucsr, icsr, cfg)
+    t_second = time.time() - t0
+    print(f"train: first {t_first:.1f}s (compile), second {t_second:.1f}s",
+          flush=True)
+
+    ok = jnp.ones(len(u[hold]), bool)
+    pred = predict(U, V, jnp.asarray(u[hold]), jnp.asarray(i[hold]), ok, ok)
+    rmse = float(jnp.sqrt(jnp.mean((pred - jnp.asarray(r[hold])) ** 2)))
+    base = float(np.std(r[hold]))
+    check("held-out RMSE beats rating std", rmse < 0.6 * base,
+          f"rmse={rmse:.4f} std={base:.4f}")
+
+    cold_U = np.asarray(U[-5:])
+    check("cold user rows are exactly 0 and finite",
+          np.isfinite(cold_U).all() and (cold_U == 0).all())
+
+    valid = jnp.arange(nI) < nI - 5
+    sc, ix = topk_scores(U, V, valid, k=10)
+    check("top-k sorted desc, valid only",
+          bool((np.diff(np.asarray(sc), axis=1) <= 1e-5).all()
+               and (np.asarray(ix) < nI - 5).all()))
+
+    # fold-in: brand-new user rating 30 known items
+    new_items = rng.choice(nI - 5, 30, replace=False)
+    new_r = (Ustar[0] * Vstar[new_items]).sum(1)
+    cols = jnp.asarray(new_items)[None]
+    vals = jnp.asarray(new_r)[None]
+    mask = jnp.ones_like(vals)
+    u_new = fold_in(V, cols, vals, mask, cfg.reg_param)
+    pred_new = np.asarray(V)[new_items] @ np.asarray(u_new)[0]
+    corr = np.corrcoef(pred_new, new_r)[0, 1]
+    check("fold-in factors track new user's ratings", corr > 0.9,
+          f"corr={corr:.3f}")
+
+    # Estimator facade
+    import tpu_als
+
+    frame = {"user": ut, "item": it_, "rating": rt}
+    als = tpu_als.ALS(rank=16, maxIter=5, regParam=0.005, seed=0)
+    model = als.fit(frame)
+    out = model.transform({"user": u[hold][:500], "item": i[hold][:500],
+                           "rating": r[hold][:500]})
+    p = np.asarray(out["prediction"], dtype=np.float32)
+    check("estimator transform finite", np.isfinite(p).all())
+    recs = model.recommendForAllUsers(5)
+    check("recommendForAllUsers shape", len(recs["user"]) == len(set(ut)))
+
+    # probes: bfloat16 compute, rank 128 MXU tile, nonnegative
+    cfg_bf = AlsConfig(rank=rank, max_iter=3, reg_param=0.005,
+                       compute_dtype="bfloat16", seed=0)
+    Ub, Vb = train(ucsr, icsr, cfg_bf)
+    check("bfloat16 compute finite",
+          bool(jnp.isfinite(Ub).all() and jnp.isfinite(Vb).all()))
+
+    cfg128 = AlsConfig(rank=128, max_iter=2, reg_param=0.005, seed=0)
+    U1, V1 = train(ucsr, icsr, cfg128)
+    check("rank=128 trains finite", bool(jnp.isfinite(U1).all()))
+
+    cfg_nn = AlsConfig(rank=8, max_iter=3, reg_param=0.005,
+                       nonnegative=True, seed=0)
+    Un, Vn = train(ucsr, icsr, cfg_nn)
+    check("nonnegative factors >= 0",
+          bool((np.asarray(Un) >= -1e-6).all()
+               and (np.asarray(Vn) >= -1e-6).all()))
+
+    print("ALL CHECKS PASSED", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -121,3 +121,61 @@ def test_trainer_chunk_caps_rank_dominated_memory():
     assert c >= 1 and 131072 % c == 0
     # rank smaller than width: gathered factors dominate, chunk unchanged
     assert trainer_chunk(1024, 512, 16, 1 << 19) == 1024
+
+
+def test_native_bucketizer_bit_identical(rng):
+    from tpu_als.io import fastbucket
+
+    if not fastbucket.available():
+        import pytest
+
+        pytest.skip("g++ unavailable")
+    # power-law degrees + rows with zero ratings + duplicates
+    n_rows, n_cols, nnz = 500, 90, 6000
+    rows = (rng.zipf(1.4, nnz) % n_rows).astype(np.int64)
+    cols = rng.integers(0, n_cols, nnz)
+    vals = rng.random(nnz).astype(np.float32)
+    a = build_csr_buckets(rows, cols, vals, n_rows, native=False)
+    b = build_csr_buckets(rows, cols, vals, n_rows, native=True)
+    assert a.nnz == b.nnz and (a.counts == b.counts).all()
+    assert len(a.buckets) == len(b.buckets)
+    for x, y in zip(a.buckets, b.buckets):
+        np.testing.assert_array_equal(x.rows, y.rows)
+        np.testing.assert_array_equal(x.cols, y.cols)
+        np.testing.assert_array_equal(x.vals, y.vals)
+        np.testing.assert_array_equal(x.mask, y.mask)
+
+
+def test_native_bucketizer_non_pow2_min_width(rng):
+    # regression: non-power-of-two min_width once crashed the native path
+    from tpu_als.io import fastbucket
+
+    if not fastbucket.available():
+        import pytest
+
+        pytest.skip("g++ unavailable")
+    rows = rng.integers(0, 40, 300)
+    cols = rng.integers(0, 25, 300)
+    vals = rng.random(300).astype(np.float32)
+    a = build_csr_buckets(rows, cols, vals, 40, min_width=6, native=False)
+    b = build_csr_buckets(rows, cols, vals, 40, min_width=6, native=True)
+    for x, y in zip(a.buckets, b.buckets):
+        np.testing.assert_array_equal(x.rows, y.rows)
+        np.testing.assert_array_equal(x.cols, y.cols)
+        np.testing.assert_array_equal(x.vals, y.vals)
+        np.testing.assert_array_equal(x.mask, y.mask)
+
+
+def test_native_counts_bounds_checked(rng):
+    from tpu_als.io import fastbucket
+
+    if not fastbucket.available():
+        import pytest
+
+        pytest.skip("g++ unavailable")
+    import pytest
+
+    with pytest.raises(ValueError, match="row indices"):
+        fastbucket.counts(np.array([0, 5, -1]), 10)
+    with pytest.raises(ValueError, match="row indices"):
+        fastbucket.counts(np.array([0, 10]), 10)

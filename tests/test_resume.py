@@ -1,0 +1,111 @@
+"""Failure detection / recovery (SURVEY.md §5.3): crash mid-training, then
+resume from the checkpoint and converge to the same factors as an
+uninterrupted run.
+
+The reference stack bounds recovery cost via ``checkpointInterval`` RDD
+lineage cuts; here ALS is a fixed-point iteration so recovery is
+restart-from-factors, which must be *exact* — each iteration is a
+deterministic function of (U, V, ratings).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_ratings
+
+import tpu_als
+from tpu_als.io.checkpoint import load_factors
+
+_CRASH_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import tpu_als
+
+data = np.load(sys.argv[1])
+frame = {{"user": data["u"], "item": data["i"], "rating": data["r"]}}
+
+def die(iteration, U, V):
+    if iteration == 4:
+        os._exit(42)  # simulated hard crash: no cleanup, no atexit
+
+als = tpu_als.ALS(rank=4, maxIter=8, regParam=0.01, seed=3,
+                  checkpointDir=sys.argv[2], checkpointInterval=3,
+                  fitCallback=die)
+als.fit(frame)
+"""
+
+
+@pytest.fixture
+def dataset(rng):
+    u, i, r, _, _ = make_ratings(rng, num_users=50, num_items=30, rank=4)
+    return u, i, r
+
+
+def test_crash_then_resume_matches_uninterrupted(dataset, tmp_path):
+    u, i, r = dataset
+    frame = {"user": u, "item": i, "rating": r}
+
+    # uninterrupted reference run
+    full = tpu_als.ALS(rank=4, maxIter=8, regParam=0.01, seed=3).fit(frame)
+
+    # crashing run: dies at iteration 4, checkpoint written at iteration 3
+    npz = tmp_path / "data.npz"
+    np.savez(npz, u=u, i=i, r=r)
+    script = _CRASH_SCRIPT.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    proc = subprocess.run(
+        [sys.executable, "-c", script, str(npz), str(tmp_path)],
+        capture_output=True, text=True,
+        env={**os.environ,
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    assert proc.returncode == 42, proc.stderr
+
+    ckpt = str(tmp_path / "als_checkpoint")
+    manifest, *_ = load_factors(ckpt)
+    assert manifest["iteration"] == 3
+
+    # resume: loads iteration-3 factors, runs the remaining 5 iterations
+    resumed = tpu_als.ALS(rank=4, maxIter=8, regParam=0.01, seed=3,
+                          resumeFrom=ckpt).fit(frame)
+
+    np.testing.assert_allclose(resumed._U, full._U, atol=1e-5)
+    np.testing.assert_allclose(resumed._V, full._V, atol=1e-5)
+
+
+def test_resume_rejects_mismatched_rank(dataset, tmp_path):
+    u, i, r = dataset
+    frame = {"user": u, "item": i, "rating": r}
+    tpu_als.ALS(rank=4, maxIter=2, regParam=0.01, seed=0,
+                checkpointDir=str(tmp_path), checkpointInterval=1).fit(frame)
+    ckpt = str(tmp_path / "als_checkpoint")
+    with pytest.raises(ValueError, match="rank"):
+        tpu_als.ALS(rank=6, maxIter=4, resumeFrom=ckpt).fit(frame)
+
+
+def test_resume_rejects_mismatched_ids(dataset, tmp_path):
+    u, i, r = dataset
+    frame = {"user": u, "item": i, "rating": r}
+    tpu_als.ALS(rank=4, maxIter=2, regParam=0.01, seed=0,
+                checkpointDir=str(tmp_path), checkpointInterval=1).fit(frame)
+    ckpt = str(tmp_path / "als_checkpoint")
+    with pytest.raises(ValueError, match="id maps"):
+        tpu_als.ALS(rank=4, maxIter=4, resumeFrom=ckpt).fit(
+            {"user": u + 1000, "item": i, "rating": r})
+
+
+def test_resume_rejects_mismatched_solver_params(dataset, tmp_path):
+    u, i, r = dataset
+    frame = {"user": u, "item": i, "rating": r}
+    tpu_als.ALS(rank=4, maxIter=2, regParam=0.01, seed=0,
+                checkpointDir=str(tmp_path), checkpointInterval=1).fit(frame)
+    ckpt = str(tmp_path / "als_checkpoint")
+    with pytest.raises(ValueError, match="regParam"):
+        tpu_als.ALS(rank=4, maxIter=4, regParam=0.1,
+                    resumeFrom=ckpt).fit(frame)

@@ -20,7 +20,7 @@ from tpu_als.api.params import Params, TypeConverters
 from tpu_als.core.als import AlsConfig, predict as _predict_kernel, train as _train
 from tpu_als.core.ratings import IdMap, build_csr_buckets, remap_ids
 from tpu_als.io.checkpoint import load_factors, save_factors
-from tpu_als.ops.topk import chunked_topk_scores
+from tpu_als.ops.topk import topk_scores
 from tpu_als.utils.frame import ColumnarFrame, as_frame
 
 _STORAGE_LEVELS = {
@@ -111,15 +111,20 @@ class ALS(_ALSParams):
     train sharded over devices (None = single device; ``numUserBlocks`` /
     ``numItemBlocks`` are then API-parity hints only); ``checkpointDir`` —
     where ``checkpointInterval`` writes resumable factor snapshots;
+    ``resumeFrom`` — a checkpoint directory to warm-start from: ``fit``
+    loads its factors + iteration counter and runs only the remaining
+    iterations (failure recovery, SURVEY.md §5.3);
     ``fitCallback(iteration, U, V)`` — per-iteration observer (e.g.
     tpu_als.utils.observe.IterationLogger).
     """
 
-    def __init__(self, *, mesh=None, checkpointDir=None, fitCallback=None,
+    def __init__(self, *, mesh=None, checkpointDir=None, resumeFrom=None,
+                 fitCallback=None,
                  **kwargs):
         super().__init__()
         self.mesh = mesh
         self.checkpointDir = checkpointDir
+        self.resumeFrom = resumeFrom
         self.fitCallback = fitCallback
         self.setParams(**kwargs)
 
@@ -173,6 +178,30 @@ class ALS(_ALSParams):
         i_idx, item_map = remap_ids(i_raw)
         cfg = self._config()
 
+        init, start_iter = None, 0
+        if self.resumeFrom is not None:
+            manifest, c_uids, c_U, c_iids, c_V = load_factors(self.resumeFrom)
+            if manifest.get("rank") != cfg.rank:
+                raise ValueError(
+                    f"resumeFrom checkpoint has rank {manifest.get('rank')}, "
+                    f"estimator is configured with rank {cfg.rank}")
+            if not (np.array_equal(c_uids, user_map.ids)
+                    and np.array_equal(c_iids, item_map.ids)):
+                raise ValueError("resumeFrom checkpoint id maps do not match "
+                                 "the dataset being fit")
+            # exact recovery requires identical solver hyperparameters too
+            ck = manifest.get("params", {})
+            for name in ("regParam", "implicitPrefs", "alpha", "nonnegative"):
+                if name in ck:
+                    mine = self.getOrDefault(self.getParam(name))
+                    if ck[name] != mine:
+                        raise ValueError(
+                            f"resumeFrom checkpoint was trained with "
+                            f"{name}={ck[name]!r}, estimator has {mine!r}; "
+                            "resume cannot reproduce the original run")
+            init = (c_U, c_V)
+            start_iter = int(manifest.get("iteration") or 0)
+
         callback = self._checkpoint_callback(user_map, item_map)
         if self.mesh is not None:
             from tpu_als.parallel.data import partition_balanced, shard_csr
@@ -192,13 +221,15 @@ class ALS(_ALSParams):
                              np.asarray(U)[upart.slot],
                              np.asarray(V)[ipart.slot])
             Us, Vs = train_sharded(self.mesh, upart, ipart, ush, ish, cfg,
-                                   callback=sharded_cb)
+                                   callback=sharded_cb, init=init,
+                                   start_iter=start_iter)
             U = np.asarray(Us)[upart.slot]
             V = np.asarray(Vs)[ipart.slot]
         else:
             ucsr = build_csr_buckets(u_idx, i_idx, r, len(user_map))
             icsr = build_csr_buckets(i_idx, u_idx, r, len(item_map))
-            U, V = _train(ucsr, icsr, cfg, callback=callback)
+            U, V = _train(ucsr, icsr, cfg, callback=callback, init=init,
+                          start_iter=start_iter)
             U, V = np.asarray(U), np.asarray(V)
 
         return ALSModel(
@@ -325,7 +356,7 @@ class ALSModel:
         ids_out = np.empty((Q.shape[0], k), dtype=other_ids.dtype)
         scores_out = np.empty((Q.shape[0], k), dtype=np.float32)
         for s in range(0, Q.shape[0], block):
-            sc, ix = chunked_topk_scores(
+            sc, ix = topk_scores(
                 jnp.asarray(Q[s:s + block]), other_j, valid, k=k,
                 item_chunk=block,
             )
@@ -347,7 +378,7 @@ class ALSModel:
         other = self._V if for_users else self._U
         other_ids = self._item_map.ids if for_users else self._user_map.ids
         k = min(numItems, other.shape[0])
-        sc, ix = chunked_topk_scores(
+        sc, ix = topk_scores(
             jnp.asarray(Q), jnp.asarray(other),
             jnp.ones(other.shape[0], bool), k=k,
         )

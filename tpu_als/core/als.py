@@ -145,7 +145,8 @@ def make_step(user_buckets, item_buckets, num_users, num_items, cfg: AlsConfig,
     return step
 
 
-def train(user_csr, item_csr, cfg: AlsConfig, callback=None):
+def train(user_csr, item_csr, cfg: AlsConfig, callback=None, init=None,
+          start_iter=0):
     """Single-device ALS training loop.
 
     ``user_csr``: CsrBuckets keyed by user (cols = item idx) — solves U.
@@ -153,20 +154,29 @@ def train(user_csr, item_csr, cfg: AlsConfig, callback=None):
     ``callback(iteration, U, V)`` runs between iterations (logging,
     checkpointing); the per-iteration compute itself is one jitted call with
     zero host round-trips inside.
+
+    ``init``: optional ``(U0, V0)`` warm start — the failure-recovery path
+    (SURVEY.md §5.3): ALS is a fixed-point iteration, so resuming from a
+    checkpoint's factors at ``start_iter`` reproduces the uninterrupted run
+    exactly.  Runs the remaining ``cfg.max_iter - start_iter`` iterations.
     """
     num_users = user_csr.num_rows
     num_items = item_csr.num_rows
-    key = jax.random.PRNGKey(cfg.seed)
-    ku, kv = jax.random.split(key)
-    U = init_factors(ku, num_users, cfg.rank)
-    V = init_factors(kv, num_items, cfg.rank)
+    if init is not None:
+        U = jnp.asarray(init[0], dtype=jnp.float32)
+        V = jnp.asarray(init[1], dtype=jnp.float32)
+    else:
+        key = jax.random.PRNGKey(cfg.seed)
+        ku, kv = jax.random.split(key)
+        U = init_factors(ku, num_users, cfg.rank)
+        V = init_factors(kv, num_items, cfg.rank)
 
     ub = jax.device_put(user_csr.device_buckets())
     ib = jax.device_put(item_csr.device_buckets())
     step = make_step(ub, ib, num_users, num_items, cfg,
                      user_csr.chunk_elems, item_csr.chunk_elems)
 
-    for it in range(cfg.max_iter):
+    for it in range(start_iter, cfg.max_iter):
         U, V = step(U, V)
         if callback is not None:
             callback(it + 1, U, V)

@@ -116,6 +116,16 @@ def _next_pow2(x):
     return 1 << int(max(0, int(np.ceil(np.log2(max(1, x))))))
 
 
+def entity_widths(counts, min_width):
+    """Bucket width per entity: next power of two of the rating count,
+    floored at ``min_width``.  The single source of truth for bucket
+    assignment — the numpy and native blocking paths both call this."""
+    counts = np.maximum(np.asarray(counts, dtype=np.int64), 1)
+    return np.maximum(
+        min_width, 1 << np.ceil(np.log2(counts)).astype(np.int64)
+    )
+
+
 def scan_chunk(nb, width, chunk_elems):
     """Builder-side rows-per-scan-step for a bucket of ``nb`` rows of
     ``width``.  Always a power of two, so the trainer can halve it freely
@@ -153,6 +163,7 @@ def build_csr_buckets(
     min_width=8,
     chunk_elems=1 << 19,
     dtype=np.float32,
+    native=None,
 ):
     """Build degree-bucketed padded CSR from COO triples.
 
@@ -165,7 +176,22 @@ def build_csr_buckets(
     [nchunks, chunk, w] without tracing-time pads, halving the chunk if the
     rank demands it; padding rows carry ``rows == num_rows`` (out-of-bounds
     ⇒ scatter-dropped).
+
+    ``native``: True forces the threaded C++ bucketizer
+    (tpu_als.io.fastbucket — bit-identical output), False forces numpy,
+    None (default) uses C++ when the library builds and f32 ratings are
+    requested.
     """
+    if native or native is None:
+        from tpu_als.io import fastbucket
+
+        ok = dtype == np.float32 and fastbucket.available()
+        if native and not ok:
+            raise RuntimeError(
+                "native bucketizer requires float32 vals and a working g++")
+        if ok:
+            return _build_csr_buckets_native(
+                row_idx, col_idx, vals, num_rows, min_width, chunk_elems)
     row_idx = np.asarray(row_idx, dtype=np.int64)
     col_idx = np.asarray(col_idx, dtype=np.int64)
     vals = np.asarray(vals, dtype=dtype)
@@ -182,10 +208,7 @@ def build_csr_buckets(
     entry_rank = np.repeat(np.arange(len(uniq)), ucounts)
     entry_off = np.arange(nnz) - starts[entry_rank]
 
-    widths = np.maximum(
-        min_width,
-        1 << np.ceil(np.log2(np.maximum(ucounts, 1))).astype(np.int64),
-    )
+    widths = entity_widths(ucounts, min_width)
     buckets = []
     for w in sorted(set(widths.tolist())):
         sel_rows = np.flatnonzero(widths == w)  # indices into uniq
@@ -213,5 +236,37 @@ def build_csr_buckets(
         num_rows=num_rows,
         counts=counts,
         nnz=nnz,
+        chunk_elems=chunk_elems,
+    )
+
+
+def _build_csr_buckets_native(row_idx, col_idx, vals, num_rows, min_width,
+                              chunk_elems):
+    """Threaded C++ blocking path — same output as the numpy path above."""
+    from tpu_als.io import fastbucket
+
+    row_idx = np.asarray(row_idx, dtype=np.int64)
+    counts = fastbucket.counts(row_idx, num_rows)
+    w_all = entity_widths(counts, min_width)
+    rated = counts > 0
+    layout = []
+    bucket_widths = sorted(set(w_all[rated].tolist()))
+    for w in bucket_widths:
+        nb = int((rated & (w_all == w)).sum())
+        chunk = scan_chunk(nb, w, chunk_elems)
+        layout.append((int(w), nb, -(-nb // chunk) * chunk))
+    # per-entity bucket index (exact width match; -1 for unrated entities)
+    ebucket = np.searchsorted(
+        np.asarray(bucket_widths, dtype=np.int64), w_all
+    ).astype(np.int32)
+    ebucket[~rated] = -1
+    raw = fastbucket.fill_buckets(
+        row_idx, col_idx, vals, num_rows, counts, ebucket, layout)
+    buckets = [Bucket(rows=r, cols=c, vals=v, mask=m) for r, c, v, m in raw]
+    return CsrBuckets(
+        buckets=buckets,
+        num_rows=num_rows,
+        counts=counts,
+        nnz=len(row_idx),
         chunk_elems=chunk_elems,
     )

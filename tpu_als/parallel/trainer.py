@@ -20,6 +20,8 @@ the rating shards.
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -131,8 +133,6 @@ def stacked_counts(part, row_idx, vals=None, positive_only=False):
     """Per-row rating counts in [D, rows_per_shard] layout (for the ring
     strategy's λ·n ridge; ``positive_only`` mirrors the implicit-feedback
     ``numExplicits`` semantic)."""
-    import numpy as np
-
     if positive_only and vals is None:
         raise ValueError("vals is required when positive_only=True")
     sel = (np.asarray(vals) > 0) if positive_only else slice(None)
@@ -144,28 +144,41 @@ def stacked_counts(part, row_idx, vals=None, positive_only=False):
 
 def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
                   cfg: AlsConfig, callback=None, strategy="all_gather",
-                  ring_counts=None):
+                  ring_counts=None, init=None, start_iter=0):
     """Distributed ALS training loop.  Returns slot-space (U, V) jax.Arrays
     sharded over ``mesh``; index with ``Partition.slot`` to get entity rows.
 
     strategy: 'all_gather' (full opposite-factor gather per half-step) or
     'ring' (ppermute streaming; pass RingCsr containers and
     ``ring_counts=(user_counts, item_counts)`` from :func:`stacked_counts`).
+
+    ``init``: optional entity-space ``(U0, V0)`` warm start (checkpoint
+    resume, SURVEY.md §5.3); rows are scattered into slot space here.
+    Resumes at ``start_iter``, running the remaining iterations.
     """
     leading = NamedSharding(mesh, P(AXIS))
     ub = jax.device_put(user_sharded.device_buckets(), leading)
     ib = jax.device_put(item_sharded.device_buckets(), leading)
 
-    key = jax.random.PRNGKey(cfg.seed)
-    ku, kv = jax.random.split(key)
-    # init in slot space: entity e's initial row is a function of its slot;
-    # padding slots start at zero and stay zero (count==0 rows solve to 0)
-    U = jax.device_put(
-        _slot_init(ku, user_part, cfg.rank), leading
-    )
-    V = jax.device_put(
-        _slot_init(kv, item_part, cfg.rank), leading
-    )
+    if init is not None:
+        U0 = np.zeros((user_part.padded_rows, cfg.rank), dtype=np.float32)
+        U0[np.asarray(user_part.slot)] = np.asarray(init[0])
+        V0 = np.zeros((item_part.padded_rows, cfg.rank), dtype=np.float32)
+        V0[np.asarray(item_part.slot)] = np.asarray(init[1])
+        U = jax.device_put(U0, leading)
+        V = jax.device_put(V0, leading)
+    else:
+        key = jax.random.PRNGKey(cfg.seed)
+        ku, kv = jax.random.split(key)
+        # init in slot space: entity e's initial row is a function of its
+        # slot; padding slots start at zero and stay zero (count==0 rows
+        # solve to 0)
+        U = jax.device_put(
+            _slot_init(ku, user_part, cfg.rank), leading
+        )
+        V = jax.device_put(
+            _slot_init(kv, item_part, cfg.rank), leading
+        )
 
     if strategy not in ("all_gather", "ring"):
         raise ValueError(f"unknown strategy {strategy!r} "
@@ -182,7 +195,7 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
     else:
         step = make_sharded_step(mesh, user_sharded, item_sharded, cfg)
         args = (ub, ib)
-    for it in range(cfg.max_iter):
+    for it in range(start_iter, cfg.max_iter):
         U, V = step(U, V, *args)
         if callback is not None:
             callback(it + 1, U, V)
@@ -196,8 +209,6 @@ def _slot_init(key, part, rank):
     single-device run started from the same seed see identical per-entity
     initial factors (the equivalence tests rely on this).
     """
-    import numpy as np
-
     n = len(part.owner)
     dense = init_factors(key, n, rank)
     out = np.zeros((part.padded_rows, rank), dtype=np.float32)

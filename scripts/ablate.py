@@ -39,7 +39,10 @@ def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
         def f(args):
             c, v, m = args
             if ab == "no-gather":
-                Vg = jnp.broadcast_to(V_full[0], (chunk, w, rank))
+                # same gather op, all indices 0: measures the random-access
+                # penalty (cache-resident source row) without changing the
+                # program shape
+                Vg = V_full[c * 0]
             else:
                 Vg = V_full[c]
             if ab == "no-neq":
@@ -54,7 +57,7 @@ def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
                 A, rhs, cnt = normal_eq_explicit(Vg, v, m, cfgd["reg"])
             if ab == "no-solve":
                 return rhs
-            return solve_spd(A, rhs, cnt)
+            return solve_spd(A, rhs, cnt, backend=cfgd["solve_backend"])
 
         if nch == 1:
             xs = f((cols[0], vals[0], mask[0]))[None]
@@ -76,7 +79,33 @@ def main():
     ap.add_argument("--explicit", action="store_true")
     ap.add_argument("--variants", nargs="*", default=[
         "full", "no-solve", "no-gather", "no-neq", "no-scatter"])
+    ap.add_argument("--solve-backend", default="auto",
+                    choices=["auto", "xla", "pallas"])
+    ap.add_argument("--subproc", action="store_true",
+                    help="run each variant in its own subprocess with a "
+                         "timeout so one pathological compile cannot hang "
+                         "the whole sweep")
+    ap.add_argument("--variant-timeout", type=int, default=420)
     args = ap.parse_args()
+
+    if args.subproc:
+        import subprocess
+        import sys as _sys
+
+        for v in args.variants:
+            cmd = [_sys.executable, os.path.abspath(__file__),
+                   "--scale", str(args.scale), "--rank", str(args.rank),
+                   "--iters", str(args.iters),
+                   "--solve-backend", args.solve_backend,
+                   "--variants", v]
+            if args.explicit:
+                cmd.append("--explicit")
+            try:
+                subprocess.run(cmd, timeout=args.variant_timeout)
+            except subprocess.TimeoutExpired:
+                print(f"{v:12s} TIMEOUT after {args.variant_timeout}s",
+                      flush=True)
+        return
 
     nU, nI, nnz = (s // args.scale for s in ML25M_SHAPE)
     frame = synthetic_movielens(nU, nI, nnz, seed=0)
@@ -87,7 +116,8 @@ def main():
     icsr = build_csr_buckets(i, u, r, nI)
     ub = jax.device_put(ucsr.device_buckets())
     ib = jax.device_put(icsr.device_buckets())
-    cfgd = {"implicit": not args.explicit, "reg": 0.01, "alpha": 40.0}
+    cfgd = {"implicit": not args.explicit, "reg": 0.01, "alpha": 40.0,
+            "solve_backend": args.solve_backend}
     rank = args.rank
 
     def step_impl(U, V, ub, ib, ab):

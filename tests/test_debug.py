@@ -1,0 +1,50 @@
+"""Numerical-safety tooling tests (SURVEY.md §5.2)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from jax.experimental import checkify
+
+from tpu_als.utils.debug import (
+    assert_all_finite, checked_predict, debug_mode)
+
+
+def test_checked_predict_ok(rng):
+    U = jnp.asarray(rng.normal(size=(10, 4)).astype(np.float32))
+    V = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+    out = checked_predict(U, V, np.array([0, 9]), np.array([7, 3]))
+    expect = (np.asarray(U)[[0, 9]] * np.asarray(V)[[7, 3]]).sum(1)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+
+
+def test_checked_predict_catches_out_of_range(rng):
+    U = jnp.asarray(rng.normal(size=(10, 4)).astype(np.float32))
+    V = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+    with pytest.raises(checkify.JaxRuntimeError, match="user index"):
+        checked_predict(U, V, np.array([10]), np.array([0]))
+    with pytest.raises(checkify.JaxRuntimeError, match="negative item"):
+        checked_predict(U, V, np.array([0]), np.array([-1]))
+
+
+def test_debug_mode_raises_on_nan():
+    with pytest.raises(FloatingPointError):
+        with debug_mode():
+            jnp.log(jnp.zeros(3) - 1.0).block_until_ready()
+
+
+def test_debug_mode_restores_config():
+    import jax
+
+    before = jax.config.jax_debug_nans
+    with debug_mode():
+        pass
+    assert jax.config.jax_debug_nans == before
+
+
+def test_assert_all_finite():
+    ok = np.ones((3, 2), np.float32)
+    assert_all_finite(1, ok, ok)
+    bad = ok.copy()
+    bad[1, 1] = np.nan
+    with pytest.raises(FloatingPointError, match="iteration 7"):
+        assert_all_finite(7, ok, bad)

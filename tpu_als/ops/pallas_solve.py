@@ -1,0 +1,178 @@
+"""Pallas TPU kernel: batched SPD solve (blocked Cholesky + substitution).
+
+The ALS half-step ends with x = A⁻¹b for hundreds of thousands of small SPD
+systems (rank×rank, one per entity).  XLA lowers ``jnp.linalg.cholesky`` /
+``triangular_solve`` on TPU as column-sequential panel algorithms over HBM
+operands — for [221k, 128, 128] batches that serial chain dominates the
+whole training iteration.  This kernel keeps a tile of matrices resident in
+VMEM and factorizes them there:
+
+  * right-looking blocked Cholesky, panel width P: the within-panel rank-1
+    updates are VPU work on a [TN, r, P] panel block, the trailing update is
+    ONE batched [TN,r,P]x[TN,P,r] MXU contraction per panel;
+  * forward/backward substitution vectorized over the batch dim.
+
+Everything is masked static-shape arithmetic — no data-dependent control
+flow.  Replaces the per-entity LAPACK ``dppsv`` of the reference stack
+(Spark MLlib ``CholeskySolver``, SURVEY.md §2.B5/C1) at the opposite end of
+the batching spectrum: one kernel, every entity at once.
+
+Contract matches tpu_als.ops.solve.solve_spd: caller pre-regularizes A
+(jitter + empty-row identity guard); rows with b = 0 solve to x = 0.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _chol_solve_kernel(A_ref, b_ref, x_ref, S, *, r, panel):
+    """One batch tile: factorize A (in VMEM scratch S) and solve.
+
+    A_ref [TN, r, r]; b_ref [TN, r]; x_ref [TN, r]; S [TN, r, r] scratch.
+    """
+    S[:] = A_ref[:]
+    tn = A_ref.shape[0]
+    row_i = jax.lax.broadcasted_iota(jnp.int32, (tn, r, 1), 1)
+    prow = jax.lax.broadcasted_iota(jnp.int32, (tn, r, panel), 1)
+    pcol = jax.lax.broadcasted_iota(jnp.int32, (tn, r, panel), 2)
+
+    def do_panel(pi, _):
+        p = pi * panel
+        blk = S[:, :, pl.ds(p * 1, panel)]  # [TN, r, panel]
+
+        # [r, P] selector picking rows p..p+P-1 (one-hot matmul: dynamic
+        # lane-offset slicing is not a thing on TPU, a tiny MXU dot is)
+        sel = (
+            jax.lax.broadcasted_iota(jnp.int32, (r, panel), 0)
+            == p + jax.lax.broadcasted_iota(jnp.int32, (r, panel), 1)
+        ).astype(jnp.float32)
+
+        def do_col(jj, blk):
+            j = p + jj
+            onecol = pcol == jj
+            onerow_j = prow == j
+            # d = sqrt(A[j,j]); column j scaled by 1/d, zeroed above row j
+            col = jnp.sum(jnp.where(onecol, blk, 0.0), axis=2)  # [TN, r]
+            d2 = jnp.sum(jnp.where(onerow_j[:, :, 0:1] & onecol, blk, 0.0),
+                         axis=(1, 2))  # [TN]
+            inv = jax.lax.rsqrt(jnp.maximum(d2, 1e-30))  # [TN]
+            ncol = col * inv[:, None]
+            ncol = jnp.where(row_i[:, :, 0] >= j, ncol, 0.0)
+            # rank-1 update of the panel columns right of j (VPU):
+            #   blk[:, :, k] -= ncol * L[p+k, j],  L[p+k, j] = ncol[p:p+P]
+            ncol_panel = jnp.dot(ncol, sel,
+                                 preferred_element_type=jnp.float32)
+            upd = ncol[:, :, None] * ncol_panel[:, None, :]
+            blk = jnp.where(pcol > jj, blk - upd, blk)
+            # write the finished column back into the panel block
+            blk = jnp.where(onecol, ncol[:, :, None], blk)
+            return blk
+
+        blk = jax.lax.fori_loop(0, panel, do_col, blk)
+        # L panel, zeroed above the diagonal (per-column global row >= col)
+        Lp = jnp.where(prow >= p + pcol, blk, 0.0)
+        S[:, :, pl.ds(p * 1, panel)] = Lp
+        # trailing update (MXU): S[:, :, k] -= sum_j Lp[:, :, j] Lp[:, k, j]
+        # for k >= p+panel (mask; rows above the diagonal become garbage the
+        # later panels never read)
+        upd = jax.lax.dot_general(
+            Lp, Lp, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [TN, r, r]
+        col_k = jax.lax.broadcasted_iota(jnp.int32, (tn, r, r), 2)
+        S[:] = jnp.where(col_k >= p + panel, S[:] - upd, S[:])
+        return 0
+
+    jax.lax.fori_loop(0, r // panel, do_panel, 0)
+
+    # ---- forward substitution: L y = b ----
+    ridx = jax.lax.broadcasted_iota(jnp.int32, (tn, r), 1)
+
+    def fwd(j, res):
+        onej = ridx == j
+        colj = S[:, :, pl.ds(j * 1, 1)][:, :, 0]  # [TN, r] (zero above j)
+        d = jnp.sum(jnp.where(onej, colj, 0.0), axis=1)  # L[j,j]
+        yj = jnp.sum(jnp.where(onej, res, 0.0), axis=1) / d
+        # subtract yj * L[:, j] from the remaining rows (> j)
+        res = jnp.where(ridx > j, res - yj[:, None] * colj, res)
+        # store yj at position j
+        res = jnp.where(onej, yj[:, None], res)
+        return res
+
+    y = jax.lax.fori_loop(0, r, fwd, b_ref[:])
+
+    # ---- backward substitution: Lᵀ x = y ----
+    def bwd(t, res):
+        j = r - 1 - t
+        onej = ridx == j
+        colj = S[:, :, pl.ds(j * 1, 1)][:, :, 0]
+        d = jnp.sum(jnp.where(onej, colj, 0.0), axis=1)
+        xj = jnp.sum(jnp.where(onej, res, 0.0), axis=1) / d
+        # (Lᵀ)[i, j] = L[j, i] → subtract xj * L[j, :] from rows < j
+        rowj = jnp.sum(
+            jnp.where(row_i == j, S[:], 0.0), axis=1
+        )  # [TN, r] row j of L (zero right of j)
+        res = jnp.where(ridx < j, res - xj[:, None] * rowj, res)
+        res = jnp.where(onej, xj[:, None], res)
+        return res
+
+    x_ref[:] = jax.lax.fori_loop(0, r, bwd, y)
+
+
+def _tile_n(r_pad, budget_elems=1 << 21):
+    """Batch-tile so the [TN, r, r] scratch stays within ~8 MB of VMEM."""
+    tn = max(8, budget_elems // (r_pad * r_pad))
+    return 1 << (tn.bit_length() - 1)
+
+
+@functools.partial(jax.jit, static_argnames=("panel", "interpret"))
+def spd_solve_pallas(A, b, panel=32, interpret=False):
+    """Batched SPD solve x = A⁻¹ b.  A [N, r, r] f32, b [N, r] f32.
+
+    Caller must pre-regularize A (SPD with jitter; identity for empty rows)
+    — same contract as the XLA path in tpu_als.ops.solve.solve_spd.
+    """
+    N, r = b.shape
+    r_pad = max(panel, -(-r // panel) * panel)
+    tn = _tile_n(r_pad)
+    n_pad = -(-N // tn) * tn
+    eye_tail = jnp.eye(r_pad, dtype=jnp.float32)[None, :, :]
+    Ap = jnp.pad(A, ((0, n_pad - N), (0, r_pad - r), (0, r_pad - r)))
+    # padded diagonal (both the rank padding and the batch padding) = I so
+    # the factorization stays finite; padded b = 0 → padded x = 0
+    diag_fix = jnp.where(
+        (jax.lax.broadcasted_iota(jnp.int32, (1, r_pad, r_pad), 1) >= r)
+        | (jnp.arange(n_pad)[:, None, None] >= N),
+        eye_tail, 0.0,
+    )
+    Ap = Ap + diag_fix
+    bp = jnp.pad(b, ((0, n_pad - N), (0, r_pad - r)))
+
+    kernel = functools.partial(_chol_solve_kernel, r=r_pad, panel=panel)
+    x = pl.pallas_call(
+        kernel,
+        grid=(n_pad // tn,),
+        in_specs=[
+            pl.BlockSpec((tn, r_pad, r_pad), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, r_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tn, r_pad), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pad, r_pad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((tn, r_pad, r_pad), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=int(n_pad * (r_pad ** 3 / 3 + 2 * r_pad ** 2)),
+            bytes_accessed=(n_pad * r_pad * r_pad + 2 * n_pad * r_pad) * 4,
+            transcendentals=n_pad * r_pad,
+        ),
+        interpret=interpret,
+    )(Ap, bp)
+    return x[:N, :r]

@@ -89,18 +89,31 @@ def compute_yty(V):
     return jnp.einsum("nr,ns->rs", V, V, preferred_element_type=jnp.float32)
 
 
-def solve_spd(A, b, count, jitter=1e-6):
+def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
     """Batched SPD solve via Cholesky: x = A⁻¹ b for each row.
 
     Rows with ``count == 0`` (entities with no ratings in this shard — padding
     rows or cold entities) get A replaced by I so the factorization stays
     finite; their b is 0 so the solution is exactly 0.  This is the batched
     equivalent of the reference solver's per-row ``dppsv`` (SURVEY.md §2.C1).
+
+    backend: 'auto' routes to the VMEM-resident Pallas blocked-Cholesky
+    kernel on TPU (tpu_als.ops.pallas_solve — XLA's column-sequential
+    cholesky/triangular_solve lowering is the training-loop bottleneck at
+    six-figure batch sizes); 'xla' forces the lax lowering.
     """
     r = A.shape[-1]
     eye = jnp.eye(r, dtype=A.dtype)
     empty = (count <= 0)[:, None, None]
     A = jnp.where(empty, eye, A) + jitter * eye
+    if backend == "auto":
+        from tpu_als.utils.platform import on_tpu
+
+        backend = "pallas" if on_tpu() else "xla"
+    if backend == "pallas":
+        from tpu_als.ops.pallas_solve import spd_solve_pallas
+
+        return spd_solve_pallas(A, b)
     L = jnp.linalg.cholesky(A)
     y = jax.scipy.linalg.solve_triangular(L, b[..., None], lower=True)
     x = jax.scipy.linalg.solve_triangular(

@@ -58,28 +58,16 @@ def chunked_topk_scores(U, V, item_valid, k, item_chunk=8192):
     return best_s, best_i
 
 
-def _on_tpu():
-    try:
-        d = jax.devices()[0]
-    except RuntimeError:
-        return False
-    # the axon plugin reports backend 'axon' but TPU device kinds; accept
-    # either signal so the Pallas path engages on tunneled chips too
-    return (
-        jax.default_backend() == "tpu"
-        or d.platform == "tpu"
-        or "tpu" in d.device_kind.lower()
-    )
-
-
 def topk_scores(U, V, item_valid, k, item_chunk=8192, backend="auto"):
     """Top-k dispatch: the fused Pallas kernel on TPU (scores never touch
     HBM — tpu_als.ops.pallas_topk), the XLA scan elsewhere.
 
     backend: 'auto' | 'pallas' | 'xla'.
     """
+    from tpu_als.utils.platform import on_tpu
+
     if backend == "auto":
-        backend = "pallas" if (_on_tpu() and k <= 128) else "xla"
+        backend = "pallas" if (on_tpu() and k <= 128) else "xla"
     if backend == "pallas":
         from tpu_als.ops.pallas_topk import topk_scores_pallas
 

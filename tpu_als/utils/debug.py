@@ -1,0 +1,81 @@
+"""Numerical-safety tooling (SURVEY.md §5.2).
+
+The reference stack gets race freedom structurally (JVM memory safety +
+immutable RDD lineage) and numerical issues surface as NaN RMSE printouts.
+JAX's functional purity gives the same structural race freedom; this module
+adds the active checks:
+
+  * :func:`debug_mode` — context manager enabling ``jax_debug_nans`` (and
+    optionally disabling jit) so the first NaN-producing primitive raises
+    with a usable stack instead of poisoning the factors silently.
+  * :func:`checked_predict` — ``checkify``-wrapped scoring kernel that turns
+    out-of-range id gathers into reported errors instead of clamped reads
+    (the production ``predict`` clamps + masks to NaN; this is the test-mode
+    oracle that the masking is actually hiding nothing).
+  * :func:`assert_all_finite` — host-side factor audit for callbacks.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+import numpy as np
+
+
+@contextmanager
+def debug_mode(nans=True, disable_jit=False):
+    """Enable fail-fast numerics for the enclosed block.
+
+    ``nans=True`` makes any primitive producing NaN raise immediately
+    (re-running the offending op un-jitted for a precise traceback);
+    ``disable_jit=True`` additionally runs everything op-by-op.
+    """
+    prev_nans = jax.config.jax_debug_nans
+    try:
+        if nans:
+            jax.config.update("jax_debug_nans", True)
+        if disable_jit:
+            with jax.disable_jit():
+                yield
+        else:
+            yield
+    finally:
+        jax.config.update("jax_debug_nans", prev_nans)
+
+
+def _predict_checked(U, V, u_idx, i_idx):
+    checkify.check(jnp.all(u_idx >= 0), "negative user index")
+    checkify.check(jnp.all(u_idx < U.shape[0]),
+                   "user index out of range")
+    checkify.check(jnp.all(i_idx >= 0), "negative item index")
+    checkify.check(jnp.all(i_idx < V.shape[0]),
+                   "item index out of range")
+    return jnp.einsum("nr,nr->n", U[u_idx], V[i_idx])
+
+
+def checked_predict(U, V, u_idx, i_idx):
+    """Gather-dot scoring with hard index-bounds checks.
+
+    Returns the scores; raises ``checkify.JaxRuntimeError`` on any
+    out-of-range id.  Use in tests/debugging; the production path
+    (tpu_als.core.als.predict) masks invalid ids to NaN instead.
+    """
+    checked = checkify.checkify(jax.jit(_predict_checked))
+    err, out = checked(U, V, jnp.asarray(u_idx), jnp.asarray(i_idx))
+    err.throw()
+    return out
+
+
+def assert_all_finite(iteration, U, V):
+    """Fit-callback form: raise if any factor entry is non-finite."""
+    for name, X in (("U", U), ("V", V)):
+        bad = ~np.isfinite(np.asarray(X))
+        if bad.any():
+            raise FloatingPointError(
+                f"non-finite {name} factors at iteration {iteration}: "
+                f"{int(bad.sum())} entries (first row "
+                f"{int(np.argwhere(bad)[0][0])})")

@@ -1,0 +1,22 @@
+"""Platform detection shared by the Pallas/XLA kernel dispatchers."""
+
+from __future__ import annotations
+
+import jax
+
+
+def on_tpu():
+    """True when the default JAX backend drives a TPU chip.
+
+    The axon plugin (tunneled TPU in this environment) reports backend name
+    'axon' but TPU device kinds; accept either signal.
+    """
+    try:
+        d = jax.devices()[0]
+    except RuntimeError:
+        return False
+    return (
+        jax.default_backend() == "tpu"
+        or d.platform == "tpu"
+        or "tpu" in d.device_kind.lower()
+    )

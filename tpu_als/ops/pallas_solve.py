@@ -5,13 +5,22 @@ systems (rank×rank, one per entity).  XLA lowers ``jnp.linalg.cholesky`` /
 ``triangular_solve`` on TPU as column-sequential panel algorithms over HBM
 operands — for [221k, 128, 128] batches that serial chain dominates the
 whole training iteration.  This kernel keeps a tile of matrices resident in
-VMEM and factorizes them there:
+VMEM and factorizes them there.
 
-  * right-looking blocked Cholesky, panel width P: the within-panel rank-1
-    updates are VPU work on a [TN, r, P] panel block, the trailing update is
-    ONE batched [TN,r,P]x[TN,P,r] MXU contraction per panel;
-  * forward/backward substitution vectorized over the batch dim.
+Mosaic (the Pallas TPU compiler) cannot slice the lane (last) dimension at
+offsets that are not multiples of 128, so the kernel never slices lanes:
 
+  * panels are **rows** of the working matrix (sublane dimension, static
+    offsets from a Python-unrolled panel loop) — valid because right-looking
+    Cholesky keeps the trailing submatrix symmetric, so a column panel of
+    the trailing block equals its row panel;
+  * single columns are extracted with iota masks + reductions, and panel
+    (lane-window) extraction uses one-hot selector matmuls on the MXU;
+  * the factor is written to a second scratch as **Lᵀ** (column j of L
+    stored as row j), so forward/backward substitution also read rows.
+
+Within-panel rank-1 updates are VPU work on a [TN, P, r] row panel; the
+trailing update is ONE batched [TN,P,r]ᵀ[TN,P,r] MXU contraction per panel.
 Everything is masked static-shape arithmetic — no data-dependent control
 flow.  Replaces the per-entity LAPACK ``dppsv`` of the reference stack
 (Spark MLlib ``CholeskySolver``, SURVEY.md §2.B5/C1) at the opposite end of
@@ -31,102 +40,128 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _chol_solve_kernel(A_ref, b_ref, x_ref, S, *, r, panel):
-    """One batch tile: factorize A (in VMEM scratch S) and solve.
+def _chol_solve_kernel(A_ref, b_ref, x_ref, S, LT, *, r, panel):
+    """One batch tile: factorize A and solve.
 
-    A_ref [TN, r, r]; b_ref [TN, r]; x_ref [TN, r]; S [TN, r, r] scratch.
+    A_ref [TN, r, r]; b_ref [TN, r]; x_ref [TN, r].
+    S  [TN, r, r] scratch: the symmetric trailing matrix (rows above the
+       current panel become stale garbage — never read again).
+    LT [TN, r, r] scratch: LT[t, j, i] = L[i, j] (column j of L on row j).
     """
     S[:] = A_ref[:]
     tn = A_ref.shape[0]
-    row_i = jax.lax.broadcasted_iota(jnp.int32, (tn, r, 1), 1)
-    prow = jax.lax.broadcasted_iota(jnp.int32, (tn, r, panel), 1)
-    pcol = jax.lax.broadcasted_iota(jnp.int32, (tn, r, panel), 2)
+    n_panels = r // panel
 
-    def do_panel(pi, _):
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tn, r), 1)          # [TN, r]
+    sub_p = jax.lax.broadcasted_iota(jnp.int32, (tn, panel, r), 1)  # k index
+    lane_p = jax.lax.broadcasted_iota(jnp.int32, (tn, panel, r), 2)
+    aidx = jax.lax.broadcasted_iota(jnp.int32, (tn, panel), 1)      # [TN, P]
+    g_sub = jax.lax.broadcasted_iota(jnp.int32, (tn, panel, panel), 1)
+    g_lane = jax.lax.broadcasted_iota(jnp.int32, (tn, panel, panel), 2)
+    sel_r = jax.lax.broadcasted_iota(jnp.int32, (r, panel), 0)
+    sel_p = jax.lax.broadcasted_iota(jnp.int32, (r, panel), 1)
+
+    def selector(p):
+        """One-hot [r, P]: sel[c, k] = (c == p + k).  Static p."""
+        return (sel_r == p + sel_p).astype(jnp.float32)
+
+    # ---- factorization: right-looking blocked Cholesky ----
+    for pi in range(n_panels):
         p = pi * panel
-        blk = S[:, :, pl.ds(p * 1, panel)]  # [TN, r, panel]
+        sel = selector(p)
+        # row panel of the (symmetric) trailing matrix == column panel,
+        # transposed: blkT[t, k, i] = A_trail[i, p+k]
+        blkT = S[:, p:p + panel, :]
 
-        # [r, P] selector picking rows p..p+P-1 (one-hot matmul: dynamic
-        # lane-offset slicing is not a thing on TPU, a tiny MXU dot is)
-        sel = (
-            jax.lax.broadcasted_iota(jnp.int32, (r, panel), 0)
-            == p + jax.lax.broadcasted_iota(jnp.int32, (r, panel), 1)
-        ).astype(jnp.float32)
-
-        def do_col(jj, blk):
+        def do_col(jj, blkT, p=p, sel=sel):
             j = p + jj
-            onecol = pcol == jj
-            onerow_j = prow == j
-            # d = sqrt(A[j,j]); column j scaled by 1/d, zeroed above row j
-            col = jnp.sum(jnp.where(onecol, blk, 0.0), axis=2)  # [TN, r]
-            d2 = jnp.sum(jnp.where(onerow_j[:, :, 0:1] & onecol, blk, 0.0),
-                         axis=(1, 2))  # [TN]
-            inv = jax.lax.rsqrt(jnp.maximum(d2, 1e-30))  # [TN]
-            ncol = col * inv[:, None]
-            ncol = jnp.where(row_i[:, :, 0] >= j, ncol, 0.0)
-            # rank-1 update of the panel columns right of j (VPU):
-            #   blk[:, :, k] -= ncol * L[p+k, j],  L[p+k, j] = ncol[p:p+P]
-            ncol_panel = jnp.dot(ncol, sel,
-                                 preferred_element_type=jnp.float32)
-            upd = ncol[:, :, None] * ncol_panel[:, None, :]
-            blk = jnp.where(pcol > jj, blk - upd, blk)
-            # write the finished column back into the panel block
-            blk = jnp.where(onecol, ncol[:, :, None], blk)
-            return blk
+            col = jnp.sum(jnp.where(sub_p == jj, blkT, 0.0), axis=1)  # [TN,r]
+            d2 = jnp.sum(jnp.where(lane == j, col, 0.0), axis=1)
+            inv = jax.lax.rsqrt(jnp.maximum(d2, 1e-30))
+            ncol = jnp.where(lane >= j, col * inv[:, None], 0.0)
+            # ncol at the panel's own lanes, via one-hot MXU dot
+            npanel = jnp.dot(ncol, sel, preferred_element_type=jnp.float32)
+            upd = npanel[:, :, None] * ncol[:, None, :]       # [TN, P, r]
+            blkT = jnp.where(sub_p > jj, blkT - upd, blkT)
+            blkT = jnp.where(sub_p == jj, ncol[:, None, :], blkT)
+            return blkT
 
-        blk = jax.lax.fori_loop(0, panel, do_col, blk)
-        # L panel, zeroed above the diagonal (per-column global row >= col)
-        Lp = jnp.where(prow >= p + pcol, blk, 0.0)
-        S[:, :, pl.ds(p * 1, panel)] = Lp
-        # trailing update (MXU): S[:, :, k] -= sum_j Lp[:, :, j] Lp[:, k, j]
-        # for k >= p+panel (mask; rows above the diagonal become garbage the
-        # later panels never read)
-        upd = jax.lax.dot_general(
-            Lp, Lp, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        blkT = jax.lax.fori_loop(0, panel, do_col, blkT)
+        # zero above the diagonal: L[i, p+k] lives at lane i >= p+k
+        LpT = jnp.where(lane_p >= p + sub_p, blkT, 0.0)
+        LT[:, p:p + panel, :] = LpT
+        if pi + 1 < n_panels:
+            # trailing update (MXU): S[t,i,i'] -= Σ_k L[i,p+k] L[i',p+k]
+            upd = jax.lax.dot_general(
+                LpT, LpT, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )  # [TN, r, r]
+            S[:] = S[:] - upd
+
+    # ---- forward substitution: L y = b (panel-blocked, row reads) ----
+    res = b_ref[:]
+    for pi in range(n_panels):
+        p = pi * panel
+        sel = selector(p)
+        LpT = LT[:, p:p + panel, :]             # LpT[t,k,i] = L[i, p+k]
+        # diag block via one-hot MXU: G[t,k,a] = L[p+a, p+k]
+        G = jnp.dot(
+            LpT.reshape(tn * panel, r), sel,
             preferred_element_type=jnp.float32,
-        )  # [TN, r, r]
-        col_k = jax.lax.broadcasted_iota(jnp.int32, (tn, r, r), 2)
-        S[:] = jnp.where(col_k >= p + panel, S[:] - upd, S[:])
-        return 0
+        ).reshape(tn, panel, panel)
+        rhs = jnp.dot(res, sel, preferred_element_type=jnp.float32)  # [TN,P]
 
-    jax.lax.fori_loop(0, r // panel, do_panel, 0)
+        def fwd_col(jj, rhs, G=G):
+            # column jj of the diag block, indexed by row a: G[t, jj, a]
+            colj = jnp.sum(jnp.where(g_sub == jj, G, 0.0), axis=1)
+            d = jnp.sum(jnp.where(aidx == jj, colj, 0.0), axis=1)
+            yj = jnp.sum(jnp.where(aidx == jj, rhs, 0.0), axis=1) / d
+            rhs = jnp.where(aidx > jj, rhs - yj[:, None] * colj, rhs)
+            rhs = jnp.where(aidx == jj, yj[:, None], rhs)
+            return rhs
 
-    # ---- forward substitution: L y = b ----
-    ridx = jax.lax.broadcasted_iota(jnp.int32, (tn, r), 1)
+        y_p = jax.lax.fori_loop(0, panel, fwd_col, rhs)     # [TN, P]
+        # apply to lanes below the panel: upd[t,i] = Σ_k y[t,k] L[i, p+k]
+        upd = jnp.sum(y_p[:, :, None] * LpT, axis=1)        # [TN, r]
+        y_full = jnp.dot(y_p, sel.T, preferred_element_type=jnp.float32)
+        res = jnp.where(lane >= p + panel, res - upd, res)
+        res = jnp.where((lane >= p) & (lane < p + panel), y_full, res)
 
-    def fwd(j, res):
-        onej = ridx == j
-        colj = S[:, :, pl.ds(j * 1, 1)][:, :, 0]  # [TN, r] (zero above j)
-        d = jnp.sum(jnp.where(onej, colj, 0.0), axis=1)  # L[j,j]
-        yj = jnp.sum(jnp.where(onej, res, 0.0), axis=1) / d
-        # subtract yj * L[:, j] from the remaining rows (> j)
-        res = jnp.where(ridx > j, res - yj[:, None] * colj, res)
-        # store yj at position j
-        res = jnp.where(onej, yj[:, None], res)
-        return res
+    # ---- backward substitution: Lᵀ x = y (LT rows ARE Lᵀ rows) ----
+    for pi in range(n_panels - 1, -1, -1):
+        p = pi * panel
+        sel = selector(p)
+        UpT = LT[:, p:p + panel, :]             # UpT[t,k,i] = Lᵀ[p+k, i]
+        # contributions of already-solved lanes (>= p+P)
+        xm = jnp.where(lane >= p + panel, res, 0.0)
+        contrib = jnp.sum(UpT * xm[:, None, :], axis=2)     # [TN, P]
+        rhs = jnp.dot(res, sel, preferred_element_type=jnp.float32) - contrib
+        G = jnp.dot(
+            UpT.reshape(tn * panel, r), sel,
+            preferred_element_type=jnp.float32,
+        ).reshape(tn, panel, panel)             # G[t,k,a] = Lᵀ[p+k, p+a]
 
-    y = jax.lax.fori_loop(0, r, fwd, b_ref[:])
+        def bwd_col(tt, rhs, G=G):
+            jj = panel - 1 - tt
+            # column jj of the diag block, indexed by row k: G[t, k, jj]
+            colj = jnp.sum(jnp.where(g_lane == jj, G, 0.0), axis=2)
+            d = jnp.sum(jnp.where(aidx == jj, colj, 0.0), axis=1)
+            xj = jnp.sum(jnp.where(aidx == jj, rhs, 0.0), axis=1) / d
+            rhs = jnp.where(aidx < jj, rhs - xj[:, None] * colj, rhs)
+            rhs = jnp.where(aidx == jj, xj[:, None], rhs)
+            return rhs
 
-    # ---- backward substitution: Lᵀ x = y ----
-    def bwd(t, res):
-        j = r - 1 - t
-        onej = ridx == j
-        colj = S[:, :, pl.ds(j * 1, 1)][:, :, 0]
-        d = jnp.sum(jnp.where(onej, colj, 0.0), axis=1)
-        xj = jnp.sum(jnp.where(onej, res, 0.0), axis=1) / d
-        # (Lᵀ)[i, j] = L[j, i] → subtract xj * L[j, :] from rows < j
-        rowj = jnp.sum(
-            jnp.where(row_i == j, S[:], 0.0), axis=1
-        )  # [TN, r] row j of L (zero right of j)
-        res = jnp.where(ridx < j, res - xj[:, None] * rowj, res)
-        res = jnp.where(onej, xj[:, None], res)
-        return res
+        x_p = jax.lax.fori_loop(0, panel, bwd_col, rhs)
+        x_full = jnp.dot(x_p, sel.T, preferred_element_type=jnp.float32)
+        res = jnp.where((lane >= p) & (lane < p + panel), x_full, res)
 
-    x_ref[:] = jax.lax.fori_loop(0, r, bwd, y)
+    x_ref[:] = res
 
 
-def _tile_n(r_pad, budget_elems=1 << 21):
-    """Batch-tile so the [TN, r, r] scratch stays within ~8 MB of VMEM."""
+def _tile_n(r_pad, budget_elems=1 << 19):
+    """Batch-tile so each [TN, r, r] VMEM buffer stays within ~2 MB: the
+    A block is double-buffered by the pipeline and there are two scratches,
+    so ~4 such buffers must fit the default 16 MiB scoped-VMEM limit."""
     tn = max(8, budget_elems // (r_pad * r_pad))
     return 1 << (tn.bit_length() - 1)
 
@@ -138,6 +173,8 @@ def spd_solve_pallas(A, b, panel=32, interpret=False):
     Caller must pre-regularize A (SPD with jitter; identity for empty rows)
     — same contract as the XLA path in tpu_als.ops.solve.solve_spd.
     """
+    if panel % 8:
+        raise ValueError("panel must be a multiple of 8 (TPU sublane tile)")
     N, r = b.shape
     r_pad = max(panel, -(-r // panel) * panel)
     tn = _tile_n(r_pad)
@@ -167,7 +204,8 @@ def spd_solve_pallas(A, b, panel=32, interpret=False):
         out_specs=pl.BlockSpec((tn, r_pad), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n_pad, r_pad), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((tn, r_pad, r_pad), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((tn, r_pad, r_pad), jnp.float32),
+                        pltpu.VMEM((tn, r_pad, r_pad), jnp.float32)],
         cost_estimate=pl.CostEstimate(
             flops=int(n_pad * (r_pad ** 3 / 3 + 2 * r_pad ** 2)),
             bytes_accessed=(n_pad * r_pad * r_pad + 2 * n_pad * r_pad) * 4,
@@ -176,3 +214,37 @@ def spd_solve_pallas(A, b, panel=32, interpret=False):
         interpret=interpret,
     )(Ap, bp)
     return x[:N, :r]
+
+
+_AVAILABLE = {}  # r_pad -> bool, probed once per process per padded rank
+
+
+def available(rank=128, panel=32):
+    """True when the kernel actually compiles AND runs on the local TPU's
+    Mosaic version **at this rank** — probed once per process per padded
+    rank with a tiny instance (VMEM budgets and Mosaic lowering both depend
+    on the rank, so a rank-128 success must not green-light rank 384).
+    Off-TPU this is False; use ``interpret=True`` there.
+    solve_spd(backend='auto') consults this so a Mosaic regression degrades
+    to the XLA lowering instead of crashing training.
+    """
+    r_pad = max(panel, -(-rank // panel) * panel)
+    if r_pad not in _AVAILABLE:
+        from tpu_als.utils.platform import on_tpu
+
+        if not on_tpu():
+            _AVAILABLE[r_pad] = False
+            return False
+        try:
+            import numpy as np
+
+            n, r = 8, r_pad
+            A = jnp.asarray(np.eye(r, dtype=np.float32)[None].repeat(n, 0))
+            b = jnp.asarray(np.ones((n, r), np.float32))
+            x = spd_solve_pallas(A, b, panel=panel)
+            x.block_until_ready()
+            _AVAILABLE[r_pad] = bool(np.allclose(np.asarray(x), 1.0,
+                                                 atol=1e-4))
+        except Exception:  # Mosaic compile/runtime failure → XLA fallback
+            _AVAILABLE[r_pad] = False
+    return _AVAILABLE[r_pad]

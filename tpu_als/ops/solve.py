@@ -100,16 +100,20 @@ def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
     backend: 'auto' routes to the VMEM-resident Pallas blocked-Cholesky
     kernel on TPU (tpu_als.ops.pallas_solve — XLA's column-sequential
     cholesky/triangular_solve lowering is the training-loop bottleneck at
-    six-figure batch sizes); 'xla' forces the lax lowering.
+    six-figure batch sizes) when the kernel is known-good on the local
+    Mosaic version (see pallas_solve.available()); 'xla' forces the lax
+    lowering; 'pallas' forces the kernel.
     """
     r = A.shape[-1]
     eye = jnp.eye(r, dtype=A.dtype)
     empty = (count <= 0)[:, None, None]
     A = jnp.where(empty, eye, A) + jitter * eye
     if backend == "auto":
+        from tpu_als.ops import pallas_solve
         from tpu_als.utils.platform import on_tpu
 
-        backend = "pallas" if on_tpu() else "xla"
+        backend = ("pallas" if (on_tpu() and pallas_solve.available(r))
+                   else "xla")
     if backend == "pallas":
         from tpu_als.ops.pallas_solve import spd_solve_pallas
 

@@ -57,6 +57,9 @@ def _predict_checked(U, V, u_idx, i_idx):
     return jnp.einsum("nr,nr->n", U[u_idx], V[i_idx])
 
 
+_checked_predict = checkify.checkify(jax.jit(_predict_checked))
+
+
 def checked_predict(U, V, u_idx, i_idx):
     """Gather-dot scoring with hard index-bounds checks.
 
@@ -64,8 +67,7 @@ def checked_predict(U, V, u_idx, i_idx):
     out-of-range id.  Use in tests/debugging; the production path
     (tpu_als.core.als.predict) masks invalid ids to NaN instead.
     """
-    checked = checkify.checkify(jax.jit(_predict_checked))
-    err, out = checked(U, V, jnp.asarray(u_idx), jnp.asarray(i_idx))
+    err, out = _checked_predict(U, V, jnp.asarray(u_idx), jnp.asarray(i_idx))
     err.throw()
     return out
 

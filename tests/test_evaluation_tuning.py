@@ -141,3 +141,37 @@ def test_legacy_save_load(rng, tmp_path):
     loaded = MatrixFactorizationModel.load(path)
     assert loaded.predict(int(u[0]), int(i[0])) == pytest.approx(
         model.predict(int(u[0]), int(i[0])), rel=1e-5)
+
+
+def test_tuned_model_save_load(rng, tmp_path):
+    """CrossValidatorModel / TrainValidationSplitModel persistence — the
+    reference's tuning models are MLWritable (SURVEY.md §2.B12/§2.B11)."""
+    from tpu_als.api.tuning import (
+        CrossValidatorModel,
+        TrainValidationSplit,
+        TrainValidationSplitModel,
+    )
+
+    u, i, r, _, _ = make_ratings(np.random.default_rng(11), 40, 30,
+                                 rank=2, density=0.4)
+    frame = {"user": u, "item": i, "rating": r}
+    est = ALS(rank=2, maxIter=2, regParam=0.05, seed=0)
+    ev = RegressionEvaluator(labelCol="rating")
+    grid = ParamGridBuilder().addGrid(est.getParam("rank"), [2, 3]).build()
+    tvs = TrainValidationSplit(estimator=est, estimatorParamMaps=grid,
+                               evaluator=ev, trainRatio=0.8, seed=0)
+    model = tvs.fit(frame)
+    p = tmp_path / "tvs"
+    model.save(str(p))
+    back = TrainValidationSplitModel.load(str(p))
+    assert back.validationMetrics == model.validationMetrics
+    np.testing.assert_allclose(
+        np.asarray(back.bestModel.transform(frame)["prediction"]),
+        np.asarray(model.transform(frame)["prediction"]), rtol=1e-6)
+
+    cvm = CrossValidatorModel(model.bestModel, [0.5, 0.4], [[0.5], [0.4]])
+    p2 = tmp_path / "cv"
+    cvm.save(str(p2))
+    back2 = CrossValidatorModel.load(str(p2))
+    assert back2.avgMetrics == [0.5, 0.4]
+    assert back2.foldMetrics == [[0.5], [0.4]]

@@ -1,0 +1,43 @@
+"""Mesh helpers: slice-major device ordering for multi-slice (DCN)
+deployments, and the DCN-boundary accounting the ring cost model uses."""
+
+from dataclasses import dataclass
+
+from tpu_als.parallel.mesh import (
+    make_mesh,
+    order_devices_slice_major,
+    slice_boundaries,
+)
+
+
+@dataclass
+class FakeDev:
+    id: int
+    slice_index: int = None
+
+
+def test_single_slice_order_preserved():
+    devs = [FakeDev(3), FakeDev(1), FakeDev(2)]
+    assert order_devices_slice_major(devs) == devs
+    assert slice_boundaries(devs) == []
+
+
+def test_multi_slice_groups_contiguous():
+    # interleaved arrival order, two slices of 3
+    devs = [FakeDev(0, 0), FakeDev(3, 1), FakeDev(1, 0),
+            FakeDev(4, 1), FakeDev(2, 0), FakeDev(5, 1)]
+    out = order_devices_slice_major(devs)
+    assert [d.slice_index for d in out] == [0, 0, 0, 1, 1, 1]
+    assert [d.id for d in out] == [0, 1, 2, 3, 4, 5]
+    assert slice_boundaries(devs) == [3]
+
+
+def test_mixed_none_slice_index_sorts_first():
+    devs = [FakeDev(0, 1), FakeDev(1, None), FakeDev(2, 0)]
+    out = order_devices_slice_major(devs)
+    assert [d.id for d in out] == [1, 2, 0]
+
+
+def test_make_mesh_runs_on_cpu_devices():
+    mesh = make_mesh(4)
+    assert mesh.devices.size == 4

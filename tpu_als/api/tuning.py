@@ -11,10 +11,39 @@ itself a TPU training run.
 from __future__ import annotations
 
 import itertools
+import json
+import os
 
 import numpy as np
 
 from tpu_als.utils.frame import as_frame
+
+
+def _save_tuned(model, path, metrics_payload):
+    """Shared persistence: best model via its own save + JSON metrics
+    (the analog of ``DefaultParamsWriter`` metadata, SURVEY.md §2.B11).
+    The best model's class is recorded so load restores the right type."""
+    os.makedirs(path, exist_ok=True)
+    model.bestModel.save(os.path.join(path, "bestModel"))
+    cls = type(model.bestModel)
+    metrics_payload["modelClass"] = f"{cls.__module__}.{cls.__qualname__}"
+    with open(os.path.join(path, "tuning.json"), "w") as f:
+        json.dump(metrics_payload, f)
+
+
+def _load_tuned(path, kind):
+    import importlib
+
+    with open(os.path.join(path, "tuning.json")) as f:
+        meta = json.load(f)
+    if meta.get("kind") != kind:
+        raise ValueError(
+            f"{path} holds a {meta.get('kind')!r} tuning save, not {kind!r}")
+    mod, _, name = meta.get(
+        "modelClass", "tpu_als.api.estimator.ALSModel").rpartition(".")
+    model_cls = getattr(importlib.import_module(mod), name)
+    best = model_cls.load(os.path.join(path, "bestModel"))
+    return best, meta
 
 
 class ParamGridBuilder:
@@ -99,6 +128,15 @@ class CrossValidatorModel:
     def transform(self, dataset):
         return self.bestModel.transform(dataset)
 
+    def save(self, path):
+        _save_tuned(self, path, {"kind": "cv", "avgMetrics": self.avgMetrics,
+                                 "foldMetrics": self.foldMetrics})
+
+    @classmethod
+    def load(cls, path):
+        best, meta = _load_tuned(path, "cv")
+        return cls(best, meta["avgMetrics"], meta.get("foldMetrics"))
+
 
 class TrainValidationSplit(_ValidatorBase):
     """Single split tuning — ``trainRatio`` of the data trains, the rest
@@ -128,3 +166,13 @@ class TrainValidationSplitModel:
 
     def transform(self, dataset):
         return self.bestModel.transform(dataset)
+
+    def save(self, path):
+        _save_tuned(self, path,
+                    {"kind": "tvs", "validationMetrics":
+                     self.validationMetrics})
+
+    @classmethod
+    def load(cls, path):
+        best, meta = _load_tuned(path, "tvs")
+        return cls(best, meta["validationMetrics"])

@@ -216,7 +216,7 @@ def spd_solve_pallas(A, b, panel=32, interpret=False):
     return x[:N, :r]
 
 
-_AVAILABLE = {}  # r_pad -> bool, probed once per process per padded rank
+_AVAILABLE = {}  # (r_pad, panel) -> bool, probed once per process
 
 
 def available(rank=128, panel=32):
@@ -229,11 +229,12 @@ def available(rank=128, panel=32):
     to the XLA lowering instead of crashing training.
     """
     r_pad = max(panel, -(-rank // panel) * panel)
-    if r_pad not in _AVAILABLE:
+    cache_key = (r_pad, panel)
+    if cache_key not in _AVAILABLE:
         from tpu_als.utils.platform import on_tpu
 
         if not on_tpu():
-            _AVAILABLE[r_pad] = False
+            _AVAILABLE[cache_key] = False
             return False
         try:
             import numpy as np
@@ -243,8 +244,8 @@ def available(rank=128, panel=32):
             b = jnp.asarray(np.ones((n, r), np.float32))
             x = spd_solve_pallas(A, b, panel=panel)
             x.block_until_ready()
-            _AVAILABLE[r_pad] = bool(np.allclose(np.asarray(x), 1.0,
-                                                 atol=1e-4))
+            _AVAILABLE[cache_key] = bool(np.allclose(np.asarray(x), 1.0,
+                                                     atol=1e-4))
         except Exception:  # Mosaic compile/runtime failure → XLA fallback
-            _AVAILABLE[r_pad] = False
-    return _AVAILABLE[r_pad]
+            _AVAILABLE[cache_key] = False
+    return _AVAILABLE[cache_key]

@@ -1,0 +1,136 @@
+"""Ragged ``all_to_all`` gather strategy (the Ulysses-style exchange).
+
+Third member of the gather-strategy triad (SURVEY.md §5.7/§5.8, build plan
+M6) next to ``all_gather`` (tpu_als.parallel.trainer) and ``ring``
+(tpu_als.parallel.comm):
+
+- **all_gather** moves ``N_opposite × rank`` floats to every device and
+  peaks HBM at the full opposite factor matrix.
+- **ring** moves the same bytes but never materializes the full matrix.
+- **all_to_all** (this module) moves only the factor rows each device
+  actually references: device d receives, from each source shard s, exactly
+  the rows its rating block touches.  When interactions are clustered (each
+  user block rates a small item subset — the regime where Spark's OutBlock
+  "send only active rows" optimization wins, SURVEY.md §2.B4), both bytes
+  moved AND peak HBM drop below the gather/ring strategies.
+
+Mechanics: the request lists are computed host-side once (they depend only
+on the rating layout), padded to a uniform per-(src,dst) budget ``R`` so the
+exchange is one static-shape ``jax.lax.all_to_all`` over the mesh axis.
+Column ids in the rating shards are pre-remapped to **compact** ids
+``s·R + position`` indexing the received ``[D·R, rank]`` table, so after the
+exchange the half-step is the unchanged ``local_half_step``.  This is the
+TPU analog of Spark ALS's OutBlock machinery: the reference stack computes,
+per user block, which factor rows each item block needs and ships only
+those through the shuffle — here the "shuffle" is a single XLA collective
+and the routing tables are baked into the compiled step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+
+from tpu_als.core.als import local_half_step
+from tpu_als.core.ratings import build_csr_buckets
+from tpu_als.parallel.data import stack_shards
+from tpu_als.parallel.mesh import AXIS
+
+
+@dataclass
+class A2aCsr:
+    """Rating shards + routing tables for one side's half-step.
+
+    buckets arrays are [D, nb, w] (cols hold compact recv-table ids);
+    send_idx [D_src, D_dst, R]: local factor-row indices on the source
+    shard requested by each destination (0-padded; padding rows are never
+    referenced by any compact col id).
+    """
+
+    buckets: list
+    send_idx: np.ndarray
+    rows_per_shard: int
+    request_budget: int  # R
+    chunk_elems: int
+    nnz: int
+
+    def device_buckets(self):
+        return list(self.buckets)
+
+
+def build_a2a(row_part, col_part, row_idx, col_idx, vals,
+              min_width=8, chunk_elems=1 << 19):
+    """Build rating shards with compact column ids + the exchange plan.
+
+    row_part/col_part: Partition for the solved side / the gathered side
+    (tpu_als.parallel.data).  Requires ``row_part.n_shards ==
+    col_part.n_shards`` (one mesh axis drives the exchange).
+    """
+    D = row_part.n_shards
+    if col_part.n_shards != D:
+        raise ValueError("all_to_all requires equal shard counts per side")
+    row_idx = np.asarray(row_idx)
+    col_idx = np.asarray(col_idx)
+    vals = np.asarray(vals)
+    owner_r = row_part.owner[row_idx]
+    local_r = row_part.local[row_idx]
+    owner_c = col_part.owner[col_idx]
+    local_c = col_part.local[col_idx].astype(np.int64)
+    rps = col_part.rows_per_shard
+
+    # unique (dst, src, local_col) triples, sorted — positions within each
+    # (dst, src) group become the slot in that destination's request list
+    key = (owner_r.astype(np.int64) * D + owner_c) * rps + local_c
+    uniq, inv = np.unique(key, return_inverse=True)
+    grp = (uniq // rps).astype(np.int64)            # dst*D + src, sorted
+    loc = (uniq % rps).astype(np.int64)
+    starts = np.searchsorted(grp, np.arange(D * D))
+    pos = np.arange(len(uniq)) - starts[grp]
+    # uniform request budget, padded to a sublane multiple
+    R = int(pos.max()) + 1 if len(uniq) else 1
+    R = max(8, -(-R // 8) * 8)
+
+    send_idx = np.zeros((D, D, R), dtype=np.int32)
+    dst = grp // D
+    src = grp % D
+    send_idx[src, dst, pos] = loc
+
+    # compact col id per rating: src_shard * R + request position
+    compact = (owner_c.astype(np.int64) * R + pos[inv]).astype(np.int64)
+
+    shards = []
+    for d in range(D):
+        sel = owner_r == d
+        shards.append(build_csr_buckets(
+            local_r[sel], compact[sel], vals[sel],
+            num_rows=row_part.rows_per_shard,
+            min_width=min_width, chunk_elems=chunk_elems,
+        ))
+    stacked = stack_shards(shards, chunk_elems)
+    return A2aCsr(
+        buckets=stacked.buckets,
+        send_idx=send_idx,
+        rows_per_shard=row_part.rows_per_shard,
+        request_budget=R,
+        chunk_elems=chunk_elems,
+        nnz=len(row_idx),
+    )
+
+
+def a2a_half_step(V_loc, send_idx, buckets, num_rows, cfg, chunk_elems,
+                  YtY=None):
+    """One half-step with the ragged exchange (inside ``shard_map``).
+
+    V_loc [per_opposite, r]: this device's shard of the opposite factors.
+    send_idx [D, R]: this device's outgoing request lists (one per dst).
+    The exchange builds the compact [D·R, r] recv table the rating shards'
+    col ids index; the solve is the shared ``local_half_step``.
+    """
+    Vsend = V_loc[send_idx]                                    # [D, R, r]
+    Vrecv = jax.lax.all_to_all(Vsend, AXIS, split_axis=0, concat_axis=0)
+    V_compact = Vrecv.reshape(-1, V_loc.shape[-1])             # [D*R, r]
+    return local_half_step(V_compact, buckets, num_rows, cfg, YtY,
+                           chunk_elems)

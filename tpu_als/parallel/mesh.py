@@ -4,9 +4,19 @@ The reference stack's scale-out substrate is Spark's cluster runtime
 (executors + netty RPC + sort shuffle, SURVEY.md §2.B8/§2.C2).  Here the
 substrate is a 1-D ``jax.sharding.Mesh`` with a single ``"d"`` axis: user
 factors, item factors, and rating shards are all partitioned along it, and
-each ALS half-step all-gathers the opposite factor shard over ICI (ring
-``ppermute`` streaming at the scale where a full gather no longer fits —
-tpu_als.parallel.comm).
+each ALS half-step either all-gathers the opposite factor shard, streams it
+around a ``ppermute`` ring, or exchanges referenced rows with
+``all_to_all`` (tpu_als.parallel.{trainer,comm,a2a}).
+
+Multi-slice (DCN) awareness: on a multi-slice deployment the devices of one
+slice share ICI while slices talk over the much slower data-center network.
+All three gather strategies move data between *neighboring* positions of
+the 1-D axis (a ring permute, or the segment layout of an all_gather), so
+the whole DCN story reduces to **device order**: :func:`make_mesh` orders
+devices slice-major (all of slice 0, then slice 1, …), which makes ring
+neighbors ICI-local with exactly one DCN hop per slice boundary and lets
+XLA schedule the intra-slice part of each collective on ICI.  This mirrors the
+scaling-book recipe: pick the mesh so collectives ride ICI, not DCN.
 """
 
 from __future__ import annotations
@@ -18,13 +28,41 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
 AXIS = "d"
 
 
+def order_devices_slice_major(devices):
+    """Sort devices so same-slice devices are contiguous.
+
+    Uses ``device.slice_index`` where the platform exposes it (multi-slice
+    TPU deployments; single-slice and CPU devices don't have it and keep
+    their given order).  The sort is stable on slice_index alone, so a
+    caller-chosen intra-slice order (e.g. a custom ring) is preserved.
+    """
+    devices = list(devices)
+    if any(getattr(d, "slice_index", None) is not None for d in devices):
+        devices.sort(key=lambda d: getattr(d, "slice_index", 0) or 0)
+    return devices
+
+
 def make_mesh(n_devices=None, devices=None, axis=AXIS):
-    """1-D mesh over the first ``n_devices`` (default: all) devices."""
+    """1-D mesh over ``n_devices`` (default: all) devices, slice-major
+    ordered.  Ordering happens BEFORE truncation, so asking for one slice's
+    worth of devices on a multi-slice deployment yields ICI-connected
+    devices of the first slice, not an interleaved sample crossing DCN."""
     if devices is None:
-        devices = jax.devices()
+        devices = order_devices_slice_major(jax.devices())
         if n_devices is not None:
             devices = devices[:n_devices]
+    else:
+        devices = order_devices_slice_major(devices)
     return Mesh(np.asarray(devices), (axis,))
+
+
+def slice_boundaries(devices):
+    """Positions in the 1-D (slice-major) order where a DCN hop occurs —
+    observability helper for the ring strategy's cost model: bytes moved
+    over DCN per iteration = boundary_count × shard_bytes."""
+    devices = order_devices_slice_major(devices)
+    slices = [getattr(d, "slice_index", 0) or 0 for d in devices]
+    return [k for k in range(1, len(slices)) if slices[k] != slices[k - 1]]
 
 
 def shard_leading(mesh, axis=AXIS):

@@ -129,6 +129,51 @@ def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig):
     return jax.jit(sharded, donate_argnums=(0, 1))
 
 
+def make_a2a_step(mesh, user_a2a, item_a2a, cfg: AlsConfig):
+    """Jitted full ALS iteration with the ragged ``all_to_all`` strategy:
+    each device receives only the opposite-factor rows its rating shard
+    references (tpu_als.parallel.a2a).  Signature: ``step(U, V, ub, ib,
+    u_send, i_send)`` where u_send/i_send are the [D, D, R] request tables.
+    """
+    from tpu_als.parallel.a2a import a2a_half_step
+
+    D = mesh.devices.size
+    if user_a2a.buckets[0].rows.shape[0] != D:
+        raise ValueError(
+            f"mesh has {D} devices but the exchange plan was built for "
+            f"{user_a2a.buckets[0].rows.shape[0]}")
+    per_u = user_a2a.rows_per_shard
+    per_i = item_a2a.rows_per_shard
+    u_chunk = user_a2a.chunk_elems
+    i_chunk = item_a2a.chunk_elems
+
+    def step_body(U_loc, V_loc, ubuckets, ibuckets, u_send, i_send):
+        ubuckets = _squeeze0(ubuckets)
+        ibuckets = _squeeze0(ibuckets)
+        # each device's slice of a [D_src, D_dst, R] table = its OUTGOING
+        # request lists; the item-side plan routes U rows and vice versa
+        u_send = u_send[0]              # serves the U half-step (V rows)
+        i_send = i_send[0]              # serves the V half-step (U rows)
+        YtY_u = (jax.lax.psum(compute_yty(U_loc), AXIS)
+                 if cfg.implicit_prefs else None)
+        V_new = a2a_half_step(U_loc, i_send, ibuckets, per_i, cfg, i_chunk,
+                              YtY_u)
+        YtY_v = (jax.lax.psum(compute_yty(V_new), AXIS)
+                 if cfg.implicit_prefs else None)
+        U_new = a2a_half_step(V_new, u_send, ubuckets, per_u, cfg, u_chunk,
+                              YtY_v)
+        return U_new, V_new
+
+    sharded = shard_map(
+        step_body,
+        mesh=mesh,
+        in_specs=(P(AXIS),) * 6,
+        out_specs=(P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
 def stacked_counts(part, row_idx, vals=None, positive_only=False):
     """Per-row rating counts in [D, rows_per_shard] layout (for the ring
     strategy's λ·n ridge; ``positive_only`` mirrors the implicit-feedback
@@ -148,9 +193,11 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
     """Distributed ALS training loop.  Returns slot-space (U, V) jax.Arrays
     sharded over ``mesh``; index with ``Partition.slot`` to get entity rows.
 
-    strategy: 'all_gather' (full opposite-factor gather per half-step) or
+    strategy: 'all_gather' (full opposite-factor gather per half-step),
     'ring' (ppermute streaming; pass RingCsr containers and
-    ``ring_counts=(user_counts, item_counts)`` from :func:`stacked_counts`).
+    ``ring_counts=(user_counts, item_counts)`` from :func:`stacked_counts`),
+    or 'all_to_all' (ragged row exchange; pass A2aCsr containers from
+    tpu_als.parallel.a2a.build_a2a).
 
     ``init``: optional entity-space ``(U0, V0)`` warm start (checkpoint
     resume, SURVEY.md §5.3); rows are scattered into slot space here.
@@ -180,10 +227,15 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
             _slot_init(kv, item_part, cfg.rank), leading
         )
 
-    if strategy not in ("all_gather", "ring"):
+    if strategy not in ("all_gather", "ring", "all_to_all"):
         raise ValueError(f"unknown strategy {strategy!r} "
-                         "(expected 'all_gather' or 'ring')")
-    if strategy == "ring":
+                         "(expected 'all_gather', 'ring' or 'all_to_all')")
+    if strategy == "all_to_all":
+        us = jax.device_put(user_sharded.send_idx, leading)
+        is_ = jax.device_put(item_sharded.send_idx, leading)
+        step = make_a2a_step(mesh, user_sharded, item_sharded, cfg)
+        args = (ub, ib, us, is_)
+    elif strategy == "ring":
         if ring_counts is None:
             raise ValueError("strategy='ring' requires ring_counts="
                              "(user_counts, item_counts) from stacked_counts")

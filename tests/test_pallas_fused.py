@@ -1,0 +1,91 @@
+"""Fused normal-eq + solve kernel vs the unfused einsum + Cholesky path
+(interpret mode on the CPU test mesh; the same kernel compiles on TPU)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tpu_als.ops.pallas_fused import fused_normal_solve
+from tpu_als.ops.solve import (
+    compute_yty,
+    normal_eq_explicit,
+    normal_eq_implicit,
+    solve_spd,
+)
+
+
+def _problem(rng, N, w, r, n_opp=200, implicit=False):
+    V = rng.normal(size=(n_opp, r)).astype(np.float32) / np.sqrt(r)
+    cols = rng.integers(0, n_opp, (N, w))
+    vals = rng.normal(size=(N, w)).astype(np.float32)
+    if implicit:
+        vals = np.abs(vals) * 3
+        # sprinkle zero-confidence and negative entries
+        vals[rng.random((N, w)) < 0.2] *= -1
+    mask = (rng.random((N, w)) < 0.8).astype(np.float32)
+    vals = vals * mask
+    Vg = V[cols] * 1.0  # gathered factors
+    return jnp.asarray(V), jnp.asarray(Vg), jnp.asarray(vals), jnp.asarray(mask)
+
+
+@pytest.mark.parametrize("N,w,r", [
+    (5, 8, 4),       # tiny everything
+    (37, 24, 10),    # ALS default rank, non-pow2 batch, w multiple of 8
+    (64, 512, 32),   # multiple width chunks
+    (33, 128, 128),  # the benchmark rank
+])
+def test_explicit_matches_unfused(rng, N, w, r):
+    V, Vg, vals, mask = _problem(rng, N, w, r)
+    reg = 0.05
+    A, b, count = normal_eq_explicit(Vg, vals, mask, reg)
+    ref = solve_spd(A, b, count, backend="xla")
+    x = fused_normal_solve(Vg, vals, mask, reg=reg, interpret=True)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(ref),
+                               atol=5e-4, rtol=5e-3)
+
+
+def test_implicit_matches_unfused(rng):
+    N, w, r = 48, 64, 16
+    V, Vg, vals, mask = _problem(rng, N, w, r, implicit=True)
+    reg, alpha = 0.1, 4.0
+    YtY = compute_yty(V)
+    A, b, count = normal_eq_implicit(Vg, vals, mask, reg, alpha, YtY)
+    ref = solve_spd(A, b, count, backend="xla")
+    x = fused_normal_solve(Vg, vals, mask, YtY, reg=reg, implicit=True,
+                           alpha=alpha, interpret=True)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(ref),
+                               atol=5e-4, rtol=5e-3)
+
+
+def test_empty_rows_solve_to_zero(rng):
+    N, w, r = 16, 16, 8
+    V, Vg, vals, mask = _problem(rng, N, w, r)
+    mask = np.asarray(mask).copy()
+    mask[::4] = 0.0  # whole rows empty
+    vals = np.asarray(vals) * mask
+    x = fused_normal_solve(jnp.asarray(np.asarray(Vg)),
+                           jnp.asarray(vals), jnp.asarray(mask),
+                           reg=0.05, interpret=True)
+    x = np.asarray(x)
+    assert np.isfinite(x).all()
+    assert np.abs(x[::4]).max() == 0.0
+    assert np.abs(x[1::4]).max() > 0.0
+
+
+def test_training_with_fused_backend_matches(rng):
+    """End-to-end: cfg.solve_backend='fused' (interpret off-TPU is not
+    available, so drive the kernel in interpret mode through one half-step
+    equivalent) — here we check the config plumbing rejects nothing and the
+    auto path stays unfused off-TPU."""
+    from conftest import make_ratings
+    from tpu_als.core.als import AlsConfig, train
+    from tpu_als.core.ratings import build_csr_buckets
+
+    u, i, r, _, _ = make_ratings(np.random.default_rng(1), 30, 20,
+                                 rank=3, density=0.4)
+    ucsr = build_csr_buckets(u, i, r, 30, min_width=4)
+    icsr = build_csr_buckets(i, u, r, 20, min_width=4)
+    cfg = AlsConfig(rank=4, max_iter=2, reg_param=0.05, seed=0,
+                    solve_backend="auto")
+    U, V = train(ucsr, icsr, cfg)  # off-TPU auto → unfused, must be green
+    assert np.isfinite(np.asarray(U)).all()

@@ -44,6 +44,11 @@ class AlsConfig:
     seed: int = 0
     nnls_sweeps: int = 32
     compute_dtype: str = "float32"  # or "bfloat16" for the A/b einsums
+    # 'auto': fused Pallas normal-eq+solve kernel on TPU when it probes
+    # healthy (A never hits HBM — tpu_als.ops.pallas_fused), else the
+    # einsum + batched-Cholesky path; 'fused' forces the kernel;
+    # 'unfused' forces the einsum path (NNLS always uses unfused)
+    solve_backend: str = "auto"
 
 
 def init_factors(key, num_rows, rank, dtype=jnp.float32):
@@ -69,6 +74,20 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     cdt = jnp.dtype(cfg.compute_dtype)
     out = jnp.zeros((num_rows, r), dtype=jnp.float32)
 
+    if cfg.solve_backend not in ("auto", "fused", "unfused"):
+        raise ValueError(
+            f"unknown solve_backend {cfg.solve_backend!r} "
+            "(expected 'auto', 'fused' or 'unfused')")
+    fused = False
+    if not cfg.nonnegative:
+        if cfg.solve_backend == "fused":
+            fused = True
+        elif cfg.solve_backend == "auto":
+            from tpu_als.ops import pallas_fused
+            from tpu_als.utils.platform import on_tpu
+
+            fused = on_tpu() and pallas_fused.available(r)
+
     for b in buckets:
         nb, w = b.cols.shape
         chunk = trainer_chunk(nb, w, r, chunk_elems)
@@ -81,6 +100,17 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
             c, v, m = args
             with jax.named_scope("gather_factors"):
                 Vg = V_full[c].astype(cdt)
+            if fused:
+                from tpu_als.ops.pallas_fused import fused_normal_solve
+
+                with jax.named_scope("fused_normal_solve"):
+                    return fused_normal_solve(
+                        Vg, v, m,
+                        YtY.astype(jnp.float32) if cfg.implicit_prefs
+                        else None,
+                        reg=cfg.reg_param,
+                        implicit=cfg.implicit_prefs, alpha=cfg.alpha,
+                    )
             with jax.named_scope("normal_eq"):
                 if cfg.implicit_prefs:
                     A, rhs, count = normal_eq_implicit(

@@ -1,0 +1,207 @@
+"""Pallas TPU kernel: fused normal-equation build + SPD solve.
+
+The unfused half-step (tpu_als.core.als.local_half_step) runs three HBM
+round-trips per chunk: the gathered factors ``Vg`` feed an einsum that
+writes ``A [n, r, r]`` to HBM, and the solver reads ``A`` back.  At
+ML-25M/rank-128 scale ``A`` is ~14 GB per iteration of pure HBM traffic.
+This kernel accumulates ``A`` and ``b`` in VMEM scratch while the ``Vg``
+blocks stream through, then factorizes and solves **in the same kernel
+invocation** — ``A`` never exists in HBM.
+
+Grid: ``(row_tiles, width_chunks)`` with the width dimension innermost; the
+``[TN, r, r]`` accumulator persists across the width chunks of one row tile
+(the standard Pallas revisiting pattern).  At the last width chunk the
+ridge (weighted-λ: ``regParam · n_ratings``, matching the reference
+solver's ``regParam * ne.k`` — Spark MLlib ``NormalEquation``/
+``CholeskySolver``, SURVEY.md §2.B5), the empty-row identity guard, the
+implicit-feedback YᵀY term, and the jitter are applied, and the blocked
+Cholesky + substitution from tpu_als.ops.pallas_solve runs on the VMEM
+accumulator.
+
+Semantics match ``normal_eq_explicit`` / ``normal_eq_implicit`` +
+``solve_spd`` exactly (same masking, same ridge, same empty-row contract).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_als.ops.pallas_solve import factorize, substitute
+
+
+def _fused_kernel(Vg_ref, vals_ref, mask_ref, YtY_ref, x_ref, S, LT, bacc,
+                  cnt, *, r, panel, n_wc, implicit, alpha, reg, jitter):
+    """One (row-tile, width-chunk) grid step.
+
+    Vg_ref [TN, WC, r]; vals/mask [TN, WC]; YtY_ref [r, r] (zeros when
+    explicit); x_ref [TN, r] (written at the last width chunk).
+    Scratch: S/LT [TN, r, r]; bacc [TN, r]; cnt [TN, r] (the per-row rating
+    count replicated across lanes — lane-uniform so the ridge/empty masks
+    can read it without lane extraction).
+    """
+    j = pl.program_id(1)
+    tn = Vg_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _init():
+        S[:] = jnp.zeros_like(S)
+        bacc[:] = jnp.zeros_like(bacc)
+        cnt[:] = jnp.zeros_like(cnt)
+
+    Vg = Vg_ref[:].astype(jnp.float32)
+    v = vals_ref[:].astype(jnp.float32)
+    m = mask_ref[:].astype(jnp.float32)
+    if implicit:
+        conf_m1 = alpha * jnp.abs(v) * m              # c - 1
+        pref = (v > 0).astype(jnp.float32) * m
+        Vw = Vg * conf_m1[..., None]
+        contrib_b = ((1.0 + conf_m1) * pref)[..., None] * Vg
+        rowcnt = jnp.sum(pref, axis=1)                # numExplicits
+    else:
+        Vw = Vg * m[..., None]
+        Vg = Vw                                       # both sides masked
+        contrib_b = (v * m)[..., None] * Vg
+        rowcnt = jnp.sum(m, axis=1)
+    # A += Σ_w Vw[t,w,:] Vg[t,w,:]ᵀ — one batched MXU contraction
+    S[:] = S[:] + jax.lax.dot_general(
+        Vw, Vg, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    bacc[:] = bacc[:] + jnp.sum(contrib_b, axis=1)
+    cnt[:] = cnt[:] + rowcnt[:, None]                 # lane-uniform
+
+    @pl.when(j == n_wc - 1)
+    def _solve():
+        ii = jax.lax.broadcasted_iota(jnp.int32, (tn, r, r), 1)
+        kk = jax.lax.broadcasted_iota(jnp.int32, (tn, r, r), 2)
+        diag = ii == kk
+        c3 = cnt[:][:, None, :]                       # [TN, 1, r] broadcast
+        A = S[:] + YtY_ref[:][None].astype(jnp.float32)
+        A = jnp.where(diag, A + reg * c3 + jitter, A)
+        # empty rows (count == 0): A := I so the factorization stays
+        # finite; b is already 0 there so x = 0 — the solve_spd contract
+        A = jnp.where(c3 <= 0.0, jnp.where(diag, 1.0 + jitter, 0.0), A)
+        S[:] = A
+        factorize(S, LT, tn=tn, r=r, panel=panel)
+        x_ref[:] = substitute(LT, bacc[:], tn=tn, r=r, panel=panel)
+
+
+def _tiles(r_pad, w, max_wc=256, budget_elems=1 << 18):
+    """(TN, WC): row tile and width chunk.  VMEM must hold S + LT
+    [TN, r, r] plus double-buffered Vg blocks [TN, WC, r]."""
+    tn = max(8, budget_elems // (r_pad * r_pad))
+    tn = 1 << (tn.bit_length() - 1)
+    wc = min(w, max_wc)
+    # keep Vg blocks within ~2 MB so the pipeline double-buffer fits
+    while tn * wc * r_pad > (1 << 19) and wc > 8:
+        wc = max(8, (wc // 2) // 8 * 8)
+    while w % wc:
+        wc -= 8  # w is a multiple of 8; find the largest dividing multiple
+    return tn, max(8, wc)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("implicit", "alpha", "reg", "panel", "jitter",
+                     "interpret"),
+)
+def fused_normal_solve(Vg, vals, mask, YtY=None, *, reg, implicit=False,
+                       alpha=1.0, panel=32, jitter=1e-6, interpret=False):
+    """x = (ΣvvᵀC + λnI [+ YᵀY])⁻¹ (ΣcCp) for every row, A never in HBM.
+
+    Vg [N, w, r] gathered opposite factors; vals/mask [N, w]; YtY [r, r]
+    required when ``implicit``.  Drop-in for normal_eq_* + solve_spd.
+    """
+    N, w, r = Vg.shape
+    if implicit and YtY is None:
+        raise ValueError("implicit fused solve requires YtY")
+    r_pad = max(panel, -(-r // panel) * panel)
+    w_pad = -(-w // 8) * 8  # width to a sublane multiple (masked zeros)
+    tn, wc = _tiles(r_pad, w_pad)
+    n_pad = -(-N // tn) * tn
+    Vg = jnp.pad(Vg, ((0, n_pad - N), (0, w_pad - w), (0, r_pad - r)))
+    vals = jnp.pad(vals, ((0, n_pad - N), (0, w_pad - w)))
+    mask = jnp.pad(mask, ((0, n_pad - N), (0, w_pad - w)))
+    w = w_pad
+    YtY_p = (jnp.zeros((r_pad, r_pad), jnp.float32) if YtY is None
+             else jnp.pad(YtY.astype(jnp.float32),
+                          ((0, r_pad - r), (0, r_pad - r))))
+    n_wc = w // wc
+
+    kernel = functools.partial(
+        _fused_kernel, r=r_pad, panel=panel, n_wc=n_wc,
+        implicit=implicit, alpha=float(alpha), reg=float(reg),
+        jitter=float(jitter),
+    )
+    x = pl.pallas_call(
+        kernel,
+        grid=(n_pad // tn, n_wc),
+        in_specs=[
+            pl.BlockSpec((tn, wc, r_pad), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, wc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, wc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((r_pad, r_pad), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tn, r_pad), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pad, r_pad), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((tn, r_pad, r_pad), jnp.float32),
+            pltpu.VMEM((tn, r_pad, r_pad), jnp.float32),
+            pltpu.VMEM((tn, r_pad), jnp.float32),
+            pltpu.VMEM((tn, r_pad), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=int(n_pad * (2 * w * r_pad * r_pad
+                               + r_pad ** 3 / 3 + 2 * r_pad ** 2)),
+            bytes_accessed=(n_pad * w * r_pad + 2 * n_pad * w
+                            + n_pad * r_pad) * 4,
+            transcendentals=n_pad * r_pad,
+        ),
+        interpret=interpret,
+    )(Vg, vals, mask, YtY_p)
+    return x[:N, :r]
+
+
+_AVAILABLE = {}
+
+
+def available(rank=128, panel=32):
+    """Compile-and-run probe, cached per (padded rank, panel) — same
+    contract as tpu_als.ops.pallas_solve.available.  The probe validates
+    the kernel output against the unfused XLA path on a random instance,
+    so a Mosaic miscompile producing finite-but-wrong values also fails."""
+    from tpu_als.utils.platform import probe_kernel
+
+    r_pad = max(panel, -(-rank // panel) * panel)
+
+    def probe():
+        import numpy as np
+
+        from tpu_als.ops.solve import normal_eq_explicit, solve_spd
+
+        rng = np.random.default_rng(0)
+        n, w = 8, 16
+        Vg = jnp.asarray(
+            rng.normal(size=(n, w, r_pad)).astype(np.float32)
+            / np.sqrt(r_pad))
+        vals = jnp.asarray(rng.normal(size=(n, w)).astype(np.float32))
+        mask = jnp.asarray(
+            (rng.random((n, w)) < 0.8).astype(np.float32))
+        x = fused_normal_solve(Vg, vals, mask, reg=0.1, panel=panel)
+        A, b, count = normal_eq_explicit(Vg, vals * mask, mask, 0.1)
+        ref = solve_spd(A, b, count, backend="xla")
+        x.block_until_ready()
+        return np.allclose(np.asarray(x), np.asarray(ref), atol=1e-3,
+                           rtol=1e-2)
+
+    return probe_kernel(_AVAILABLE, (r_pad, panel), probe)

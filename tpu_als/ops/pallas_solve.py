@@ -50,14 +50,18 @@ def _chol_solve_kernel(A_ref, b_ref, x_ref, S, LT, *, r, panel):
     """
     S[:] = A_ref[:]
     tn = A_ref.shape[0]
+    factorize(S, LT, tn=tn, r=r, panel=panel)
+    x_ref[:] = substitute(LT, b_ref[:], tn=tn, r=r, panel=panel)
+
+
+def factorize(S, LT, *, tn, r, panel):
+    """In-VMEM blocked Cholesky: S (symmetric input, destroyed) → LT holds
+    Lᵀ.  Shared by the standalone solver and the fused normal-eq kernel."""
     n_panels = r // panel
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (tn, r), 1)          # [TN, r]
     sub_p = jax.lax.broadcasted_iota(jnp.int32, (tn, panel, r), 1)  # k index
     lane_p = jax.lax.broadcasted_iota(jnp.int32, (tn, panel, r), 2)
-    aidx = jax.lax.broadcasted_iota(jnp.int32, (tn, panel), 1)      # [TN, P]
-    g_sub = jax.lax.broadcasted_iota(jnp.int32, (tn, panel, panel), 1)
-    g_lane = jax.lax.broadcasted_iota(jnp.int32, (tn, panel, panel), 2)
     sel_r = jax.lax.broadcasted_iota(jnp.int32, (r, panel), 0)
     sel_p = jax.lax.broadcasted_iota(jnp.int32, (r, panel), 1)
 
@@ -98,8 +102,23 @@ def _chol_solve_kernel(A_ref, b_ref, x_ref, S, LT, *, r, panel):
             )  # [TN, r, r]
             S[:] = S[:] - upd
 
+
+def substitute(LT, b, *, tn, r, panel):
+    """Solve L Lᵀ x = b given LT (= Lᵀ) in VMEM; returns x [TN, r]."""
+    n_panels = r // panel
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tn, r), 1)          # [TN, r]
+    aidx = jax.lax.broadcasted_iota(jnp.int32, (tn, panel), 1)      # [TN, P]
+    g_sub = jax.lax.broadcasted_iota(jnp.int32, (tn, panel, panel), 1)
+    g_lane = jax.lax.broadcasted_iota(jnp.int32, (tn, panel, panel), 2)
+    sel_r = jax.lax.broadcasted_iota(jnp.int32, (r, panel), 0)
+    sel_p = jax.lax.broadcasted_iota(jnp.int32, (r, panel), 1)
+
+    def selector(p):
+        return (sel_r == p + sel_p).astype(jnp.float32)
+
     # ---- forward substitution: L y = b (panel-blocked, row reads) ----
-    res = b_ref[:]
+    res = b
     for pi in range(n_panels):
         p = pi * panel
         sel = selector(p)
@@ -155,7 +174,7 @@ def _chol_solve_kernel(A_ref, b_ref, x_ref, S, LT, *, r, panel):
         x_full = jnp.dot(x_p, sel.T, preferred_element_type=jnp.float32)
         res = jnp.where((lane >= p) & (lane < p + panel), x_full, res)
 
-    x_ref[:] = res
+    return res
 
 
 def _tile_n(r_pad, budget_elems=1 << 19):
@@ -228,24 +247,18 @@ def available(rank=128, panel=32):
     solve_spd(backend='auto') consults this so a Mosaic regression degrades
     to the XLA lowering instead of crashing training.
     """
+    from tpu_als.utils.platform import probe_kernel
+
     r_pad = max(panel, -(-rank // panel) * panel)
-    cache_key = (r_pad, panel)
-    if cache_key not in _AVAILABLE:
-        from tpu_als.utils.platform import on_tpu
 
-        if not on_tpu():
-            _AVAILABLE[cache_key] = False
-            return False
-        try:
-            import numpy as np
+    def probe():
+        import numpy as np
 
-            n, r = 8, r_pad
-            A = jnp.asarray(np.eye(r, dtype=np.float32)[None].repeat(n, 0))
-            b = jnp.asarray(np.ones((n, r), np.float32))
-            x = spd_solve_pallas(A, b, panel=panel)
-            x.block_until_ready()
-            _AVAILABLE[cache_key] = bool(np.allclose(np.asarray(x), 1.0,
-                                                     atol=1e-4))
-        except Exception:  # Mosaic compile/runtime failure → XLA fallback
-            _AVAILABLE[cache_key] = False
-    return _AVAILABLE[cache_key]
+        n, r = 8, r_pad
+        A = jnp.asarray(np.eye(r, dtype=np.float32)[None].repeat(n, 0))
+        b = jnp.asarray(np.ones((n, r), np.float32))
+        x = spd_solve_pallas(A, b, panel=panel)
+        x.block_until_ready()
+        return np.allclose(np.asarray(x), 1.0, atol=1e-4)
+
+    return probe_kernel(_AVAILABLE, (r_pad, panel), probe)

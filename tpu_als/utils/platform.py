@@ -5,6 +5,23 @@ from __future__ import annotations
 import jax
 
 
+def probe_kernel(cache, key, probe):
+    """Shared compile-and-run probe scaffolding for Pallas kernels: off-TPU
+    → False; on TPU run ``probe()`` once (any exception — Mosaic compile or
+    runtime failure — caches False so callers degrade to the XLA path).
+    ``probe`` must return truthy only when the kernel output is CORRECT,
+    not merely finite."""
+    if key not in cache:
+        if not on_tpu():
+            cache[key] = False
+        else:
+            try:
+                cache[key] = bool(probe())
+            except Exception:
+                cache[key] = False
+    return cache[key]
+
+
 def on_tpu():
     """True when the default JAX backend drives a TPU chip.
 

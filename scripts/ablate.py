@@ -45,6 +45,14 @@ def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
                 Vg = V_full[c * 0]
             else:
                 Vg = V_full[c]
+            if cfgd["solve_backend"] == "fused" and ab not in (
+                    "no-neq", "no-solve"):
+                from tpu_als.ops.pallas_fused import fused_normal_solve
+
+                return fused_normal_solve(
+                    Vg, v, m, YtY if cfgd["implicit"] else None,
+                    reg=cfgd["reg"], implicit=cfgd["implicit"],
+                    alpha=cfgd["alpha"])
             if ab == "no-neq":
                 A = jnp.broadcast_to(
                     jnp.eye(rank) * 2.0, (chunk, rank, rank))
@@ -80,7 +88,7 @@ def main():
     ap.add_argument("--variants", nargs="*", default=[
         "full", "no-solve", "no-gather", "no-neq", "no-scatter"])
     ap.add_argument("--solve-backend", default="auto",
-                    choices=["auto", "xla", "pallas"])
+                    choices=["auto", "xla", "pallas", "fused"])
     ap.add_argument("--subproc", action="store_true",
                     help="run each variant in its own subprocess with a "
                          "timeout so one pathological compile cannot hang "

@@ -215,3 +215,27 @@ def test_checkpoint_survives_swap_window(rng, tmp_path):
     os.rename(path, path + ".old")
     manifest, *_ = load_factors(path)
     assert manifest["iteration"] == 1
+
+
+@pytest.mark.parametrize("strategy", ["ring", "all_to_all"])
+def test_sharded_fit_strategy_matches_all_gather(rng, strategy):
+    """Estimator-level gatherStrategy plumbing: ring / all_to_all fits must
+    reproduce the all_gather fit."""
+    from tpu_als.parallel.mesh import make_mesh
+
+    u, i, r, _, _ = make_ratings(np.random.default_rng(4), 50, 35,
+                                 rank=3, density=0.4)
+    frame = {"user": u, "item": i, "rating": r}
+    mesh = make_mesh(8)
+    base = ALS(rank=4, maxIter=3, regParam=0.05, seed=0, mesh=mesh).fit(frame)
+    alt = ALS(rank=4, maxIter=3, regParam=0.05, seed=0, mesh=mesh,
+              gatherStrategy=strategy).fit(frame)
+    np.testing.assert_allclose(
+        np.asarray(alt.transform(frame)["prediction"]),
+        np.asarray(base.transform(frame)["prediction"]),
+        rtol=5e-3, atol=5e-3)
+
+
+def test_bad_gather_strategy_rejected():
+    with pytest.raises(ValueError, match="gatherStrategy"):
+        ALS(gatherStrategy="broadcast")

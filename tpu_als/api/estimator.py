@@ -109,7 +109,11 @@ class ALS(_ALSParams):
 
     Runtime-only (non-Param) knobs: ``mesh`` — a ``jax.sharding.Mesh`` to
     train sharded over devices (None = single device; ``numUserBlocks`` /
-    ``numItemBlocks`` are then API-parity hints only); ``checkpointDir`` —
+    ``numItemBlocks`` are then API-parity hints only); ``gatherStrategy`` —
+    how sharded half-steps move the opposite factors: ``'all_gather'``
+    (default), ``'ring'`` (ppermute streaming — opposite factors never
+    materialize in full), or ``'all_to_all'`` (ragged exchange of only the
+    referenced rows); ``checkpointDir`` —
     where ``checkpointInterval`` writes resumable factor snapshots;
     ``resumeFrom`` — a checkpoint directory to warm-start from: ``fit``
     loads its factors + iteration counter and runs only the remaining
@@ -118,11 +122,17 @@ class ALS(_ALSParams):
     tpu_als.utils.observe.IterationLogger).
     """
 
-    def __init__(self, *, mesh=None, checkpointDir=None, resumeFrom=None,
+    def __init__(self, *, mesh=None, gatherStrategy="all_gather",
+                 checkpointDir=None, resumeFrom=None,
                  fitCallback=None,
                  **kwargs):
         super().__init__()
         self.mesh = mesh
+        if gatherStrategy not in ("all_gather", "ring", "all_to_all"):
+            raise ValueError(
+                f"unknown gatherStrategy {gatherStrategy!r} (expected "
+                "'all_gather', 'ring' or 'all_to_all')")
+        self.gatherStrategy = gatherStrategy
         self.checkpointDir = checkpointDir
         self.resumeFrom = resumeFrom
         self.fitCallback = fitCallback
@@ -205,15 +215,32 @@ class ALS(_ALSParams):
         callback = self._checkpoint_callback(user_map, item_map)
         if self.mesh is not None:
             from tpu_als.parallel.data import partition_balanced, shard_csr
-            from tpu_als.parallel.trainer import train_sharded
+            from tpu_als.parallel.trainer import stacked_counts, train_sharded
 
             D = self.mesh.devices.size
             upart = partition_balanced(
                 np.bincount(u_idx, minlength=len(user_map)), D)
             ipart = partition_balanced(
                 np.bincount(i_idx, minlength=len(item_map)), D)
-            ush = shard_csr(upart, ipart, u_idx, i_idx, r)
-            ish = shard_csr(ipart, upart, i_idx, u_idx, r)
+            strategy = self.gatherStrategy
+            ring_counts = None
+            if strategy == "ring":
+                from tpu_als.parallel.comm import shard_csr_grid
+
+                ush = shard_csr_grid(upart, ipart, u_idx, i_idx, r)
+                ish = shard_csr_grid(ipart, upart, i_idx, u_idx, r)
+                pos = cfg.implicit_prefs
+                ring_counts = (
+                    stacked_counts(upart, u_idx, r, positive_only=pos),
+                    stacked_counts(ipart, i_idx, r, positive_only=pos))
+            elif strategy == "all_to_all":
+                from tpu_als.parallel.a2a import build_a2a
+
+                ush = build_a2a(upart, ipart, u_idx, i_idx, r)
+                ish = build_a2a(ipart, upart, i_idx, u_idx, r)
+            else:
+                ush = shard_csr(upart, ipart, u_idx, i_idx, r)
+                ish = shard_csr(ipart, upart, i_idx, u_idx, r)
             sharded_cb = None
             if callback is not None:
                 def sharded_cb(iteration, U, V):  # slot space -> entity space
@@ -222,7 +249,8 @@ class ALS(_ALSParams):
                              np.asarray(V)[ipart.slot])
             Us, Vs = train_sharded(self.mesh, upart, ipart, ush, ish, cfg,
                                    callback=sharded_cb, init=init,
-                                   start_iter=start_iter)
+                                   start_iter=start_iter, strategy=strategy,
+                                   ring_counts=ring_counts)
             U = np.asarray(Us)[upart.slot]
             V = np.asarray(Vs)[ipart.slot]
         else:

@@ -94,8 +94,9 @@ def _fused_kernel(Vg_ref, vals_ref, mask_ref, YtY_ref, x_ref, S, LT, bacc,
 def _tiles(r_pad, w, max_wc=256, budget_elems=1 << 18):
     """(TN, WC): row tile and width chunk.  VMEM must hold S + LT
     [TN, r, r] plus double-buffered Vg blocks [TN, WC, r]."""
-    tn = max(8, budget_elems // (r_pad * r_pad))
-    tn = 1 << (tn.bit_length() - 1)
+    from tpu_als.ops.pallas_solve import _tile_n
+
+    tn = _tile_n(r_pad, budget_elems)
     wc = min(w, max_wc)
     # keep Vg blocks within ~2 MB so the pipeline double-buffer fits
     while tn * wc * r_pad > (1 << 19) and wc > 8:
@@ -189,8 +190,17 @@ def available(rank=128, panel=32):
 
         from tpu_als.ops.solve import normal_eq_explicit, solve_spd
 
+        # shape chosen so the probe compiles the SAME program structure as
+        # production: >= 2 row tiles and >= 2 width chunks, exercising the
+        # scratch-accumulator revisiting across the inner grid dimension
+        w = 64
+        while True:
+            tn, wc = _tiles(r_pad, w)
+            if w // wc >= 2:
+                break
+            w *= 2
+        n = 2 * tn
         rng = np.random.default_rng(0)
-        n, w = 8, 16
         Vg = jnp.asarray(
             rng.normal(size=(n, w, r_pad)).astype(np.float32)
             / np.sqrt(r_pad))

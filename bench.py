@@ -36,6 +36,10 @@ def main():
     ap.add_argument("--iters", type=int, default=3,
                     help="timed iterations after warmup")
     ap.add_argument("--rank", type=int, default=128)
+    ap.add_argument("--solve-backend", default="auto",
+                    choices=["auto", "fused", "unfused"],
+                    help="half-step solve path (AlsConfig.solve_backend); "
+                         "'auto' probes the fused Pallas kernel on TPU")
     args = ap.parse_args()
 
     import numpy as np
@@ -65,7 +69,8 @@ def main():
         f"item waste {icsr.padded_nnz/icsr.nnz:.2f}x ({time.time()-t0:.1f}s)")
 
     cfg = AlsConfig(rank=args.rank, max_iter=1, reg_param=0.01,
-                    implicit_prefs=True, alpha=40.0, seed=0)
+                    implicit_prefs=True, alpha=40.0, seed=0,
+                    solve_backend=args.solve_backend)
     key = jax.random.PRNGKey(0)
     ku, kv = jax.random.split(key)
     U = init_factors(ku, nU, cfg.rank)
@@ -111,6 +116,7 @@ def main():
             "implicit": True, "alpha": 40.0,
             "device": str(jax.devices()[0]),
             "seconds_per_iter": round(dt / args.iters, 3),
+            "solve_backend": args.solve_backend,
         },
     }
     print(json.dumps(result))

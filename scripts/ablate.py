@@ -65,7 +65,12 @@ def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
                 A, rhs, cnt = normal_eq_explicit(Vg, v, m, cfgd["reg"])
             if ab == "no-solve":
                 return rhs
-            return solve_spd(A, rhs, cnt, backend=cfgd["solve_backend"])
+            # under --solve-backend fused the no-neq/no-solve variants fall
+            # back to the unfused path; use the XLA solver there so the
+            # stage delta isn't conflated with a solver swap
+            sb = cfgd["solve_backend"]
+            return solve_spd(A, rhs, cnt,
+                             backend="xla" if sb == "fused" else sb)
 
         if nch == 1:
             xs = f((cols[0], vals[0], mask[0]))[None]

@@ -207,11 +207,29 @@ def available(rank=128, panel=32):
         vals = jnp.asarray(rng.normal(size=(n, w)).astype(np.float32))
         mask = jnp.asarray(
             (rng.random((n, w)) < 0.8).astype(np.float32))
+        # explicit variant
         x = fused_normal_solve(Vg, vals, mask, reg=0.1, panel=panel)
         A, b, count = normal_eq_explicit(Vg, vals * mask, mask, 0.1)
         ref = solve_spd(A, b, count, backend="xla")
         x.block_until_ready()
-        return np.allclose(np.asarray(x), np.asarray(ref), atol=1e-3,
+        if not np.allclose(np.asarray(x), np.asarray(ref), atol=1e-3,
+                           rtol=1e-2):
+            return False
+        # implicit variant compiles a different kernel body (confidence /
+        # preference / YtY path) — probe it independently
+        from tpu_als.ops.solve import normal_eq_implicit
+
+        iv = jnp.abs(vals) * jnp.asarray(
+            np.sign(rng.normal(size=(n, w))).astype(np.float32))
+        YtY = jnp.asarray(
+            rng.normal(size=(r_pad, r_pad)).astype(np.float32))
+        YtY = YtY @ YtY.T / r_pad
+        xi = fused_normal_solve(Vg, iv, mask, YtY, reg=0.1, implicit=True,
+                                alpha=4.0, panel=panel)
+        Ai, bi, ci = normal_eq_implicit(Vg, iv * mask, mask, 0.1, 4.0, YtY)
+        refi = solve_spd(Ai, bi, ci, backend="xla")
+        xi.block_until_ready()
+        return np.allclose(np.asarray(xi), np.asarray(refi), atol=1e-3,
                            rtol=1e-2)
 
     return probe_kernel(_AVAILABLE, (r_pad, panel), probe)

@@ -149,3 +149,40 @@ def topk_scores_pallas(U, V, item_valid, k, tile_u=256, tile_i=512,
         interpret=interpret,
     )(Up, Vp, validp)
     return out_s[:n, :k], out_i[:n, :k]
+
+
+_AVAILABLE = {}
+
+
+def available():
+    """Compile-and-run probe (cached per process), validated against the
+    XLA scan path — same contract as the solver kernels' ``available()``:
+    a Mosaic regression (compile failure OR finite-but-wrong output) makes
+    the serving dispatch degrade to the XLA scan."""
+    from tpu_als.utils.platform import probe_kernel
+
+    def probe():
+        import numpy as np
+
+        from tpu_als.ops.topk import chunked_topk_scores
+
+        rng = np.random.default_rng(0)
+        # >= 2 user tiles and >= 2 item tiles so the output-revisiting
+        # merge across the item grid dimension is exercised
+        n, ni, r, k = 2 * 256, 2 * 512, 8, 10
+        U = rng.normal(size=(n, r)).astype(np.float32)
+        V = rng.normal(size=(ni, r)).astype(np.float32)
+        valid = jnp.asarray(np.ones(ni, bool))
+        s, i = topk_scores_pallas(jnp.asarray(U), jnp.asarray(V), valid, k)
+        rs, _ = chunked_topk_scores(jnp.asarray(U), jnp.asarray(V), valid, k)
+        s.block_until_ready()
+        s, i, rs = np.asarray(s), np.asarray(i), np.asarray(rs)
+        # score VALUES must match the XLA scan; exact index equality is not
+        # required (fp accumulation-order near-ties may rank-swap on a
+        # healthy kernel) — instead the returned ids must reproduce the
+        # returned scores under an independent host-side dot
+        host = np.einsum("nr,nkr->nk", U, V[i])
+        return (np.allclose(s, rs, atol=1e-4)
+                and np.allclose(host, s, atol=1e-3))
+
+    return probe_kernel(_AVAILABLE, "topk", probe)

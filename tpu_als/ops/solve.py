@@ -114,6 +114,9 @@ def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
 
         backend = ("pallas" if (on_tpu() and pallas_solve.available(r))
                    else "xla")
+    if backend not in ("pallas", "xla"):
+        raise ValueError(f"unknown solve backend {backend!r} "
+                         "(expected 'auto', 'pallas' or 'xla')")
     if backend == "pallas":
         from tpu_als.ops.pallas_solve import spd_solve_pallas
 

@@ -62,12 +62,18 @@ def topk_scores(U, V, item_valid, k, item_chunk=8192, backend="auto"):
     """Top-k dispatch: the fused Pallas kernel on TPU (scores never touch
     HBM — tpu_als.ops.pallas_topk), the XLA scan elsewhere.
 
-    backend: 'auto' | 'pallas' | 'xla'.
+    backend: 'auto' (Pallas only after its compile-and-run probe passes,
+    so a Mosaic regression degrades to the scan instead of crashing
+    serving) | 'pallas' | 'xla'.
     """
     from tpu_als.utils.platform import on_tpu
 
     if backend == "auto":
-        backend = "pallas" if (on_tpu() and k <= 128) else "xla"
+        from tpu_als.ops import pallas_topk
+
+        backend = ("pallas" if (on_tpu() and k <= 128
+                                and pallas_topk.available())
+                   else "xla")
     if backend == "pallas":
         from tpu_als.ops.pallas_topk import topk_scores_pallas
 

@@ -214,9 +214,18 @@ class ALS(_ALSParams):
 
         callback = self._checkpoint_callback(user_map, item_map)
         if self.mesh is not None:
+            import jax
+
             from tpu_als.parallel.data import partition_balanced, shard_csr
             from tpu_als.parallel.trainer import stacked_counts, train_sharded
 
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "the Estimator is single-controller: it materializes "
+                    "full factor matrices host-side, which is not valid "
+                    "under multi-process JAX. For multi-host training use "
+                    "tpu_als.parallel.trainer with per-host rating shards "
+                    "(see tpu_als.parallel.multihost).")
             D = self.mesh.devices.size
             upart = partition_balanced(
                 np.bincount(u_idx, minlength=len(user_map)), D)

@@ -47,10 +47,25 @@ def cmd_train(args):
     train, test = frame.randomSplit([1 - args.holdout, args.holdout],
                                     seed=args.seed)
     logger = IterationLogger(path=args.log_file) if args.log_file else None
+    mesh = None
+    if args.devices != 1:
+        import jax
+
+        from tpu_als.parallel.mesh import make_mesh
+        from tpu_als.parallel.multihost import init_distributed
+
+        init_distributed()  # no-op single-process; DCN rendezvous on pods
+        visible = len(jax.devices())
+        if args.devices > visible:
+            raise SystemExit(
+                f"--devices {args.devices} but only {visible} visible; "
+                "refusing to silently train on fewer devices")
+        mesh = make_mesh(None if args.devices == 0 else args.devices)
     als = ALS(rank=args.rank, maxIter=args.max_iter, regParam=args.reg_param,
               implicitPrefs=args.implicit, alpha=args.alpha,
               nonnegative=args.nonnegative, seed=args.seed,
-              coldStartStrategy="drop", fitCallback=logger)
+              coldStartStrategy="drop", fitCallback=logger,
+              mesh=mesh, gatherStrategy=args.gather_strategy)
     print(f"training on {len(train):,} ratings "
           f"({len(test):,} held out)", file=sys.stderr)
     model = als.fit(train)
@@ -150,6 +165,12 @@ def main(argv=None):
     t.add_argument("--output", default=None)
     t.add_argument("--log-file", default=None,
                    help="write per-iteration JSON log lines here")
+    t.add_argument("--devices", type=int, default=1,
+                   help="train sharded over N devices (0 = all visible; "
+                        "1 = single device, the default)")
+    t.add_argument("--gather-strategy", default="all_gather",
+                   choices=["all_gather", "ring", "all_to_all"],
+                   help="how sharded half-steps move the opposite factors")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("evaluate", help="score a dataset with a saved model")

@@ -154,12 +154,17 @@ def topk_scores_pallas(U, V, item_valid, k, tile_u=256, tile_i=512,
 _AVAILABLE = {}
 
 
-def available():
-    """Compile-and-run probe (cached per process), validated against the
-    XLA scan path — same contract as the solver kernels' ``available()``:
-    a Mosaic regression (compile failure OR finite-but-wrong output) makes
-    the serving dispatch degrade to the XLA scan."""
+def available(rank=128, k=10):
+    """Compile-and-run probe, cached per (padded rank, k) — the kernel
+    instantiation depends on both (k is a static loop bound; the rank sets
+    the lane padding), so a verdict for one shape must not green-light
+    another.  Validated against the XLA scan path, same contract as the
+    solver kernels' ``available()``: a Mosaic regression (compile failure
+    OR finite-but-wrong output) makes serving degrade to the XLA scan."""
     from tpu_als.utils.platform import probe_kernel
+
+    r_pad = -(-max(1, rank) // LANES) * LANES
+    k = min(k, LANES)
 
     def probe():
         import numpy as np
@@ -169,9 +174,9 @@ def available():
         rng = np.random.default_rng(0)
         # >= 2 user tiles and >= 2 item tiles so the output-revisiting
         # merge across the item grid dimension is exercised
-        n, ni, r, k = 2 * 256, 2 * 512, 8, 10
-        U = rng.normal(size=(n, r)).astype(np.float32)
-        V = rng.normal(size=(ni, r)).astype(np.float32)
+        n, ni, r = 2 * 256, 2 * 512, r_pad
+        U = (rng.normal(size=(n, r)) / np.sqrt(r)).astype(np.float32)
+        V = (rng.normal(size=(ni, r)) / np.sqrt(r)).astype(np.float32)
         valid = jnp.asarray(np.ones(ni, bool))
         s, i = topk_scores_pallas(jnp.asarray(U), jnp.asarray(V), valid, k)
         rs, _ = chunked_topk_scores(jnp.asarray(U), jnp.asarray(V), valid, k)
@@ -185,4 +190,4 @@ def available():
         return (np.allclose(s, rs, atol=1e-4)
                 and np.allclose(host, s, atol=1e-3))
 
-    return probe_kernel(_AVAILABLE, "topk", probe)
+    return probe_kernel(_AVAILABLE, (r_pad, k), probe)

@@ -72,7 +72,7 @@ def topk_scores(U, V, item_valid, k, item_chunk=8192, backend="auto"):
         from tpu_als.ops import pallas_topk
 
         backend = ("pallas" if (on_tpu() and k <= 128
-                                and pallas_topk.available())
+                                and pallas_topk.available(U.shape[1], k))
                    else "xla")
     if backend == "pallas":
         from tpu_als.ops.pallas_topk import topk_scores_pallas

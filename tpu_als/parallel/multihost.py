@@ -1,0 +1,91 @@
+"""Multi-host bring-up — the analog of Spark's cluster boot.
+
+The reference stack scales past one machine with Spark's driver/executor
+runtime: executors register with the driver over netty RPC and each holds
+its partitions (SURVEY.md §2.B8).  The TPU-native equivalent is JAX's
+multi-controller model: every host runs this same program,
+``jax.distributed.initialize`` rendezvouses them over DCN, and afterwards
+``jax.devices()`` spans the whole deployment, so
+:func:`tpu_als.parallel.mesh.make_mesh` builds one global (slice-major)
+mesh and the ``shard_map`` trainer is unchanged — XLA routes each
+collective over ICI within a slice and DCN across (SURVEY.md §5.8).
+
+What IS per-host is the data: at Amazon-2023 scale (~570M ratings,
+BASELINE.json config 3) no host should materialize the full rating set.
+:func:`local_positions` + :func:`local_rating_mask` give each process the
+mesh-axis positions its devices own and the subset of COO ratings that
+land there, so blocking (`build_csr_buckets` / `build_a2a`) runs on the
+local shard only — the analog of executors building only their own
+``InBlock``s.
+
+Scope (honest contract): the high-level Estimator is single-controller —
+it materializes full factor matrices host-side and raises a clear error
+under multi-process JAX rather than failing inside a collective.  The
+multi-host surface is the trainer level: these helpers + per-host rating
+shards + ``jax.make_array_from_process_local_data`` for the factor/bucket
+placement.  Wiring the Estimator itself for multi-process is future work;
+nothing in the sharded math (shard_map steps, collectives) is
+single-process-specific.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Connect this process to the deployment (no-op when single-process).
+
+    Resolution order: explicit args → the standard JAX env vars
+    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``, also set by TPU pod launchers) → single-process
+    no-op.  Must run before first JAX use, like Spark's ``SparkContext``
+    construction must precede any job.
+    Returns (process_index, process_count).
+    """
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if coordinator_address:
+        kw = {"coordinator_address": coordinator_address}
+        num_processes = num_processes or os.environ.get("JAX_NUM_PROCESSES")
+        process_id = process_id if process_id is not None else \
+            os.environ.get("JAX_PROCESS_ID")
+        if num_processes is not None:
+            kw["num_processes"] = int(num_processes)
+        if process_id is not None:
+            kw["process_id"] = int(process_id)
+        jax.distributed.initialize(**kw)
+    return jax.process_index(), jax.process_count()
+
+
+def local_positions(mesh):
+    """Mesh-axis positions (0..D-1) owned by this process's devices.
+
+    The sharded trainer lays factors and rating shards out device-major
+    along the 1-D mesh axis; these are the leading-axis indices this host
+    must have data for."""
+    local = {d.id for d in jax.local_devices()}
+    flat = list(mesh.devices.flat)
+    return [k for k, d in enumerate(flat) if d.id in local]
+
+
+def local_rating_mask(part, row_idx, mesh=None, positions=None):
+    """Boolean mask over COO ratings: True where the solved-side entity is
+    owned by one of this process's mesh positions.  Feed the masked
+    triples to the blocking builders so each host blocks only its shard —
+    O(local nnz) host memory instead of O(total nnz).
+
+    ``positions`` overrides the mesh-derived ownership (tests / custom
+    placement); exactly one of ``mesh`` / ``positions`` is required."""
+    if positions is None:
+        if mesh is None:
+            raise ValueError("pass mesh or positions")
+        positions = local_positions(mesh)
+    own = np.zeros(part.n_shards, dtype=bool)
+    own[list(positions)] = True
+    return own[part.owner[np.asarray(row_idx)]]

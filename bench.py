@@ -40,6 +40,11 @@ def main():
                     choices=["auto", "fused", "unfused"],
                     help="half-step solve path (AlsConfig.solve_backend); "
                          "'auto' probes the fused Pallas kernel on TPU")
+    ap.add_argument("--width-growth", type=float, default=2.0,
+                    choices=[2.0, 1.5],
+                    help="bucket width ladder: 2.0 = powers of two, "
+                         "1.5 = add 0.75*2^k rungs (~25%% less padding, "
+                         "more jit specializations)")
     args = ap.parse_args()
 
     import numpy as np
@@ -63,8 +68,8 @@ def main():
     log(f"synthesized {nnz:,} ratings ({time.time()-t0:.1f}s)")
 
     t0 = time.time()
-    ucsr = build_csr_buckets(u, i, r, nU)
-    icsr = build_csr_buckets(i, u, r, nI)
+    ucsr = build_csr_buckets(u, i, r, nU, width_growth=args.width_growth)
+    icsr = build_csr_buckets(i, u, r, nI, width_growth=args.width_growth)
     log(f"blocked: user waste {ucsr.padded_nnz/ucsr.nnz:.2f}x, "
         f"item waste {icsr.padded_nnz/icsr.nnz:.2f}x ({time.time()-t0:.1f}s)")
 

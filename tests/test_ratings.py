@@ -179,3 +179,61 @@ def test_native_counts_bounds_checked(rng):
         fastbucket.counts(np.array([0, 5, -1]), 10)
     with pytest.raises(ValueError, match="row indices"):
         fastbucket.counts(np.array([0, 10]), 10)
+
+
+def test_width_growth_ladder(rng):
+    """growth=1.5 adds the 0.75*2^k rungs that are sublane multiples and
+    never shrinks a row below its rating count."""
+    from tpu_als.core.ratings import entity_widths
+
+    counts = np.arange(1, 400)
+    w2 = entity_widths(counts, 8)
+    w15 = entity_widths(counts, 8, growth=1.5)
+    assert (w15 >= counts).all()
+    assert (w15 <= w2).all()
+    assert (w15 % 8 == 0).all()
+    # the new rungs actually appear and help: count=20 -> 24 not 32
+    assert entity_widths([20], 8, growth=1.5)[0] == 24
+    assert entity_widths([40], 8, growth=1.5)[0] == 48
+    # but 12 is not a sublane multiple, so count=10 stays at 16
+    assert entity_widths([10], 8, growth=1.5)[0] == 16
+
+
+def test_width_growth_end_to_end(rng):
+    """Blocking with growth=1.5 reduces padded nnz and trains to the same
+    factors (bucketization must not change the math)."""
+    from conftest import make_ratings
+    from tpu_als.core.als import AlsConfig, train
+
+    u, i, r, _, _ = make_ratings(np.random.default_rng(6), 80, 50,
+                                 rank=3, density=0.5)
+    a = build_csr_buckets(u, i, r, 80, min_width=8)
+    b = build_csr_buckets(u, i, r, 80, min_width=8, width_growth=1.5)
+    assert b.padded_nnz <= a.padded_nnz
+    ia = build_csr_buckets(i, u, r, 50, min_width=8)
+    ib = build_csr_buckets(i, u, r, 50, min_width=8, width_growth=1.5)
+    cfg = AlsConfig(rank=4, max_iter=3, reg_param=0.05, seed=0)
+    Ua, Va = train(a, ia, cfg)
+    Ub, Vb = train(b, ib, cfg)
+    np.testing.assert_allclose(np.asarray(Ub), np.asarray(Ua),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_width_growth_native_matches_numpy(rng):
+    from tpu_als.io import fastbucket
+
+    if not fastbucket.available():
+        import pytest
+        pytest.skip("native bucketizer unavailable")
+    rows = rng.integers(0, 60, 800).astype(np.int64)
+    cols = rng.integers(0, 40, 800).astype(np.int64)
+    vals = rng.normal(size=800).astype(np.float32)
+    a = build_csr_buckets(rows, cols, vals, 60, native=False,
+                          width_growth=1.5)
+    b = build_csr_buckets(rows, cols, vals, 60, native=True,
+                          width_growth=1.5)
+    assert [x.width for x in a.buckets] == [x.width for x in b.buckets]
+    for x, y in zip(a.buckets, b.buckets):
+        np.testing.assert_array_equal(x.rows, y.rows)
+        np.testing.assert_array_equal(x.cols, y.cols)
+        np.testing.assert_array_equal(x.vals, y.vals)

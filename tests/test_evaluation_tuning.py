@@ -175,3 +175,21 @@ def test_tuned_model_save_load(rng, tmp_path):
     back2 = CrossValidatorModel.load(str(p2))
     assert back2.avgMetrics == [0.5, 0.4]
     assert back2.foldMetrics == [[0.5], [0.4]]
+
+
+def test_tuned_load_rejects_foreign_model_class(tmp_path):
+    # tuning.json from an untrusted directory must not drive arbitrary
+    # imports (ADVICE r1): only tpu_als.* classes are loadable
+    import json
+
+    import pytest
+
+    from tpu_als.api.tuning import TrainValidationSplitModel
+
+    p = tmp_path / "evil"
+    p.mkdir()
+    (p / "tuning.json").write_text(json.dumps(
+        {"kind": "tvs", "validationMetrics": [],
+         "modelClass": "os.path.join"}))
+    with pytest.raises(ValueError, match="refusing to load"):
+        TrainValidationSplitModel.load(str(p))

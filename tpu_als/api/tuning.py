@@ -24,11 +24,17 @@ def _save_tuned(model, path, metrics_payload):
     (the analog of ``DefaultParamsWriter`` metadata, SURVEY.md §2.B11).
     The best model's class is recorded so load restores the right type."""
     os.makedirs(path, exist_ok=True)
-    model.bestModel.save(os.path.join(path, "bestModel"))
-    cls = type(model.bestModel)
+    best = model.bestModel
+    if hasattr(best, "write"):  # inner replace is atomic (save_factors)
+        best.write().overwrite().save(os.path.join(path, "bestModel"))
+    else:
+        best.save(os.path.join(path, "bestModel"))
+    cls = type(best)
     metrics_payload["modelClass"] = f"{cls.__module__}.{cls.__qualname__}"
-    with open(os.path.join(path, "tuning.json"), "w") as f:
+    tmp = os.path.join(path, "tuning.json.tmp")
+    with open(tmp, "w") as f:
         json.dump(metrics_payload, f)
+    os.replace(tmp, os.path.join(path, "tuning.json"))
 
 
 def _load_tuned(path, kind):
@@ -39,8 +45,14 @@ def _load_tuned(path, kind):
     if meta.get("kind") != kind:
         raise ValueError(
             f"{path} holds a {meta.get('kind')!r} tuning save, not {kind!r}")
-    mod, _, name = meta.get(
-        "modelClass", "tpu_als.api.estimator.ALSModel").rpartition(".")
+    cls_path = meta.get("modelClass", "tpu_als.api.estimator.ALSModel")
+    # tuning.json may come from an untrusted directory — never import an
+    # arbitrary dotted path from it
+    if not cls_path.startswith("tpu_als."):
+        raise ValueError(
+            f"refusing to load model class {cls_path!r} from {path}: "
+            "only tpu_als.* model classes are loadable")
+    mod, _, name = cls_path.rpartition(".")
     model_cls = getattr(importlib.import_module(mod), name)
     best = model_cls.load(os.path.join(path, "bestModel"))
     return best, meta
@@ -128,7 +140,15 @@ class CrossValidatorModel:
     def transform(self, dataset):
         return self.bestModel.transform(dataset)
 
+    def write(self):
+        from tpu_als.api.estimator import MLWriter
+
+        return MLWriter(self)
+
     def save(self, path):
+        self.write().save(path)
+
+    def _save_to(self, path):
         _save_tuned(self, path, {"kind": "cv", "avgMetrics": self.avgMetrics,
                                  "foldMetrics": self.foldMetrics})
 
@@ -167,7 +187,15 @@ class TrainValidationSplitModel:
     def transform(self, dataset):
         return self.bestModel.transform(dataset)
 
+    def write(self):
+        from tpu_als.api.estimator import MLWriter
+
+        return MLWriter(self)
+
     def save(self, path):
+        self.write().save(path)
+
+    def _save_to(self, path):
         _save_tuned(self, path,
                     {"kind": "tvs", "validationMetrics":
                      self.validationMetrics})

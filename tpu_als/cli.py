@@ -55,6 +55,14 @@ def cmd_train(args):
         from tpu_als.parallel.multihost import init_distributed
 
         init_distributed()  # no-op single-process; DCN rendezvous on pods
+        if jax.process_count() > 1:
+            # the Estimator fit path is single-process (it would raise
+            # NotImplementedError after rendezvous); fail before training
+            # so each pod host doesn't silently train the full dataset
+            raise SystemExit(
+                "multi-process training is not wired into the CLI yet: "
+                "ALS.fit requires a single process owning all devices "
+                "(see tpu_als.parallel.multihost for the bring-up path)")
         visible = len(jax.devices())
         if args.devices > visible:
             raise SystemExit(
@@ -74,7 +82,9 @@ def cmd_train(args):
             model.transform(test))
         print(json.dumps({"holdout_rmse": round(rmse, 4)}))
     if args.output:
-        model.save(args.output)
+        # CLI --output semantics: replace (atomically) — a rerun must not
+        # crash after the whole training finished
+        model.write().overwrite().save(args.output)
         print(f"model saved to {args.output}", file=sys.stderr)
     return model
 

@@ -252,13 +252,28 @@ def available(rank=128, panel=32):
     r_pad = max(panel, -(-rank // panel) * panel)
 
     def probe():
+        # validates a random well-conditioned SPD batch against the XLA
+        # lowering, through the same solve_spd() entry production uses —
+        # a Mosaic miscompile producing finite-but-wrong values fails here
+        # (identity-only probes do not exercise the factorization
+        # arithmetic; same standard as pallas_fused.available)
         import numpy as np
 
+        from tpu_als.ops.solve import solve_spd
+
         n, r = 8, r_pad
-        A = jnp.asarray(np.eye(r, dtype=np.float32)[None].repeat(n, 0))
-        b = jnp.asarray(np.ones((n, r), np.float32))
-        x = spd_solve_pallas(A, b, panel=panel)
+        rng = np.random.default_rng(0)
+        M = rng.normal(size=(n, r, r)).astype(np.float32) / np.sqrt(r)
+        A = jnp.asarray(
+            M @ np.swapaxes(M, 1, 2)
+            + 0.5 * np.eye(r, dtype=np.float32)[None])
+        b = jnp.asarray(rng.normal(size=(n, r)).astype(np.float32))
+        # mirror solve_spd's pre-regularization, but call the kernel
+        # directly so the probe compiles the SAME panel it green-lights
+        x = spd_solve_pallas(A + 1e-6 * jnp.eye(r), b, panel=panel)
         x.block_until_ready()
-        return np.allclose(np.asarray(x), 1.0, atol=1e-4)
+        ref = solve_spd(A, b, jnp.ones((n,), jnp.float32), backend="xla")
+        return np.allclose(np.asarray(x), np.asarray(ref), atol=1e-3,
+                           rtol=1e-2)
 
     return probe_kernel(_AVAILABLE, (r_pad, panel), probe)

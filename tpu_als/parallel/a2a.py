@@ -56,18 +56,32 @@ class A2aCsr:
     request_budget: int  # R
     chunk_elems: int
     nnz: int
+    # the budget is the max over (src, dst) pairs, so one hot pair inflates
+    # the whole [D, D, R] exchange (ADVICE r1): these fields let callers see
+    # and escape that degeneration
+    padding_ratio: float = 1.0  # D²·R / true request-list entries
+    degenerate: bool = False    # True when exchanged rows >= all_gather's
 
     def device_buckets(self):
         return list(self.buckets)
 
 
 def build_a2a(row_part, col_part, row_idx, col_idx, vals,
-              min_width=8, chunk_elems=1 << 19):
+              min_width=8, chunk_elems=1 << 19, on_degenerate="build"):
     """Build rating shards with compact column ids + the exchange plan.
 
     row_part/col_part: Partition for the solved side / the gathered side
     (tpu_als.parallel.data).  Requires ``row_part.n_shards ==
     col_part.n_shards`` (one mesh axis drives the exchange).
+
+    on_degenerate: what to do when the uniform budget R reaches the
+    opposite side's rows/shard (exchange bytes >= all_gather's).
+    'build' (default) warns but still builds a working plan — fine at
+    small scale.  'stub' returns immediately with ``degenerate=True`` and
+    NO shard/table arrays (a [D, D, R] table at R ≈ 10⁶ rows is a
+    terabyte-class host allocation — the caller must check the flag and
+    fall back before anything that size is materialized); a stub plan is
+    not trainable.
     """
     D = row_part.n_shards
     if col_part.n_shards != D:
@@ -90,8 +104,37 @@ def build_a2a(row_part, col_part, row_idx, col_idx, vals,
     starts = np.searchsorted(grp, np.arange(D * D))
     pos = np.arange(len(uniq)) - starts[grp]
     # uniform request budget, padded to a sublane multiple
-    R = int(pos.max()) + 1 if len(uniq) else 1
-    R = max(8, -(-R // 8) * 8)
+    R_true = int(pos.max()) + 1 if len(uniq) else 1
+    R = max(8, -(-R_true // 8) * 8)
+
+    # budget accounting: R is the max over all (src, dst) pairs, so a
+    # single dense pair pads every other pair's list up to it.  When the
+    # per-device exchanged rows (D·R) reach the opposite side's full shard
+    # rows (D·rows_per_shard ≥ what all_gather would move), the strategy
+    # has lost its reason to exist — callers should fall back.  Compare
+    # the pre-floor demand so the sublane rounding of tiny budgets doesn't
+    # read as degeneration.
+    true_requests = max(1, len(uniq))
+    padding_ratio = (D * D * R) / true_requests
+    degenerate = R_true >= rps
+    if degenerate:
+        import warnings
+
+        warnings.warn(
+            f"all_to_all request budget R={R_true} >= opposite rows/shard "
+            f"{rps}: the exchange moves at least as many bytes as "
+            "all_gather (clustered-skew rating layout); prefer "
+            "gatherStrategy='all_gather' or 'ring'", stacklevel=2)
+        if on_degenerate == "stub":
+            # detected BEFORE the [D, D, R] table / shard arrays exist —
+            # at the scale the fallback matters those allocations would
+            # OOM the host long before the caller could check the flag
+            return A2aCsr(
+                buckets=[], send_idx=np.zeros((D, D, 0), dtype=np.int32),
+                rows_per_shard=row_part.rows_per_shard, request_budget=R,
+                chunk_elems=chunk_elems, nnz=len(row_idx),
+                padding_ratio=padding_ratio, degenerate=True,
+            )
 
     send_idx = np.zeros((D, D, R), dtype=np.int32)
     dst = grp // D
@@ -117,6 +160,8 @@ def build_a2a(row_part, col_part, row_idx, col_idx, vals,
         request_budget=R,
         chunk_elems=chunk_elems,
         nnz=len(row_idx),
+        padding_ratio=padding_ratio,
+        degenerate=degenerate,
     )
 
 

@@ -8,16 +8,32 @@ netty TCP (SURVEY.md §2.C2).  The TPU-native replacements (§5.7/§5.8):
   ``N_opposite × rank`` fits per-device HBM.
 - **ring** (this module): the opposite factors are never materialized in
   full.  Each device keeps only its own factor shard; shards rotate around
-  the mesh with ``ppermute`` while per-row normal-equation accumulators stay
+  the mesh with ``ppermute`` while normal-equation accumulators stay
   stationary — the same dataflow as ring attention (stationary queries =
-  the accumulators, streaming keys/values = the factor shards).  Total
-  bytes moved equal one all_gather, but peak HBM drops from
-  ``N_opposite × rank`` to ``N_opposite/D × rank``.
+  the accumulators, streaming keys/values = the factor shards).
 
-Data layout for the ring: ratings are blocked on a 2-D (owner device ×
-source shard) grid — the TPU analog of Spark's ``numUserBlocks ×
-numItemBlocks`` rating grid — with column ids local to the source shard, so
-each ring step's gather indexes only the currently-held shard.
+Peak-HBM model (the reason ring exists, config 3 of BASELINE.json —
+rank 256, ~570M ratings on a v5e-32 mesh):
+
+  extra HBM per device = O(row_tile · r²)   (one tile's A accumulators)
+                       + O(N_opposite/D · r) (the resident factor shard)
+
+The solved rows are processed in **row tiles**: the ring pass runs once per
+tile, so only that tile's ``A [tile, r, r]`` is ever alive — never a
+full-shard ``[num_rows, r, r]`` accumulator (at rank 256 and 1M solved
+rows/device that naive accumulator would be ~262 GB; a 1024-row tile is
+256 MB).  ``trainer_chunk`` bounds ``tile · r · max(w, r)`` by 2²⁸ elements
+(1 GiB f32).  The price is communication: each tile re-streams every
+opposite shard, so ring traffic = n_tiles × one all_gather's bytes — a
+deliberate HBM-for-ICI trade; ICI bandwidth is the cheap resource and the
+``ppermute`` chain overlaps with each tile's einsum work.
+
+Data layout: ratings are blocked on a 2-D (owner device × source shard)
+grid — the TPU analog of Spark's ``numUserBlocks × numItemBlocks`` rating
+grid — with column ids local to the source shard, so each ring step's
+gather indexes only the currently-held shard.  Crucially all S source
+shards share ONE row position per entity (bucketing by max-per-source
+degree), so a row tile accumulates coherently across the whole ring pass.
 """
 
 from __future__ import annotations
@@ -28,18 +44,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_als.core.ratings import Bucket, build_csr_buckets, trainer_chunk
+from tpu_als.core.ratings import (
+    Bucket,
+    entity_widths,
+    scan_chunk,
+    trainer_chunk,
+)
 from tpu_als.ops.solve import solve_nnls, solve_spd
-from tpu_als.parallel.data import stack_shards
 from tpu_als.parallel.mesh import AXIS
 
 
 @dataclass
 class RingCsr:
-    """[D, S, ...] bucketed grid for one side (uniform shapes over both the
-    device axis D and the source-shard axis S)."""
+    """Bucketed (owner device × source shard) grid for one side.
 
-    buckets: list  # list[Bucket]; arrays are [D, S, nb, w]
+    Bucket arrays: rows [D, nb] (entity per row — shared across source
+    shards), cols/vals/mask [D, S, nb, w] (shard-local column ids).
+    """
+
+    buckets: list  # list[Bucket]
     rows_per_shard: int
     chunk_elems: int
     nnz: int
@@ -47,109 +70,94 @@ class RingCsr:
     def device_buckets(self):
         return list(self.buckets)
 
+    @property
+    def padded_nnz(self):
+        return sum(b.mask.size for b in self.buckets)
+
 
 def shard_csr_grid(row_part, col_part, row_idx, col_idx, vals,
                    min_width=8, chunk_elems=1 << 19):
-    """Build the (owner device × source shard) grid with shard-local cols."""
+    """Build the grid with a row space SHARED across source shards.
+
+    Every source shard stores entity u's ratings at the same (bucket, row)
+    position — required by the row-tiled ring pass, which accumulates one
+    tile's normal equations from all S shards before solving it.  Entities
+    are bucketed by their **max-per-source** degree (each shard's slice of
+    a row pads to that bucket's width), trading some extra padding for the
+    tile-coherent layout.
+    """
     D = row_part.n_shards
     S = col_part.n_shards
-    owner = row_part.owner[row_idx]
-    local_rows = row_part.local[row_idx]
-    src = col_part.owner[col_idx]
-    local_cols = col_part.local[col_idx]
-
-    vals = np.asarray(vals)
-    # per (d, s): a CsrBuckets; then unify across d for each s, then across s
-    per_s = []
-    for s in range(S):
-        shards = []
-        for d in range(D):
-            sel = (owner == d) & (src == s)
-            shards.append(build_csr_buckets(
-                local_rows[sel], local_cols[sel], vals[sel],
-                num_rows=row_part.rows_per_shard,
-                min_width=min_width, chunk_elems=chunk_elems,
-            ))
-        per_s.append(stack_shards(shards, chunk_elems))  # [D, nb_s, w]
-
-    # unify bucket shapes across the S axis so a traced shard index can
-    # dynamic-slice into a single stacked array
-    widths = sorted({b.width for sh in per_s for b in sh.buckets})
-    stacked = []
+    row_idx = np.asarray(row_idx)
+    col_idx = np.asarray(col_idx)
+    vals = np.asarray(vals, dtype=np.float32)
+    owner = row_part.owner[row_idx].astype(np.int64)
+    local_rows = row_part.local[row_idx].astype(np.int64)
+    src = col_part.owner[col_idx].astype(np.int64)
+    local_cols = col_part.local[col_idx].astype(np.int64)
     num_rows = row_part.rows_per_shard
-    for w in widths:
-        per = [next((b for b in sh.buckets if b.width == w), None)
-               for sh in per_s]
-        nb_max = max(b.rows.shape[1] for b in per if b is not None)
-        rows = np.full((D, S, nb_max), num_rows, dtype=np.int32)
-        cols = np.zeros((D, S, nb_max, w), dtype=np.int32)
-        v = np.zeros((D, S, nb_max, w), dtype=np.float32)
-        m = np.zeros((D, S, nb_max, w), dtype=np.float32)
-        for s, b in enumerate(per):
-            if b is None:
-                continue
-            nb = b.rows.shape[1]
-            rows[:, s, :nb] = b.rows
-            cols[:, s, :nb] = b.cols
-            v[:, s, :nb] = b.vals
-            m[:, s, :nb] = b.mask
-        stacked.append(Bucket(rows=rows, cols=cols, vals=v, mask=m))
-    return RingCsr(buckets=stacked, rows_per_shard=num_rows,
-                   chunk_elems=chunk_elems, nnz=len(row_idx))
+    n = len(row_idx)
 
+    # per-entry offset within its (owner, row, source-shard) group
+    key = (owner * num_rows + local_rows) * S + src
+    order = np.argsort(key, kind="stable")
+    uniq_k, starts, kcounts = np.unique(
+        key[order], return_index=True, return_counts=True)
+    off = np.arange(n) - starts[np.repeat(np.arange(len(uniq_k)), kcounts)]
 
-def _accumulate_shard(V_shard, buckets, shard_sel, num_rows, cfg, chunk_elems,
-                      A_acc, b_acc):
-    """Add one source shard's normal-equation contributions.
+    # bucket width per (device, entity): max degree over source shards
+    k_du = uniq_k // S
+    maxdeg = np.zeros(D * num_rows, dtype=np.int64)
+    np.maximum.at(maxdeg, k_du, kcounts)
+    rated = np.zeros(D * num_rows, dtype=bool)
+    rated[k_du] = True
+    widths_all = entity_widths(maxdeg, min_width)
 
-    ``buckets`` arrays are [S, nb, w]; ``shard_sel`` is the traced source
-    shard index currently held by this device.  Raw sums only — the λ·n·I
-    ridge (and implicit YᵀY) are added once at solve time.
-    """
-    r = V_shard.shape[-1]
-    cdt = jnp.dtype(cfg.compute_dtype)
-    for b in buckets:
-        _, nb, w = b.cols.shape
-        rows = jax.lax.dynamic_index_in_dim(b.rows, shard_sel, 0, False)
-        cols = jax.lax.dynamic_index_in_dim(b.cols, shard_sel, 0, False)
-        vals = jax.lax.dynamic_index_in_dim(b.vals, shard_sel, 0, False)
-        mask = jax.lax.dynamic_index_in_dim(b.mask, shard_sel, 0, False)
-        chunk = trainer_chunk(nb, w, r, chunk_elems)
-        nchunks = nb // chunk
+    bucket_widths = sorted(set(widths_all[rated].tolist()))
+    local_pos = np.full(D * num_rows, -1, dtype=np.int64)
+    nb_pads = []
+    for w in bucket_widths:
+        nb_need = 0
+        for d in range(D):
+            lo = d * num_rows
+            sel = np.flatnonzero(
+                rated[lo:lo + num_rows]
+                & (widths_all[lo:lo + num_rows] == w))
+            local_pos[lo + sel] = np.arange(len(sel))
+            nb_need = max(nb_need, len(sel))
+        chunk = scan_chunk(nb_need, w, chunk_elems)
+        nb_pads.append(-(-nb_need // chunk) * chunk)
 
-        def contrib(args):
-            c, v, m = args
-            Vg = V_shard[c].astype(cdt)
-            if cfg.implicit_prefs:
-                conf_m1 = cfg.alpha * jnp.abs(v) * m
-                pref = (v > 0).astype(cdt)
-                A = jnp.einsum("nw,nwr,nws->nrs", conf_m1.astype(cdt), Vg, Vg,
-                               preferred_element_type=jnp.float32)
-                bb = jnp.einsum("nw,nwr->nr",
-                                ((1.0 + conf_m1) * pref * m).astype(cdt), Vg,
-                                preferred_element_type=jnp.float32)
-            else:
-                Vm = Vg * m[..., None].astype(cdt)
-                A = jnp.einsum("nwr,nws->nrs", Vm, Vm,
-                               preferred_element_type=jnp.float32)
-                bb = jnp.einsum("nw,nwr->nr", (v * m).astype(cdt), Vg,
-                                preferred_element_type=jnp.float32)
-            return A, bb
+    e_owner = owner[order]
+    e_rows = local_rows[order]
+    e_src = src[order]
+    e_cols = local_cols[order]
+    e_vals = vals[order]
+    flat = e_owner * num_rows + e_rows
+    e_w = widths_all[flat]
+    e_pos = local_pos[flat]
 
-        if nchunks == 1:
-            A, bb = contrib((cols, vals, mask))
-        else:
-            A, bb = jax.lax.map(
-                contrib,
-                (cols.reshape(nchunks, chunk, w),
-                 vals.reshape(nchunks, chunk, w),
-                 mask.reshape(nchunks, chunk, w)),
-            )
-            A = A.reshape(nb, r, r)
-            bb = bb.reshape(nb, r)
-        A_acc = A_acc.at[rows].add(A, mode="drop")
-        b_acc = b_acc.at[rows].add(bb, mode="drop")
-    return A_acc, b_acc
+    buckets = []
+    for w, nb in zip(bucket_widths, nb_pads):
+        rows = np.full((D, nb), num_rows, dtype=np.int32)
+        for d in range(D):
+            lo = d * num_rows
+            sel = np.flatnonzero(
+                rated[lo:lo + num_rows]
+                & (widths_all[lo:lo + num_rows] == w))
+            rows[d, :len(sel)] = sel
+        cols = np.zeros((D, S, nb, w), dtype=np.int32)
+        v = np.zeros((D, S, nb, w), dtype=np.float32)
+        m = np.zeros((D, S, nb, w), dtype=np.float32)
+        esel = e_w == w
+        dd, ss = e_owner[esel], e_src[esel]
+        pp, oo = e_pos[esel], off[esel]
+        cols[dd, ss, pp, oo] = e_cols[esel]
+        v[dd, ss, pp, oo] = e_vals[esel]
+        m[dd, ss, pp, oo] = 1.0
+        buckets.append(Bucket(rows=rows, cols=cols, vals=v, mask=m))
+    return RingCsr(buckets=buckets, rows_per_shard=num_rows,
+                   chunk_elems=chunk_elems, nnz=n)
 
 
 def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
@@ -157,28 +165,90 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
     """One half-step with streaming factor shards (inside ``shard_map``).
 
     V_shard [per_opposite, r]: this device's shard of the opposite factors.
-    ring_buckets: [S, ...] bucket arrays (this device's slice of a RingCsr).
+    ring_buckets: this device's slice of a RingCsr — rows [nb],
+    cols/vals/mask [S, nb, w].
     counts [num_rows]: per-row rating counts (for the λ·n ridge; for
     implicit feedback, the positive-rating counts).
+
+    Rows are processed in tiles (``trainer_chunk``): per tile, one full
+    ring pass of ``n_shards`` ppermute rotations accumulates
+    ``A [tile, r, r]`` / ``b [tile, r]``, then the tile is solved and
+    scattered.  Each pass performs all ``n_shards`` rotations, so the
+    factor shard is back home when the next tile starts.  See the module
+    docstring for the peak-HBM model this enforces.
     """
     r = V_shard.shape[-1]
+    cdt = jnp.dtype(cfg.compute_dtype)
     me = jax.lax.axis_index(AXIS)
-    A = jnp.zeros((num_rows, r, r), dtype=jnp.float32)
-    b = jnp.zeros((num_rows, r), dtype=jnp.float32)
-
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
-    V_cur = V_shard
-    for t in range(n_shards):
-        src = (me - t) % n_shards  # shard currently held after t rotations
-        A, b = _accumulate_shard(V_cur, ring_buckets, src, num_rows, cfg,
-                                 chunk_elems, A, b)
-        if t + 1 < n_shards:
-            V_cur = jax.lax.ppermute(V_cur, AXIS, perm)
-
     eye = jnp.eye(r, dtype=jnp.float32)
-    A = A + (cfg.reg_param * counts)[:, None, None] * eye
-    if cfg.implicit_prefs:
-        A = A + YtY[None]
-    if cfg.nonnegative:
-        return solve_nnls(A, b, counts, sweeps=cfg.nnls_sweeps)
-    return solve_spd(A, b, counts)
+    out = jnp.zeros((num_rows, r), dtype=jnp.float32)
+
+    def tile_pass(V_c, rows, cols, vals, mask):
+        """rows [tile]; cols/vals/mask [S, tile, w] -> (V_c, x [tile, r])"""
+        tile = rows.shape[0]
+        A = jnp.zeros((tile, r, r), dtype=jnp.float32)
+        bb = jnp.zeros((tile, r), dtype=jnp.float32)
+        for t in range(n_shards):
+            src = (me - t) % n_shards  # shard held after t rotations
+            with jax.named_scope("ring_gather"):
+                c = jax.lax.dynamic_index_in_dim(cols, src, 0, False)
+                v = jax.lax.dynamic_index_in_dim(vals, src, 0, False)
+                m = jax.lax.dynamic_index_in_dim(mask, src, 0, False)
+                Vg = V_c[c].astype(cdt)
+            with jax.named_scope("ring_normal_eq"):
+                if cfg.implicit_prefs:
+                    conf_m1 = cfg.alpha * jnp.abs(v) * m
+                    pref = (v > 0).astype(cdt)
+                    A = A + jnp.einsum(
+                        "nw,nwr,nws->nrs", conf_m1.astype(cdt), Vg, Vg,
+                        preferred_element_type=jnp.float32)
+                    bb = bb + jnp.einsum(
+                        "nw,nwr->nr",
+                        ((1.0 + conf_m1) * pref * m).astype(cdt), Vg,
+                        preferred_element_type=jnp.float32)
+                else:
+                    Vm = Vg * m[..., None].astype(cdt)
+                    A = A + jnp.einsum(
+                        "nwr,nws->nrs", Vm, Vm,
+                        preferred_element_type=jnp.float32)
+                    bb = bb + jnp.einsum(
+                        "nw,nwr->nr", (v * m).astype(cdt), Vg,
+                        preferred_element_type=jnp.float32)
+            # rotate every step: after n_shards rotations the shard is home
+            V_c = jax.lax.ppermute(V_c, AXIS, perm)
+        # padding rows (rows == num_rows) read an arbitrary count; their
+        # b is 0 so x solves to 0 and the scatter drops them anyway
+        cnt = counts[jnp.clip(rows, 0, num_rows - 1)]
+        A = A + (cfg.reg_param * cnt)[:, None, None] * eye
+        if cfg.implicit_prefs:
+            A = A + YtY[None]
+        with jax.named_scope("ring_solve"):
+            if cfg.nonnegative:
+                x = solve_nnls(A, bb, cnt, sweeps=cfg.nnls_sweeps)
+            else:
+                x = solve_spd(A, bb, cnt)
+        return V_c, x
+
+    for b in ring_buckets:
+        S, nb, w = b.cols.shape
+        tile = trainer_chunk(nb, w, r, chunk_elems)
+        ntiles = nb // tile
+        if ntiles == 1:
+            V_shard, x = tile_pass(V_shard, b.rows, b.cols, b.vals, b.mask)
+            out = out.at[b.rows].set(x, mode="drop", unique_indices=True)
+        else:
+            def body(ti, carry, b=b, tile=tile):
+                V_c, out = carry
+                s0 = ti * tile
+                rows = jax.lax.dynamic_slice_in_dim(b.rows, s0, tile, 0)
+                cols = jax.lax.dynamic_slice_in_dim(b.cols, s0, tile, 1)
+                vals = jax.lax.dynamic_slice_in_dim(b.vals, s0, tile, 1)
+                mask = jax.lax.dynamic_slice_in_dim(b.mask, s0, tile, 1)
+                V_c, x = tile_pass(V_c, rows, cols, vals, mask)
+                out = out.at[rows].set(x, mode="drop", unique_indices=True)
+                return (V_c, out)
+
+            V_shard, out = jax.lax.fori_loop(
+                0, ntiles, body, (V_shard, out))
+    return out

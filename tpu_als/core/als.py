@@ -44,9 +44,12 @@ class AlsConfig:
     seed: int = 0
     nnls_sweeps: int = 32
     compute_dtype: str = "float32"  # or "bfloat16" for the A/b einsums
-    # 'auto': fused Pallas normal-eq+solve kernel on TPU when it probes
-    # healthy (A never hits HBM — tpu_als.ops.pallas_fused), else the
-    # einsum + batched-Cholesky path; 'fused' forces the kernel;
+    # 'auto': einsum normal equations + the Pallas blocked-Cholesky solve
+    # on TPU when it probes healthy (tpu_als.ops.pallas_solve), else the
+    # XLA cholesky lowering; 'fused' forces the fused normal-eq+solve
+    # kernel (tpu_als.ops.pallas_fused — measured 34x SLOWER than the
+    # einsum+pallas path on v5e at ML-25M/25 rank 128, kept for ablation
+    # and for regimes where the A tensor's HBM round-trip dominates);
     # 'unfused' forces the einsum path (NNLS always uses unfused)
     solve_backend: str = "auto"
 
@@ -66,21 +69,21 @@ def resolve_solve_path(cfg: AlsConfig, rank):
 
     tpu = on_tpu()
     # probe lazily: only the branches that consume a probe outcome run it
-    # (each probe compiles+executes a kernel on TPU); None = not probed
+    # (each probe compiles+executes a kernel on TPU); None = not probed.
+    # 'auto' deliberately never picks the fused kernel: measured on v5e
+    # (round 2 ablation, ML-25M/25 rank 128) fused = 3.93 s/iter vs
+    # einsum+pallas_cholesky = 0.114 s/iter — the VMEM-resident solve on
+    # the einsum-built A wins; 'fused' stays available explicitly.
     fused_ok = solve_ok = None
     if cfg.nonnegative:
         path = "einsum+nnls"
     elif cfg.solve_backend == "fused":
+        fused_ok = bool(tpu and pallas_fused.available(rank))
         path = "fused_pallas"
     else:
-        if cfg.solve_backend == "auto":
-            fused_ok = bool(tpu and pallas_fused.available(rank))
-        if cfg.solve_backend == "auto" and fused_ok:
-            path = "fused_pallas"
-        else:
-            solve_ok = bool(tpu and pallas_solve.available(rank))
-            path = ("einsum+pallas_cholesky" if solve_ok
-                    else "einsum+xla_cholesky")
+        solve_ok = bool(tpu and pallas_solve.available(rank))
+        path = ("einsum+pallas_cholesky" if solve_ok
+                else "einsum+xla_cholesky")
     return {
         "solve_backend_requested": cfg.solve_backend,
         "fused_kernel_probe": fused_ok,

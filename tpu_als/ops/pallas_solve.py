@@ -39,6 +39,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# All dots inside the factorization/substitution run at HIGHEST precision:
+# the MXU's DEFAULT f32 path is a single bf16 pass (~4e-3 relative), and
+# that error COMPOUNDS through the Cholesky recurrence — measured ~1e-2
+# relative solve error on well-conditioned rank-128 systems, which is what
+# made available()'s comparison against the XLA lowering fail on real
+# hardware in round 1.  HIGHEST (multi-pass f32 emulation) restores ~1e-6.
+# The dots here are a small fraction of kernel time (the column loops are
+# VPU-bound), so the cost is negligible.
+_PREC = jax.lax.Precision.HIGHEST
+
 
 def _chol_solve_kernel(A_ref, b_ref, x_ref, S, LT, *, r, panel):
     """One batch tile: factorize A and solve.
@@ -84,7 +94,7 @@ def factorize(S, LT, *, tn, r, panel):
             inv = jax.lax.rsqrt(jnp.maximum(d2, 1e-30))
             ncol = jnp.where(lane >= j, col * inv[:, None], 0.0)
             # ncol at the panel's own lanes, via one-hot MXU dot
-            npanel = jnp.dot(ncol, sel, preferred_element_type=jnp.float32)
+            npanel = jnp.dot(ncol, sel, preferred_element_type=jnp.float32, precision=_PREC)
             upd = npanel[:, :, None] * ncol[:, None, :]       # [TN, P, r]
             blkT = jnp.where(sub_p > jj, blkT - upd, blkT)
             blkT = jnp.where(sub_p == jj, ncol[:, None, :], blkT)
@@ -98,7 +108,7 @@ def factorize(S, LT, *, tn, r, panel):
             # trailing update (MXU): S[t,i,i'] -= Σ_k L[i,p+k] L[i',p+k]
             upd = jax.lax.dot_general(
                 LpT, LpT, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=jnp.float32, precision=_PREC,
             )  # [TN, r, r]
             S[:] = S[:] - upd
 
@@ -126,9 +136,9 @@ def substitute(LT, b, *, tn, r, panel):
         # diag block via one-hot MXU: G[t,k,a] = L[p+a, p+k]
         G = jnp.dot(
             LpT.reshape(tn * panel, r), sel,
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_PREC,
         ).reshape(tn, panel, panel)
-        rhs = jnp.dot(res, sel, preferred_element_type=jnp.float32)  # [TN,P]
+        rhs = jnp.dot(res, sel, preferred_element_type=jnp.float32, precision=_PREC)  # [TN,P]
 
         def fwd_col(jj, rhs, G=G):
             # column jj of the diag block, indexed by row a: G[t, jj, a]
@@ -142,7 +152,7 @@ def substitute(LT, b, *, tn, r, panel):
         y_p = jax.lax.fori_loop(0, panel, fwd_col, rhs)     # [TN, P]
         # apply to lanes below the panel: upd[t,i] = Σ_k y[t,k] L[i, p+k]
         upd = jnp.sum(y_p[:, :, None] * LpT, axis=1)        # [TN, r]
-        y_full = jnp.dot(y_p, sel.T, preferred_element_type=jnp.float32)
+        y_full = jnp.dot(y_p, sel.T, preferred_element_type=jnp.float32, precision=_PREC)
         res = jnp.where(lane >= p + panel, res - upd, res)
         res = jnp.where((lane >= p) & (lane < p + panel), y_full, res)
 
@@ -154,10 +164,10 @@ def substitute(LT, b, *, tn, r, panel):
         # contributions of already-solved lanes (>= p+P)
         xm = jnp.where(lane >= p + panel, res, 0.0)
         contrib = jnp.sum(UpT * xm[:, None, :], axis=2)     # [TN, P]
-        rhs = jnp.dot(res, sel, preferred_element_type=jnp.float32) - contrib
+        rhs = jnp.dot(res, sel, preferred_element_type=jnp.float32, precision=_PREC) - contrib
         G = jnp.dot(
             UpT.reshape(tn * panel, r), sel,
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_PREC,
         ).reshape(tn, panel, panel)             # G[t,k,a] = Lᵀ[p+k, p+a]
 
         def bwd_col(tt, rhs, G=G):
@@ -171,7 +181,7 @@ def substitute(LT, b, *, tn, r, panel):
             return rhs
 
         x_p = jax.lax.fori_loop(0, panel, bwd_col, rhs)
-        x_full = jnp.dot(x_p, sel.T, preferred_element_type=jnp.float32)
+        x_full = jnp.dot(x_p, sel.T, preferred_element_type=jnp.float32, precision=_PREC)
         res = jnp.where((lane >= p) & (lane < p + panel), x_full, res)
 
     return res

@@ -167,14 +167,8 @@ def run_headline(args):
     ib = jax.device_put(icsr.device_buckets())
     step = make_step(ub, ib, nU, nI, cfg, ucsr.chunk_elems, icsr.chunk_elems)
 
-    import jax.numpy as jnp
-
-    def fence(x):
-        # scalar device->host readback: block_until_ready alone has been
-        # seen returning early on the experimental axon platform
-        return float(jnp.sum(jnp.abs(x)))
-
     from tpu_als.core.als import resolve_solve_path
+    from tpu_als.utils.platform import fence
 
     backends = resolve_solve_path(cfg, cfg.rank)
     log(f"resolved backends: {backends}")

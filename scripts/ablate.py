@@ -140,11 +140,7 @@ def main():
         U = half_step(V, ub, nU, rank, ucsr.chunk_elems, YtY_v, ab, cfgd)
         return U, V
 
-    def fence(x):
-        # scalar device->host readback: block_until_ready alone has been
-        # seen returning early on the experimental axon platform (same
-        # workaround as bench.py)
-        return float(jnp.sum(jnp.abs(x)))
+    from tpu_als.utils.platform import fence
 
     base = None
     for ab in args.variants:

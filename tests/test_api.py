@@ -298,6 +298,45 @@ def test_estimator_save_load_roundtrip(tmp_path):
     est.write().overwrite().save(path)
 
 
+def test_failed_overwrite_preserves_old_save(rng, tmp_path, monkeypatch):
+    # a _save_to failure mid-overwrite (ENOSPC, bug) must leave the old
+    # save at `path` fully loadable — even across a RETRY of the failing
+    # overwrite (code-review r2: the old move-aside scheme let a retry
+    # rmtree the only good copy before failing again)
+    import pytest
+
+    frame = small_frame(rng)
+    model = ALS(rank=3, maxIter=2, seed=4).fit(frame)
+    path = str(tmp_path / "m")
+    model.write().save(path)
+
+    boom = RuntimeError("disk full")
+
+    def failing_save_to(p):
+        import os
+
+        os.makedirs(p, exist_ok=True)  # leave partial contents behind
+        raise boom
+
+    monkeypatch.setattr(model, "_save_to", failing_save_to)
+    for _ in range(2):  # the second attempt is the retry that used to lose
+        with pytest.raises(RuntimeError, match="disk full"):
+            model.write().overwrite().save(path)
+        assert ALSModel.load(path).rank == 3  # old save intact
+
+    monkeypatch.undo()
+    model.write().overwrite().save(path)  # healthy retry still lands
+    assert ALSModel.load(path).rank == 3
+
+    # crash window between the two swap renames: path missing, old save
+    # orphaned at .overwritten.tmp -> load and save must both recover it
+    import os
+
+    os.rename(path, path + ".overwritten.tmp")
+    assert ALSModel.load(path).rank == 3  # load recovers the aside copy
+    assert os.path.exists(path)
+
+
 def test_overwrite_clears_stale_save_of_different_kind(rng, tmp_path):
     # overwriting a model save with an estimator save must not leave the
     # old model files loadable next to the new estimator.json

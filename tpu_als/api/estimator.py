@@ -90,6 +90,19 @@ class _ALSParams(Params):
             raise ValueError("checkpointInterval must be >= 1 or -1")
 
 
+def recover_interrupted_overwrite(path):
+    """If a previous ``.write().overwrite().save(path)`` crashed between
+    its two renames, ``path`` is missing but the old save sits complete at
+    ``path + '.overwritten.tmp'`` — move it back.  Called by both the
+    writer and the load entry points so an intact copy on disk is never
+    unreachable (code-review r2)."""
+    import os
+
+    aside = path.rstrip("/\\") + ".overwritten.tmp"
+    if not os.path.exists(path) and os.path.exists(aside):
+        os.rename(aside, path)
+
+
 class MLWriter:
     """Writer handle giving the reference call shape
     ``instance.write().overwrite().save(path)`` (pyspark ``ml.util.MLWriter``
@@ -108,26 +121,33 @@ class MLWriter:
         import os
         import shutil
 
+        recover_interrupted_overwrite(path)
         if os.path.exists(path):
             if not self._shouldOverwrite:
                 raise IOError(
                     f"path {path} already exists; use "
                     ".write().overwrite().save(path) to replace it")
-            # move the old save aside instead of deleting it, so a crash
-            # mid-save never destroys the only good copy; remove it only
-            # after the new save landed.  (Writing into the old directory
-            # would leave stale files when the save *kinds* differ — e.g.
-            # an estimator.json landing next to an old model manifest.)
-            aside = path.rstrip("/\\") + ".overwritten.tmp"
-            if os.path.exists(aside):
-                shutil.rmtree(aside, ignore_errors=True)
-            os.rename(path, aside)
+            # write the new save to a sibling temp dir FIRST, then swap:
+            # a _save_to failure (ENOSPC, bug) leaves the old save at
+            # ``path`` completely untouched, and the only crash window is
+            # between the two renames — where both copies still exist on
+            # disk (same discipline as io.checkpoint's atomic swap).
+            # (Writing into the old directory in place would leave stale
+            # files when the save *kinds* differ — e.g. an estimator.json
+            # landing next to an old model manifest.)
+            base = path.rstrip("/\\")
+            fresh = base + ".new.tmp"
+            aside = base + ".overwritten.tmp"
+            for tmp in (fresh, aside):
+                if os.path.exists(tmp):
+                    shutil.rmtree(tmp, ignore_errors=True)
             try:
-                self._instance._save_to(path)
+                self._instance._save_to(fresh)
             except BaseException:
-                if not os.path.exists(path):
-                    os.rename(aside, path)  # restore the old save
+                shutil.rmtree(fresh, ignore_errors=True)
                 raise
+            os.rename(path, aside)
+            os.rename(fresh, path)
             shutil.rmtree(aside, ignore_errors=True)
         else:
             self._instance._save_to(path)
@@ -361,6 +381,7 @@ class ALS(_ALSParams):
         import json
         import os
 
+        recover_interrupted_overwrite(path)
         with open(os.path.join(path, "estimator.json")) as f:
             meta = json.load(f)
         if meta.get("class") != "tpu_als.api.estimator.ALS":
@@ -537,6 +558,7 @@ class ALSModel:
 
     @classmethod
     def load(cls, path):
+        recover_interrupted_overwrite(path)
         manifest, u_ids, U, i_ids, V = load_factors(path)
         return cls(rank=manifest["rank"], user_map=IdMap(ids=u_ids),
                    item_map=IdMap(ids=i_ids), user_factors=U, item_factors=V,

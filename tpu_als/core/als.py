@@ -64,7 +64,7 @@ def resolve_solve_path(cfg: AlsConfig, rank):
     'fused_pallas', 'einsum+pallas_cholesky', 'einsum+xla_cholesky'} plus
     the raw probe outcomes.
     """
-    from tpu_als.ops import pallas_fused, pallas_solve
+    from tpu_als.ops import pallas_solve
     from tpu_als.utils.platform import on_tpu
 
     tpu = on_tpu()
@@ -78,7 +78,8 @@ def resolve_solve_path(cfg: AlsConfig, rank):
     if cfg.nonnegative:
         path = "einsum+nnls"
     elif cfg.solve_backend == "fused":
-        fused_ok = bool(tpu and pallas_fused.available(rank))
+        # forced: no probe — dispatch would ignore its outcome, and the
+        # probe costs a Mosaic compile+execute on every resolve
         path = "fused_pallas"
     else:
         solve_ok = bool(tpu and pallas_solve.available(rank))

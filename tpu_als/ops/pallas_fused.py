@@ -92,18 +92,39 @@ def _fused_kernel(Vg_ref, vals_ref, mask_ref, YtY_ref, x_ref, S, LT, bacc,
 
 
 def _tiles(r_pad, w, max_wc=256, budget_elems=1 << 18):
-    """(TN, WC): row tile and width chunk.  VMEM must hold S + LT
-    [TN, r, r] plus double-buffered Vg blocks [TN, WC, r]."""
+    """(TN, WC, W_PAD): row tile, width chunk, (re)padded width.
+
+    Mosaic constrains the LAST dimension of a block to be a multiple of
+    128 or equal to the full array dimension; the width is the last dim of
+    the 2-D vals/mask blocks ``[TN, WC]``, so WC must be the whole (padded)
+    width or a 128-multiple dividing it — shrinking in 8-steps, as this
+    did before round 2, compiles in interpret mode but is rejected by the
+    real Mosaic lowering for any bucket whose width chunks below 128.
+    VMEM must hold S + LT [TN, r, r] plus double-buffered Vg [TN, WC, r];
+    when the width can no longer shrink, the ROW tile shrinks instead.
+    """
     from tpu_als.ops.pallas_solve import _tile_n
 
     tn = _tile_n(r_pad, budget_elems)
-    wc = min(w, max_wc)
-    # keep Vg blocks within ~2 MB so the pipeline double-buffer fits
-    while tn * wc * r_pad > (1 << 19) and wc > 8:
-        wc = max(8, (wc // 2) // 8 * 8)
-    while w % wc:
-        wc -= 8  # w is a multiple of 8; find the largest dividing multiple
-    return tn, max(8, wc)
+    budget = 1 << 19
+    if w <= max_wc:
+        wc = w_pad = w
+    else:
+        w_pad = -(-w // 128) * 128
+        wc = max_wc - (max_wc % 128)
+        while wc > 128 and (tn * wc * r_pad > budget or w_pad % wc):
+            wc -= 128
+    while tn > 8 and tn * wc * r_pad > budget:
+        tn //= 2
+    # Mosaic allocates the kernel body's live temporaries ([TN, panel, r]
+    # shaped, ~20 live at the factorization's deepest point) on the scoped
+    # VMEM stack; _tile_n's budget only models the S/LT scratches, which
+    # at small ranks lets TN grow until the stack blows the 16 MiB limit
+    # (observed: rank 32, TN=256 → "scoped vmem limit exceeded by 7.88M").
+    # Cap TN so TN·panel·r stays ≤ 2^17 elems — measured green at ranks
+    # 32/64/128 on v5e.
+    tn = min(tn, max(8, (1 << 17) // (32 * r_pad)))
+    return tn, wc, w_pad
 
 
 @functools.partial(
@@ -122,8 +143,8 @@ def fused_normal_solve(Vg, vals, mask, YtY=None, *, reg, implicit=False,
     if implicit and YtY is None:
         raise ValueError("implicit fused solve requires YtY")
     r_pad = max(panel, -(-r // panel) * panel)
-    w_pad = -(-w // 8) * 8  # width to a sublane multiple (masked zeros)
-    tn, wc = _tiles(r_pad, w_pad)
+    tn, wc, w_pad = _tiles(r_pad, -(-w // 8) * 8)
+    assert wc == w_pad or (wc % 128 == 0 and w_pad % wc == 0), (wc, w_pad)
     n_pad = -(-N // tn) * tn
     Vg = jnp.pad(Vg, ((0, n_pad - N), (0, w_pad - w), (0, r_pad - r)))
     vals = jnp.pad(vals, ((0, n_pad - N), (0, w_pad - w)))
@@ -195,8 +216,8 @@ def available(rank=128, panel=32):
         # scratch-accumulator revisiting across the inner grid dimension
         w = 64
         while True:
-            tn, wc = _tiles(r_pad, w)
-            if w // wc >= 2:
+            tn, wc, w_pad = _tiles(r_pad, -(-w // 8) * 8)
+            if w_pad // wc >= 2:
                 break
             w *= 2
         n = 2 * tn

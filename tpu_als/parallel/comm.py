@@ -116,6 +116,7 @@ def shard_csr_grid(row_part, col_part, row_idx, col_idx, vals,
     bucket_widths = sorted(set(widths_all[rated].tolist()))
     local_pos = np.full(D * num_rows, -1, dtype=np.int64)
     nb_pads = []
+    selections = {}  # (w, d) -> row indices, reused by the fill loop below
     for w in bucket_widths:
         nb_need = 0
         for d in range(D):
@@ -123,6 +124,7 @@ def shard_csr_grid(row_part, col_part, row_idx, col_idx, vals,
             sel = np.flatnonzero(
                 rated[lo:lo + num_rows]
                 & (widths_all[lo:lo + num_rows] == w))
+            selections[w, d] = sel
             local_pos[lo + sel] = np.arange(len(sel))
             nb_need = max(nb_need, len(sel))
         chunk = scan_chunk(nb_need, w, chunk_elems)
@@ -141,10 +143,7 @@ def shard_csr_grid(row_part, col_part, row_idx, col_idx, vals,
     for w, nb in zip(bucket_widths, nb_pads):
         rows = np.full((D, nb), num_rows, dtype=np.int32)
         for d in range(D):
-            lo = d * num_rows
-            sel = np.flatnonzero(
-                rated[lo:lo + num_rows]
-                & (widths_all[lo:lo + num_rows] == w))
+            sel = selections[w, d]
             rows[d, :len(sel)] = sel
         cols = np.zeros((D, S, nb, w), dtype=np.int32)
         v = np.zeros((D, S, nb, w), dtype=np.float32)

@@ -22,6 +22,21 @@ def probe_kernel(cache, key, probe):
     return cache[key]
 
 
+def fence(x):
+    """Force device completion via a scalar readback and return the sum of
+    absolute values (doubles as a checksum).
+
+    ``block_until_ready`` alone has been seen returning early on the
+    experimental axon platform (tunneled TPU), which silently breaks any
+    wall-clock measurement; a device->host scalar transfer cannot complete
+    before the producing computation has.  Used by bench.py and
+    scripts/ablate.py around every timed region.
+    """
+    import jax.numpy as jnp
+
+    return float(jnp.sum(jnp.abs(x)))
+
+
 def on_tpu():
     """True when the default JAX backend drives a TPU chip.
 

@@ -305,10 +305,128 @@ def run_rmse(args):
     }
 
 
+def run_foldin(args):
+    """Fold-in p50 latency (BASELINE.json config 4): micro-batches of new
+    ratings folded into a fitted model's user factors against fixed item
+    factors.  Item catalog at ML-25M size so the jitted solve runs at the
+    production shape; latency includes the host-side batch prep (that IS
+    the serving path)."""
+    import numpy as np
+
+    import jax
+
+    from tpu_als.api.estimator import ALS
+    from tpu_als.io.movielens import ML25M_SHAPE, synthetic_movielens
+    from tpu_als.stream.microbatch import FoldInServer
+    from tpu_als.utils.frame import ColumnarFrame
+
+    nU_cat, nI, _ = ML25M_SHAPE
+    nU = 20000   # training-user count only affects fit time, not fold-in
+    nnz = 2_000_000
+    if args.small:
+        nU, nI, nnz = nU // 10, nI // 10, nnz // 10
+    devs = call_with_timeout(jax.devices, 180, "jax.devices() hung")
+    log(f"devices: {devs}")
+    frame = synthetic_movielens(nU, nI, nnz, seed=0)
+    model = ALS(rank=args.rank, maxIter=2, regParam=0.01, seed=0).fit(frame)
+    log("model fitted; running fold-in batches")
+
+    srv = FoldInServer(model)
+    rng = np.random.default_rng(1)
+    base = int(model._user_map.ids.max()) + 1
+    batches = 30
+    for b in range(batches):
+        n = args.foldin_batch
+        srv.update(ColumnarFrame({
+            "user": rng.integers(base, base + 1000, n),
+            "item": rng.choice(model._item_map.ids, n),
+            "rating": rng.uniform(0.5, 5.0, n).astype(np.float32),
+        }))
+    p50 = srv.latency(0.5, skip_warmup=True)
+    p95 = srv.latency(0.95, skip_warmup=True)
+    return {
+        "value": round(p50, 4),
+        "unit": "seconds_p50",
+        "vs_baseline": None,
+        "baseline_note": "reference stack has no fold-in (full refit "
+                         "required; SURVEY.md §3.5) — latency vs refit is "
+                         "the comparison",
+        "config": {
+            "rank": args.rank, "items": nI, "batch_size": args.foldin_batch,
+            "batches": batches, "p95_seconds": round(p95, 4),
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def run_twotower(args):
+    """Two-tower retrieval recall@10 (BASELINE.json config 5), ALS-warm
+    vs cold start, on held-out positives."""
+    import numpy as np
+
+    import jax
+
+    from tpu_als.core.als import AlsConfig, train
+    from tpu_als.core.ratings import build_csr_buckets
+    from tpu_als.io.movielens import synthetic_movielens
+    from tpu_als.models.two_tower import (
+        TwoTowerConfig, recall_at_k, train_two_tower)
+
+    devs = call_with_timeout(jax.devices, 180, "jax.devices() hung")
+    log(f"devices: {devs}")
+    nU, nI, nnz = 20000, 4000, 800_000
+    if args.small:
+        nU, nI, nnz = nU // 10, nI // 10, nnz // 10
+    frame = synthetic_movielens(nU, nI, nnz, seed=0)
+    u = np.asarray(frame["user"])
+    i = np.asarray(frame["item"])
+    r = np.asarray(frame["rating"])
+    pos = r >= 3.5  # positives for retrieval
+    u, i, r = u[pos], i[pos], r[pos]
+    rng = np.random.default_rng(2)
+    test = rng.random(len(u)) < 0.1
+    ut, it_ = u[test], i[test]
+    u2, i2, r2 = u[~test], i[~test], r[~test]
+
+    als_cfg = AlsConfig(rank=32, max_iter=8, reg_param=0.005,
+                        implicit_prefs=True, alpha=20.0, seed=0)
+    ucsr = build_csr_buckets(u2, i2, r2, nU)
+    icsr = build_csr_buckets(i2, u2, r2, nI)
+    U, V = train(ucsr, icsr, als_cfg)
+    log("ALS warm-start factors trained")
+
+    cfg = TwoTowerConfig(embed_dim=32, out_dim=32, epochs=args.tt_epochs,
+                         seed=0)
+    t0 = time.time()
+    warm = train_two_tower(u2, i2, nU, nI, cfg,
+                           als_user_factors=np.asarray(U),
+                           als_item_factors=np.asarray(V))
+    warm_s = time.time() - t0
+    cold = train_two_tower(u2, i2, nU, nI, cfg)
+    r_warm = recall_at_k(warm, ut, it_, k=10)
+    r_cold = recall_at_k(cold, ut, it_, k=10)
+    log(f"recall@10 warm {r_warm:.4f} vs cold {r_cold:.4f}")
+    return {
+        "value": round(r_warm, 4),
+        "unit": "recall_at_10",
+        "vs_baseline": round(r_warm / max(r_cold, 1e-9), 3),
+        "baseline_note": "vs_baseline = warm-start recall / cold-start "
+                         "recall at equal epochs (>1 = ALS warm start "
+                         "helps); reference stack has no neural retrieval",
+        "config": {
+            "users": nU, "items": nI, "train_pairs": int(len(u2)),
+            "test_pairs": int(len(ut)), "epochs": cfg.epochs,
+            "cold_recall_at_10": round(r_cold, 4),
+            "train_seconds_warm": round(warm_s, 1),
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="headline",
-                    choices=["headline", "rmse"])
+                    choices=["headline", "rmse", "foldin", "twotower"])
     ap.add_argument("--small", action="store_true",
                     help="1/25 scale for quick checks")
     ap.add_argument("--iters", type=int, default=3,
@@ -325,6 +443,10 @@ def main():
     ap.add_argument("--compute-dtype", default="float32",
                     choices=["float32", "bfloat16"],
                     help="dtype for the gather/einsum stage")
+    ap.add_argument("--foldin-batch", type=int, default=512,
+                    help="ratings per micro-batch (foldin mode)")
+    ap.add_argument("--tt-epochs", type=int, default=5,
+                    help="two-tower training epochs (twotower mode)")
     ap.add_argument("--width-growth", type=float, default=2.0,
                     choices=[2.0, 1.5],
                     help="bucket width ladder: 2.0 = powers of two, "
@@ -339,12 +461,15 @@ def main():
     ap.add_argument("--probe-timeout", type=int, default=120)
     args = ap.parse_args()
 
-    metric = ("als_iters_per_sec_rank128_ml25m_implicit"
-              if args.mode == "headline"
-              else "als_heldout_rmse_ml25m_explicit")
+    metric, unit = {
+        "headline": ("als_iters_per_sec_rank128_ml25m_implicit",
+                     "iters/sec"),
+        "rmse": ("als_heldout_rmse_ml25m_explicit", "rmse_stars"),
+        "foldin": ("foldin_p50_latency", "seconds_p50"),
+        "twotower": ("two_tower_recall_at_10", "recall_at_10"),
+    }[args.mode]
     if args.small:
         metric += "_small"
-    unit = "iters/sec" if args.mode == "headline" else "rmse_stars"
 
     if args.platform == "cpu":
         import jax
@@ -358,8 +483,9 @@ def main():
             return
 
     try:
-        result = run_headline(args) if args.mode == "headline" \
-            else run_rmse(args)
+        run = {"headline": run_headline, "rmse": run_rmse,
+               "foldin": run_foldin, "twotower": run_twotower}[args.mode]
+        result = run(args)
         result["metric"] = metric
     except Exception as e:  # tunnel can die mid-run; JSON contract holds
         import traceback

@@ -148,10 +148,9 @@ def cmd_foldin_bench(args):
         if b == 0:
             print(f"warmup batch: {time.perf_counter()-t0:.3f}s",
                   file=sys.stderr)
-    lat = sorted(s[2] for s in srv.stats[1:]) or [float("nan")]
     print(json.dumps({
         "metric": "foldin_p50_latency",
-        "value": round(lat[len(lat) // 2], 4),
+        "value": round(srv.latency(0.5, skip_warmup=True), 4),
         "unit": "seconds",
         "batches": args.batches,
         "batch_size": args.batch_size,

@@ -91,7 +91,7 @@ def _fused_kernel(Vg_ref, vals_ref, mask_ref, YtY_ref, x_ref, S, LT, bacc,
         x_ref[:] = substitute(LT, bacc[:], tn=tn, r=r, panel=panel)
 
 
-def _tiles(r_pad, w, max_wc=256, budget_elems=1 << 18):
+def _tiles(r_pad, w, max_wc=256, budget_elems=1 << 18, panel=16):
     """(TN, WC, W_PAD): row tile, width chunk, (re)padded width.
 
     Mosaic constrains the LAST dimension of a block to be a multiple of
@@ -121,9 +121,9 @@ def _tiles(r_pad, w, max_wc=256, budget_elems=1 << 18):
     # VMEM stack; _tile_n's budget only models the S/LT scratches, which
     # at small ranks lets TN grow until the stack blows the 16 MiB limit
     # (observed: rank 32, TN=256 → "scoped vmem limit exceeded by 7.88M").
-    # Cap TN so TN·panel·r stays ≤ 2^17 elems — measured green at ranks
-    # 32/64/128 on v5e.
-    tn = min(tn, max(8, (1 << 17) // (32 * r_pad)))
+    # Cap TN so TN·panel·r stays ≤ 2^17 elems at panel 32 — measured green
+    # at ranks 32/64/128 on v5e; scale with the caller's actual panel.
+    tn = min(tn, max(8, (1 << 17) // (max(panel, 32) * r_pad)))
     return tn, wc, w_pad
 
 
@@ -133,7 +133,7 @@ def _tiles(r_pad, w, max_wc=256, budget_elems=1 << 18):
                      "interpret"),
 )
 def fused_normal_solve(Vg, vals, mask, YtY=None, *, reg, implicit=False,
-                       alpha=1.0, panel=32, jitter=1e-6, interpret=False):
+                       alpha=1.0, panel=16, jitter=1e-6, interpret=False):
     """x = (ΣvvᵀC + λnI [+ YᵀY])⁻¹ (ΣcCp) for every row, A never in HBM.
 
     Vg [N, w, r] gathered opposite factors; vals/mask [N, w]; YtY [r, r]
@@ -143,7 +143,7 @@ def fused_normal_solve(Vg, vals, mask, YtY=None, *, reg, implicit=False,
     if implicit and YtY is None:
         raise ValueError("implicit fused solve requires YtY")
     r_pad = max(panel, -(-r // panel) * panel)
-    tn, wc, w_pad = _tiles(r_pad, -(-w // 8) * 8)
+    tn, wc, w_pad = _tiles(r_pad, -(-w // 8) * 8, panel=panel)
     assert wc == w_pad or (wc % 128 == 0 and w_pad % wc == 0), (wc, w_pad)
     n_pad = -(-N // tn) * tn
     Vg = jnp.pad(Vg, ((0, n_pad - N), (0, w_pad - w), (0, r_pad - r)))
@@ -197,7 +197,7 @@ def fused_normal_solve(Vg, vals, mask, YtY=None, *, reg, implicit=False,
 _AVAILABLE = {}
 
 
-def available(rank=128, panel=32):
+def available(rank=128, panel=16):
     """Compile-and-run probe, cached per (padded rank, panel) — same
     contract as tpu_als.ops.pallas_solve.available.  The probe validates
     the kernel output against the unfused XLA path on a random instance,
@@ -216,7 +216,7 @@ def available(rank=128, panel=32):
         # scratch-accumulator revisiting across the inner grid dimension
         w = 64
         while True:
-            tn, wc, w_pad = _tiles(r_pad, -(-w // 8) * 8)
+            tn, wc, w_pad = _tiles(r_pad, -(-w // 8) * 8, panel=panel)
             if w_pad // wc >= 2:
                 break
             w *= 2

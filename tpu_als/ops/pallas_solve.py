@@ -196,7 +196,7 @@ def _tile_n(r_pad, budget_elems=1 << 19):
 
 
 @functools.partial(jax.jit, static_argnames=("panel", "interpret"))
-def spd_solve_pallas(A, b, panel=32, interpret=False):
+def spd_solve_pallas(A, b, panel=16, interpret=False):
     """Batched SPD solve x = A⁻¹ b.  A [N, r, r] f32, b [N, r] f32.
 
     Caller must pre-regularize A (SPD with jitter; identity for empty rows)
@@ -248,7 +248,7 @@ def spd_solve_pallas(A, b, panel=32, interpret=False):
 _AVAILABLE = {}  # (r_pad, panel) -> bool, probed once per process
 
 
-def available(rank=128, panel=32):
+def available(rank=128, panel=16):
     """True when the kernel actually compiles AND runs on the local TPU's
     Mosaic version **at this rank** — probed once per process per padded
     rank with a tiny instance (VMEM budgets and Mosaic lowering both depend

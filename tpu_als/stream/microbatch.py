@@ -115,6 +115,14 @@ class FoldInServer:
             dense = m._user_map.to_dense(touched_raw_ids)
         m._U[dense] = new_rows
 
+    def latency(self, q=0.5, skip_warmup=False):
+        """Latency quantile over processed batches.  ``skip_warmup`` drops
+        the first batch (jit compile) — what latency benchmarks want."""
+        stats = self.stats[1:] if skip_warmup else self.stats
+        lat = sorted(s[2] for s in stats)
+        if not lat:
+            return float("nan")
+        return lat[min(len(lat) - 1, int(len(lat) * q))]
+
     def p50_latency(self):
-        lat = sorted(s[2] for s in self.stats)
-        return lat[len(lat) // 2] if lat else float("nan")
+        return self.latency(0.5)

@@ -1,0 +1,101 @@
+"""Batch-in-lanes Pallas SPD solver vs dense reference (interpret mode on
+the CPU test mesh; the same kernel compiles for real on TPU — measured
+2.2x the blocked kernel at rank 128 on v5e)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tpu_als.ops.pallas_lanes import (
+    LANES,
+    available,
+    spd_solve_lanes,
+    supported_rank,
+)
+from tpu_als.ops.solve import solve_spd
+
+
+def _spd_problem(rng, N, r, scale=1.0):
+    M = rng.normal(size=(N, r, r)).astype(np.float32) * scale
+    A = M @ M.transpose(0, 2, 1) + 0.5 * np.eye(r, dtype=np.float32)
+    b = rng.normal(size=(N, r)).astype(np.float32)
+    return jnp.asarray(A), jnp.asarray(b)
+
+
+@pytest.mark.parametrize("N,r", [
+    (5, 4),           # tiny everything, heavy batch padding
+    (37, 10),         # the ALS default rank
+    (LANES, 32),      # exactly one lane group
+    (LANES + 9, 64),  # two groups, second mostly padding
+    (40, 128),        # the benchmark rank
+])
+def test_matches_dense_solve(rng, N, r):
+    A, b = _spd_problem(rng, N, r)
+    x = np.asarray(spd_solve_lanes(A, b, interpret=True))
+    ref = np.stack([np.linalg.solve(np.asarray(A)[k], np.asarray(b)[k])
+                    for k in range(N)])
+    denom = max(1.0, np.abs(ref).max())
+    assert np.abs(x - ref).max() / denom < 5e-3
+
+
+def test_matches_solve_spd_contract(rng):
+    # same prep as solve_spd: empty rows (count=0) -> identity A, zero b
+    N, r = 24, 16
+    A, b = _spd_problem(rng, N, r)
+    count = np.ones(N, np.float32)
+    count[::5] = 0.0
+    b = jnp.asarray(np.where(count[:, None] > 0, np.asarray(b), 0.0))
+    x_ref = solve_spd(A, b, jnp.asarray(count), backend="xla")
+    eye = jnp.eye(r)
+    Ap = jnp.where((count <= 0)[:, None, None], eye, A) + 1e-6 * eye
+    x_lan = spd_solve_lanes(Ap, b, interpret=True)
+    np.testing.assert_allclose(np.asarray(x_lan), np.asarray(x_ref),
+                               atol=2e-4, rtol=2e-3)
+    assert (np.asarray(x_lan)[::5] == 0).all()
+
+
+def test_rank_gate():
+    # the [r, r, 128] scratch exceeds VMEM above rank 128: the blocked
+    # kernel owns that regime and available() must refuse without probing
+    assert supported_rank(128)
+    assert not supported_rank(136)
+    assert available(256) is False
+
+
+def test_solve_spd_accepts_lanes_backend(rng):
+    N, r = 16, 8
+    A, b = _spd_problem(rng, N, r)
+    count = jnp.ones((N,), jnp.float32)
+    with pytest.raises(ValueError, match="unknown solve backend"):
+        solve_spd(A, b, count, backend="warp")
+
+
+class TestAvailableProbe:
+    """Same standard as pallas_solve.available: wrong-but-finite output
+    fails, crashes fail, correct output passes."""
+
+    def _probe(self, monkeypatch, fake_kernel):
+        from tpu_als.ops import pallas_lanes
+        from tpu_als.utils import platform
+
+        monkeypatch.setattr(platform, "on_tpu", lambda: True)
+        monkeypatch.setattr(pallas_lanes, "_AVAILABLE", {})
+        monkeypatch.setattr(pallas_lanes, "spd_solve_lanes", fake_kernel)
+        return pallas_lanes.available(32)
+
+    def test_rejects_wrong_but_finite_kernel(self, monkeypatch):
+        assert self._probe(
+            monkeypatch, lambda A, b, interpret=False: b) is False
+
+    def test_rejects_crashing_kernel(self, monkeypatch):
+        def boom(A, b, interpret=False):
+            raise RuntimeError("mosaic compile failure")
+
+        assert self._probe(monkeypatch, boom) is False
+
+    def test_accepts_correct_kernel(self, monkeypatch):
+        assert self._probe(
+            monkeypatch,
+            lambda A, b, interpret=False: jnp.linalg.solve(
+                A, b[..., None])[..., 0],
+        ) is True

@@ -44,9 +44,10 @@ class AlsConfig:
     seed: int = 0
     nnls_sweeps: int = 32
     compute_dtype: str = "float32"  # or "bfloat16" for the A/b einsums
-    # 'auto': einsum normal equations + the Pallas blocked-Cholesky solve
-    # on TPU when it probes healthy (tpu_als.ops.pallas_solve), else the
-    # XLA cholesky lowering; 'fused' forces the fused normal-eq+solve
+    # 'auto': einsum normal equations + the fastest healthy Pallas solve —
+    # batch-in-lanes (tpu_als.ops.pallas_lanes, rank <= 128, 2.2x the
+    # blocked kernel on v5e) then blocked Cholesky (pallas_solve), else
+    # the XLA cholesky lowering; 'fused' forces the fused normal-eq+solve
     # kernel (tpu_als.ops.pallas_fused — measured 34x SLOWER than the
     # einsum+pallas path on v5e at ML-25M/25 rank 128, kept for ablation
     # and for regimes where the A tensor's HBM round-trip dominates);
@@ -61,10 +62,10 @@ def resolve_solve_path(cfg: AlsConfig, rank):
     backends, not requested ones).
 
     Returns a dict with ``resolved_solve_path`` ∈ {'einsum+nnls',
-    'fused_pallas', 'einsum+pallas_cholesky', 'einsum+xla_cholesky'} plus
-    the raw probe outcomes.
+    'fused_pallas', 'einsum+pallas_lanes', 'einsum+pallas_cholesky',
+    'einsum+xla_cholesky'} plus the raw probe outcomes.
     """
-    from tpu_als.ops import pallas_solve
+    from tpu_als.ops import pallas_lanes, pallas_solve
     from tpu_als.utils.platform import on_tpu
 
     tpu = on_tpu()
@@ -74,7 +75,7 @@ def resolve_solve_path(cfg: AlsConfig, rank):
     # (round 2 ablation, ML-25M/25 rank 128) fused = 3.93 s/iter vs
     # einsum+pallas_cholesky = 0.114 s/iter — the VMEM-resident solve on
     # the einsum-built A wins; 'fused' stays available explicitly.
-    fused_ok = solve_ok = None
+    fused_ok = solve_ok = lanes_ok = None
     if cfg.nonnegative:
         path = "einsum+nnls"
     elif cfg.solve_backend == "fused":
@@ -82,12 +83,17 @@ def resolve_solve_path(cfg: AlsConfig, rank):
         # probe costs a Mosaic compile+execute on every resolve
         path = "fused_pallas"
     else:
-        solve_ok = bool(tpu and pallas_solve.available(rank))
-        path = ("einsum+pallas_cholesky" if solve_ok
-                else "einsum+xla_cholesky")
+        lanes_ok = bool(tpu and pallas_lanes.available(rank))
+        if lanes_ok:
+            path = "einsum+pallas_lanes"
+        else:
+            solve_ok = bool(tpu and pallas_solve.available(rank))
+            path = ("einsum+pallas_cholesky" if solve_ok
+                    else "einsum+xla_cholesky")
     return {
         "solve_backend_requested": cfg.solve_backend,
         "fused_kernel_probe": fused_ok,
+        "pallas_lanes_probe": lanes_ok,
         "pallas_solve_probe": solve_ok,
         "resolved_solve_path": path,
         "on_tpu": tpu,

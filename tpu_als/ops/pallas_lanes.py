@@ -1,0 +1,199 @@
+"""Pallas TPU kernel: batched SPD solve with the BATCH dimension in lanes.
+
+Second-generation layout for the ALS solve (see tpu_als.ops.pallas_solve
+for the first): instead of tiling matrices over the batch dimension and
+running the Cholesky recurrence with masked lane reductions and one-hot
+MXU extractions, this kernel lays the working set out as ``S[a, b, t] =
+A_t[b, a]`` with ``t`` (the matrix index) in the 128-wide LANE dimension.
+The serial column recurrence then vectorizes across 128 matrices at once
+and every per-column step becomes a *static sublane slice*:
+
+  * column ``j`` of all 128 matrices is ``S[j]`` — a [r, 128] slice, no
+    masked reduction;
+  * the pivot ``d = S[j, j]`` is a [128] vector — no lane extraction;
+  * the rank-1 trailing update is one broadcast multiply-subtract over
+    ``[r, r, 128]`` — no one-hot selector matmuls.
+
+The trade: the MXU cannot batch over lanes, so the trailing update runs on
+the VPU at r³ (vs the blocked scheme's r³/3 + MXU panels).  What that buys
+is the removal of every cross-lane reduction and selector dot from the
+serial chain — which is what actually bounds the first-generation kernel
+(measured: its runtime is invariant to the batch-tile size, so it is
+latency-, not throughput-, bound).
+
+Substitution uses the same layout: y and x live as [r, 128] panels and
+each forward/backward step is a [128]-wide vector operation.
+
+Same contract as ``spd_solve_pallas``: caller pre-regularizes A (jitter +
+empty-row identity guard); rows with b = 0 solve to x = 0.  Replaces the
+reference stack's per-entity LAPACK ``dppsv`` (Spark MLlib
+``CholeskySolver``, SURVEY.md §2.B5/C1).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+
+
+def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, sem, *, r):
+    """One lane-group: factorize 128 matrices and solve.
+
+    A_ref [G, r, r, LANES] stays in HBM (``memory_space=ANY``) with
+    A_ref[g, a, b, t] = A_t[b, a] (column-major per matrix so column j is
+    a leading-axis slice); the kernel DMAs group ``g`` straight into the
+    working scratch ``S`` [r, r, LANES] — at r=128 the group is 8 MB, so a
+    pipelined (double-buffered) input block plus the scratch would blow
+    the 16 MiB VMEM limit, and the copy (~10 µs at HBM bandwidth) is
+    negligible against the factorization anyway.  b_ref / x_ref
+    [1, r, LANES].  After the loop S[j] holds column j of L (entries above
+    the diagonal zeroed).
+    """
+    g = pl.program_id(0)
+    cp = pltpu.make_async_copy(A_ref.at[g], S, sem)
+    cp.start()
+    cp.wait()
+    sub = jax.lax.broadcasted_iota(jnp.int32, (r, LANES), 0)  # row index b
+
+    def col(j, _):
+        cj = S[j]                                   # [r, LANES]
+        d = jnp.sum(jnp.where(sub == j, cj, 0.0), axis=0)     # pivot [LANES]
+        inv = jax.lax.rsqrt(jnp.maximum(d, 1e-30))
+        ncol = jnp.where(sub >= j, cj * inv[None, :], 0.0)    # L[:, j]
+        # trailing rank-1 update, unmasked over the column axis: ncol is
+        # zero above row j, so columns a < j receive no update, and
+        # columns a <= j are never read again anyway — skipping the
+        # where-mask pass is free
+        S[:] = S[:] - ncol[:, None, :] * ncol[None, :, :]
+        # column j itself was hit by the update (a == j); store the factor
+        S[j] = ncol
+        return 0
+
+    jax.lax.fori_loop(0, r, col, 0, unroll=False)
+
+    # forward substitution L y = b: y_j = (b_j - Σ_{k<j} L[j,k] y_k)/L[j,j]
+    def fwd(j, res):
+        cj = S[j]                                   # column j of L [r, LANES]
+        d = jnp.sum(jnp.where(sub == j, cj, 0.0), axis=0)
+        yj = jnp.sum(jnp.where(sub == j, res, 0.0), axis=0) / d
+        # subtract y_j * L[b, j] from all later rows b > j
+        res = jnp.where(sub > j, res - yj[None, :] * cj, res)
+        res = jnp.where(sub == j, yj[None, :], res)
+        return res
+
+    y = jax.lax.fori_loop(0, r, fwd, b_ref[0], unroll=False)
+
+    # backward substitution Lᵀ x = y: x_j = (y_j - Σ_{k>j} L[k,j] x_k)/L[j,j]
+    def bwd(t, res):
+        j = r - 1 - t
+        cj = S[j]
+        d = jnp.sum(jnp.where(sub == j, cj, 0.0), axis=0)
+        # Σ_{k>j} L[k, j] x_k: column j of L holds exactly those entries
+        s = jnp.sum(jnp.where(sub > j, cj * res, 0.0), axis=0)
+        xj = (jnp.sum(jnp.where(sub == j, res, 0.0), axis=0) - s) / d
+        res = jnp.where(sub == j, xj[None, :], res)
+        return res
+
+    x_ref[0] = jax.lax.fori_loop(0, r, bwd, y, unroll=False)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def spd_solve_lanes(A, b, interpret=False):
+    """Batched SPD solve x = A⁻¹ b.  A [N, r, r] f32, b [N, r] f32.
+
+    Drop-in for ``spd_solve_pallas``; transposes to the lanes layout on
+    device (one XLA transpose each way, fused into neighbours where
+    possible).
+    """
+    N, r = b.shape
+    r_pad = -(-r // 8) * 8
+    n_pad = -(-N // LANES) * LANES
+    eye_tail = jnp.eye(r_pad, dtype=jnp.float32)[None, :, :]
+    Ap = jnp.pad(A, ((0, n_pad - N), (0, r_pad - r), (0, r_pad - r)))
+    diag_fix = jnp.where(
+        (jax.lax.broadcasted_iota(jnp.int32, (1, r_pad, r_pad), 1) >= r)
+        | (jnp.arange(n_pad)[:, None, None] >= N),
+        eye_tail, 0.0,
+    )
+    Ap = Ap + diag_fix
+    bp = jnp.pad(b, ((0, n_pad - N), (0, r_pad - r)))
+
+    # [N, b, a] -> [G, a, b, t]: column-major per matrix, batch in lanes
+    G = n_pad // LANES
+    At = jnp.transpose(
+        Ap.reshape(G, LANES, r_pad, r_pad), (0, 3, 2, 1))
+    bt = jnp.transpose(bp.reshape(G, LANES, r_pad), (0, 2, 1))
+
+    kernel = functools.partial(_chol_lanes_kernel, r=r_pad)
+    xt = pl.pallas_call(
+        kernel,
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((1, r_pad, LANES), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, r_pad, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((G, r_pad, LANES), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((r_pad, r_pad, LANES), jnp.float32),
+                        pltpu.SemaphoreType.DMA],
+        cost_estimate=pl.CostEstimate(
+            flops=int(n_pad * (r_pad ** 3 + 4 * r_pad ** 2)),
+            bytes_accessed=(n_pad * r_pad * r_pad + 2 * n_pad * r_pad) * 4,
+            transcendentals=n_pad * r_pad,
+        ),
+        interpret=interpret,
+    )(At, bt)
+    x = jnp.transpose(xt, (0, 2, 1)).reshape(n_pad, r_pad)
+    return x[:N, :r]
+
+
+_AVAILABLE = {}  # r_pad -> bool, probed once per process
+
+
+def supported_rank(rank):
+    """VMEM feasibility: the [r, r, LANES] scratch must fit alongside the
+    b/x blocks — r_pad = 128 uses 8 MiB of the 16 MiB scoped limit; the
+    next multiple of 8 over 128 is already pushing 10+ MiB with DMA
+    staging, so the blocked kernel (tpu_als.ops.pallas_solve) owns ranks
+    above 128."""
+    r_pad = -(-rank // 8) * 8
+    return r_pad <= 128
+
+
+def available(rank=128):
+    """True when the kernel compiles AND produces correct results on the
+    local TPU at this rank — validated against the XLA lowering on a
+    random SPD batch (same standard as pallas_solve.available)."""
+    from tpu_als.utils.platform import probe_kernel
+
+    r_pad = -(-rank // 8) * 8
+    if not supported_rank(rank):
+        return False
+
+    def probe():
+        import numpy as np
+
+        from tpu_als.ops.solve import solve_spd
+
+        n, r = LANES + 8, r_pad  # force 2 lane groups + batch padding
+        rng = np.random.default_rng(0)
+        M = rng.normal(size=(n, r, r)).astype(np.float32) / np.sqrt(r)
+        A = jnp.asarray(
+            M @ np.swapaxes(M, 1, 2)
+            + 0.5 * np.eye(r, dtype=np.float32)[None])
+        b = jnp.asarray(rng.normal(size=(n, r)).astype(np.float32))
+        x = spd_solve_lanes(A + 1e-6 * jnp.eye(r), b)
+        x.block_until_ready()
+        ref = solve_spd(A, b, jnp.ones((n,), jnp.float32), backend="xla")
+        return np.allclose(np.asarray(x), np.asarray(ref), atol=1e-3,
+                           rtol=1e-2)
+
+    return probe_kernel(_AVAILABLE, r_pad, probe)

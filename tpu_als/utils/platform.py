@@ -54,8 +54,9 @@ def probe_kernel(cache, key, probe):
                     warnings.warn(
                         f"Pallas kernel probe {key} failed"
                         f"{' (transient, retries exhausted)' if transient else ''}"
-                        f" — callers fall back to the XLA lowering for this "
-                        f"process: {msg[:200]}", stacklevel=2)
+                        f" — callers fall back to the next backend in "
+                        f"preference order for this process: {msg[:200]}",
+                        stacklevel=2)
                     cache[key] = False
                     break
     return cache[key]

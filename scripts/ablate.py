@@ -93,7 +93,7 @@ def main():
     ap.add_argument("--variants", nargs="*", default=[
         "full", "no-solve", "no-gather", "no-neq", "no-scatter"])
     ap.add_argument("--solve-backend", default="auto",
-                    choices=["auto", "xla", "pallas", "fused"])
+                    choices=["auto", "xla", "pallas", "lanes", "fused"])
     ap.add_argument("--subproc", action="store_true",
                     help="run each variant in its own subprocess with a "
                          "timeout so one pathological compile cannot hang "
@@ -141,6 +141,15 @@ def main():
         return U, V
 
     from tpu_als.utils.platform import fence
+
+    if args.solve_backend in ("auto", "pallas", "lanes"):
+        # probe the solve kernels EAGERLY: probes cannot run inside the
+        # jit traces below (probe_kernel degrades that trace to the
+        # fallback without caching), which would silently measure the XLA
+        # path under an 'auto' label
+        from tpu_als.ops.solve import prewarm_solve
+
+        prewarm_solve(rank)
 
     base = None
     for ab in args.variants:

@@ -62,10 +62,27 @@ def test_rank_gate():
     assert available(256) is False
 
 
-def test_solve_spd_accepts_lanes_backend(rng):
+def test_solve_spd_lanes_backend_dispatch(rng, monkeypatch):
+    # backend='lanes' must route to spd_solve_lanes (a refactor dropping
+    # 'lanes' from the dispatch would otherwise only surface on TPU, at
+    # trace time); unknown backends must raise
+    from tpu_als.ops import pallas_lanes
+
     N, r = 16, 8
     A, b = _spd_problem(rng, N, r)
     count = jnp.ones((N,), jnp.float32)
+    hits = []
+
+    def fake(Ax, bx, interpret=False):
+        hits.append(Ax.shape)
+        return jnp.linalg.solve(Ax, bx[..., None])[..., 0]
+
+    monkeypatch.setattr(pallas_lanes, "spd_solve_lanes", fake)
+    x = solve_spd(A, b, count, backend="lanes")
+    assert hits and hits[0] == (N, r, r)
+    ref = solve_spd(A, b, count, backend="xla")
+    np.testing.assert_allclose(np.asarray(x), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
     with pytest.raises(ValueError, match="unknown solve backend"):
         solve_spd(A, b, count, backend="warp")
 

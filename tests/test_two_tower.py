@@ -43,6 +43,35 @@ def test_als_warm_start(rng):
     assert r_warm > r_cold, (r_warm, r_cold)
 
 
+def test_popularity_correction_changes_loss_and_stays_finite(rng):
+    # one dominant item: the logQ correction must shift the logits (loss
+    # differs from the uncorrected run) and training must stay finite
+    import jax.numpy as jnp
+
+    from tpu_als.models.two_tower import in_batch_softmax_loss, init_params
+    import jax
+
+    nU, nI, n = 30, 10, 200
+    u = rng.integers(0, nU, n)
+    i = np.where(rng.random(n) < 0.7, 0, rng.integers(1, nI, n))  # item 0 hot
+    counts = np.bincount(i, minlength=nI).astype(np.float64)
+    log_q = jnp.asarray(
+        np.log((counts + 1) / (counts.sum() + nI)), jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), nU, nI,
+                         TwoTowerConfig(embed_dim=4, hidden=(), out_dim=4))
+    ub, ib = jnp.asarray(u[:64]), jnp.asarray(i[:64])
+    w = jnp.ones(64)
+    l_plain = in_batch_softmax_loss(params, ub, ib, w, 0.1)
+    l_corr = in_batch_softmax_loss(params, ub, ib, w, 0.1, log_q)
+    assert np.isfinite(float(l_plain)) and np.isfinite(float(l_corr))
+    assert abs(float(l_plain) - float(l_corr)) > 1e-4
+
+    cfg = TwoTowerConfig(embed_dim=4, hidden=(), out_dim=4, epochs=2,
+                         batch_size=64, popularity_correction=True, seed=0)
+    p = train_two_tower(u, i, nU, nI, cfg)
+    assert np.isfinite(np.asarray(p["item_embed"])).all()
+
+
 def test_from_fitted_als_model(rng):
     from tpu_als import ALS, ColumnarFrame
 

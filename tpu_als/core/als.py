@@ -192,6 +192,10 @@ def make_step(user_buckets, item_buckets, num_users, num_items, cfg: AlsConfig,
     inside the compile payload (and re-compiling whenever the data changes).
     As arguments they stay on device and the compiled step is reusable.
     """
+    # probe the solve kernels EAGERLY: a probe firing inside the jit trace
+    # below cannot run (and the jit cache would pin the fallback path for
+    # the step's lifetime) — see ops.solve.prewarm_solve
+    resolve_solve_path(cfg, cfg.rank)
 
     def step_impl(U, V, ub, ib):
         if cfg.implicit_prefs:

@@ -27,9 +27,6 @@ from tpu_als.ops.solve import (
 )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("implicit_prefs", "nonnegative", "nnls_sweeps")
-)
 def fold_in(
     V,
     cols,
@@ -46,7 +43,36 @@ def fold_in(
 
     cols/vals/mask: [n, w] padded CSR rows (same convention as
     tpu_als.core.ratings).  Returns new factors [n, rank].
+
+    Eager wrapper: probes the solve kernels before tracing (a probe inside
+    the jit trace cannot run and would pin the fallback path into the jit
+    cache — ops.solve.prewarm_solve), then dispatches to the jitted body.
     """
+    from tpu_als.ops.solve import prewarm_solve
+
+    if not nonnegative:
+        prewarm_solve(V.shape[-1])
+    return _fold_in_jit(V, cols, vals, mask, reg_param,
+                        implicit_prefs=implicit_prefs, alpha=alpha,
+                        nonnegative=nonnegative, nnls_sweeps=nnls_sweeps,
+                        YtY=YtY)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("implicit_prefs", "nonnegative", "nnls_sweeps")
+)
+def _fold_in_jit(
+    V,
+    cols,
+    vals,
+    mask,
+    reg_param,
+    implicit_prefs=False,
+    alpha=1.0,
+    nonnegative=False,
+    nnls_sweeps=32,
+    YtY=None,
+):
     Vg = V[cols]
     if implicit_prefs:
         if YtY is None:

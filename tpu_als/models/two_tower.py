@@ -34,6 +34,13 @@ class TwoTowerConfig:
     epochs: int = 5
     temperature: float = 0.1
     seed: int = 0
+    # logQ sampled-softmax correction: in-batch negatives are sampled with
+    # probability proportional to item popularity, which biases the softmax
+    # against popular items; subtracting log q(item) from each candidate
+    # logit (the standard dual-encoder correction) removes the bias.  On
+    # power-law data this is the difference between learning preferences
+    # and learning an inverted-popularity table.
+    popularity_correction: bool = True
 
 
 def init_params(key, num_users, num_items, cfg: TwoTowerConfig,
@@ -92,12 +99,21 @@ def item_repr(params, i_idx):
     return _tower(params["item_tower"], params["item_embed"][i_idx])
 
 
-def in_batch_softmax_loss(params, u_idx, i_idx, weights, temperature):
+def in_batch_softmax_loss(params, u_idx, i_idx, weights, temperature,
+                          log_q=None):
     """Sampled softmax with in-batch negatives: every other item in the
-    batch is a negative for each (user, item) positive."""
+    batch is a negative for each (user, item) positive.
+
+    ``log_q`` [num_items]: log of each item's sampling probability (its
+    empirical share of training interactions).  When given, candidate
+    logits are corrected by −log q(item) so popularity-proportional
+    in-batch sampling doesn't bias scores (standard logQ correction).
+    """
     zu = user_repr(params, u_idx)
     zi = item_repr(params, i_idx)
     logits = (zu @ zi.T) / temperature
+    if log_q is not None:
+        logits = logits - log_q[i_idx][None, :]
     labels = jnp.arange(zu.shape[0])
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
     return jnp.sum(losses * weights) / jnp.maximum(jnp.sum(weights), 1e-6)
@@ -121,10 +137,16 @@ def train_two_tower(u_idx, i_idx, num_users, num_items,
     tx = optax.adam(cfg.learning_rate)
     opt_state = tx.init(params)
 
+    log_q = None
+    if cfg.popularity_correction:
+        counts = np.bincount(i_idx, minlength=num_items).astype(np.float64)
+        q = (counts + 1.0) / (counts.sum() + num_items)  # add-1 smoothing
+        log_q = jnp.asarray(np.log(q), dtype=jnp.float32)
+
     @jax.jit
     def step(params, opt_state, ub, ib, wb):
         loss, grads = jax.value_and_grad(in_batch_softmax_loss)(
-            params, ub, ib, wb, cfg.temperature)
+            params, ub, ib, wb, cfg.temperature, log_q)
         updates, opt_state = tx.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 

@@ -135,7 +135,7 @@ def spd_solve_lanes(A, b, interpret=False):
         kernel,
         grid=(G,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((1, r_pad, LANES), lambda i: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],

@@ -89,6 +89,25 @@ def compute_yty(V):
     return jnp.einsum("nr,ns->rs", V, V, preferred_element_type=jnp.float32)
 
 
+def prewarm_solve(rank):
+    """Run the solve-kernel probes EAGERLY for this rank (cached per
+    process).  Anything that jit-traces a path reaching
+    ``solve_spd(backend='auto')`` must probe eagerly first: a probe cannot
+    execute inside a trace (tpu_als.utils.platform.probe_kernel degrades
+    that trace to the fallback path without caching), and the jit cache
+    would then pin the slow path for the compiled step's lifetime.
+    Callers: ``fold_in`` and ``scripts/ablate.py`` directly; the training
+    step builders (``make_step``, ``train_sharded``) get the same effect
+    through their eager ``resolve_solve_path`` call, which consults the
+    identical probe caches.
+    """
+    from tpu_als.ops import pallas_lanes, pallas_solve
+    from tpu_als.utils.platform import on_tpu
+
+    if on_tpu() and not pallas_lanes.available(rank):
+        pallas_solve.available(rank)
+
+
 def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
     """Batched SPD solve via Cholesky: x = A⁻¹ b for each row.
 

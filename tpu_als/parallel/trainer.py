@@ -203,6 +203,13 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
     resume, SURVEY.md §5.3); rows are scattered into slot space here.
     Resumes at ``start_iter``, running the remaining iterations.
     """
+    from tpu_als.core.als import resolve_solve_path
+
+    # probe the solve kernels EAGERLY before the shard_map jit below: a
+    # probe firing inside the trace cannot run, and the jit cache would
+    # pin the fallback path for the compiled step's lifetime
+    resolve_solve_path(cfg, cfg.rank)
+
     leading = NamedSharding(mesh, P(AXIS))
     ub = jax.device_put(user_sharded.device_buckets(), leading)
     ib = jax.device_put(item_sharded.device_buckets(), leading)

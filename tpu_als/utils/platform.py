@@ -30,6 +30,35 @@ def probe_kernel(cache, key, probe):
     regressions hide.
     """
     if key not in cache:
+        try:  # removed from the public jax.core in 0.9; degrade safely if
+            # a future jax relocates the private one too
+            from jax._src.core import trace_state_clean
+        except ImportError:
+            def trace_state_clean():
+                return True
+
+        if not trace_state_clean():
+            # a probe fired while TRACING (solve_spd's auto dispatch runs
+            # inside jit): the probe's own concrete arrays would become
+            # tracers of the ambient trace and its block_until_ready /
+            # comparison would raise — and round 2 showed caching that
+            # failure silently downgrades the whole process to the slow
+            # path.  Running the probe here is not possible (pallas has no
+            # eager-eval rule for ensure_compile_time_eval, and a helper
+            # thread deadlocks against the tracing thread on the tunneled
+            # backend), so: degrade THIS trace only, cache nothing, and
+            # tell the developer to prewarm (make_step/train_sharded call
+            # resolve_solve_path eagerly, fold_in and ablate.py call
+            # ops.solve.prewarm_solve — hitting this warning means a new
+            # call path skipped that).
+            import warnings
+
+            warnings.warn(
+                f"Pallas kernel probe {key} requested inside a jit trace; "
+                "using the fallback path for this trace WITHOUT caching. "
+                "Prewarm probes eagerly (tpu_als.core.als."
+                "resolve_solve_path) before tracing.", stacklevel=2)
+            return False
         if not on_tpu():
             cache[key] = False
         else:

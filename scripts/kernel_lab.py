@@ -1,0 +1,73 @@
+"""Kernel lab: A/B the lanes-Cholesky kernel variants on the local TPU.
+
+Sweeps the production ``spd_solve_lanes`` trailing-update panel widths for
+correctness (vs the XLA lowering) and speed at a headline-representative
+shape; the winner sets ``pallas_lanes.DEFAULT_PANEL``.
+
+Usage: python scripts/kernel_lab.py [--n 262144] [--rank 128] [--panel 8]
+"""
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_als.ops.pallas_lanes import LANES, spd_solve_lanes
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=32768)
+    ap.add_argument("--rank", type=int, default=128)
+    ap.add_argument("--panels", type=int, nargs="*", default=[4, 8, 16])
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+    n, r = args.n, args.rank
+
+    rng = np.random.default_rng(0)
+    # correctness batch (small), validated vs XLA
+    nc = LANES + 8
+    M = rng.normal(size=(nc, r, r)).astype(np.float32) / np.sqrt(r)
+    Ac = jnp.asarray(M @ np.swapaxes(M, 1, 2)
+                     + 0.5 * np.eye(r, dtype=np.float32)[None])
+    bc = jnp.asarray(rng.normal(size=(nc, r)).astype(np.float32))
+    from tpu_als.ops.solve import solve_spd
+    ref = np.asarray(solve_spd(Ac, bc, jnp.ones(nc), backend="xla"))
+
+    # timing batch: same SPD instance tiled (cheap to build, full-size solve)
+    reps = -(-n // nc)
+    A = jnp.asarray(np.tile(np.asarray(Ac), (reps, 1, 1))[:n])
+    b = jnp.asarray(np.tile(np.asarray(bc), (reps, 1))[:n])
+    A.block_until_ready()
+    print(f"data staged: {A.nbytes/1e9:.1f} GB on device", flush=True)
+
+    def bench(f, label):
+        x = f(A, b)
+        x.block_until_ready()
+        t0 = time.time()
+        for _ in range(args.reps):
+            x = f(A, b)
+        x.block_until_ready()
+        dt = (time.time() - t0) / args.reps
+        print(f"{label:20s} {dt*1e3:8.1f} ms  "
+              f"({n / dt / 1e6:.2f} M solves/s)", flush=True)
+        return x
+
+    for p in [1] + list(args.panels):
+        f = functools.partial(spd_solve_lanes, panel=p)
+        bench(f, f"lanes panel={p}")
+        err = np.abs(np.asarray(spd_solve_lanes(Ac, bc, panel=p)) - ref).max()
+        print(f"  panel={p} max err vs xla: {err:.2e}")
+
+
+if __name__ == "__main__":
+    main()

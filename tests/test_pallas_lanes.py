@@ -38,6 +38,28 @@ def test_matches_dense_solve(rng, N, r):
     assert np.abs(x - ref).max() / denom < 5e-3
 
 
+@pytest.mark.parametrize("panel", [1, 4, 8, 16])
+def test_panel_widths_agree(rng, panel):
+    # the panelized trailing update must reproduce the rank-1 recurrence
+    # (same math, different blocking) at the benchmark rank
+    N, r = LANES + 8, 128
+    A, b = _spd_problem(rng, N, r, scale=1.0 / np.sqrt(r))
+    x = np.asarray(spd_solve_lanes(A, b, panel=panel, interpret=True))
+    ref = solve_spd(A, b, jnp.ones(N), backend="xla")
+    np.testing.assert_allclose(x, np.asarray(ref), atol=1e-3, rtol=1e-2)
+
+
+def test_panel_rounds_to_divisor(rng):
+    # rank 24 pads to 24; DEFAULT_PANEL=8 divides it, but panel=16 must
+    # round down to a divisor instead of tracing a ragged loop
+    N, r = 12, 24
+    A, b = _spd_problem(rng, N, r)
+    x = np.asarray(spd_solve_lanes(A, b, panel=16, interpret=True))
+    ref = np.stack([np.linalg.solve(np.asarray(A)[k], np.asarray(b)[k])
+                    for k in range(N)])
+    assert np.abs(x - ref).max() / max(1.0, np.abs(ref).max()) < 5e-3
+
+
 def test_matches_solve_spd_contract(rng):
     # same prep as solve_spd: empty rows (count=0) -> identity A, zero b
     N, r = 24, 16
@@ -73,13 +95,13 @@ def test_solve_spd_lanes_backend_dispatch(rng, monkeypatch):
     count = jnp.ones((N,), jnp.float32)
     hits = []
 
-    def fake(Ax, bx, interpret=False):
-        hits.append(Ax.shape)
+    def fake(Ax, bx, panel=None, interpret=False):
+        hits.append((Ax.shape, panel))
         return jnp.linalg.solve(Ax, bx[..., None])[..., 0]
 
     monkeypatch.setattr(pallas_lanes, "spd_solve_lanes", fake)
     x = solve_spd(A, b, count, backend="lanes")
-    assert hits and hits[0] == (N, r, r)
+    assert hits and hits[0][0] == (N, r, r)
     ref = solve_spd(A, b, count, backend="xla")
     np.testing.assert_allclose(np.asarray(x), np.asarray(ref),
                                atol=1e-4, rtol=1e-4)
@@ -97,15 +119,17 @@ class TestAvailableProbe:
 
         monkeypatch.setattr(platform, "on_tpu", lambda: True)
         monkeypatch.setattr(pallas_lanes, "_AVAILABLE", {})
+        monkeypatch.setattr(pallas_lanes, "_PANEL", {})
         monkeypatch.setattr(pallas_lanes, "spd_solve_lanes", fake_kernel)
         return pallas_lanes.available(32)
 
     def test_rejects_wrong_but_finite_kernel(self, monkeypatch):
         assert self._probe(
-            monkeypatch, lambda A, b, interpret=False: b) is False
+            monkeypatch, lambda A, b, panel=None, interpret=False: b
+        ) is False
 
     def test_rejects_crashing_kernel(self, monkeypatch):
-        def boom(A, b, interpret=False):
+        def boom(A, b, panel=None, interpret=False):
             raise RuntimeError("mosaic compile failure")
 
         assert self._probe(monkeypatch, boom) is False
@@ -113,6 +137,6 @@ class TestAvailableProbe:
     def test_accepts_correct_kernel(self, monkeypatch):
         assert self._probe(
             monkeypatch,
-            lambda A, b, interpret=False: jnp.linalg.solve(
+            lambda A, b, panel=None, interpret=False: jnp.linalg.solve(
                 A, b[..., None])[..., 0],
         ) is True

@@ -119,8 +119,9 @@ def test_trainer_chunk_caps_rank_dominated_memory():
     c = trainer_chunk(131072, 8, 128, 1 << 19, mem_elems=1 << 28)
     assert c * 128 * 128 <= 1 << 28
     assert c >= 1 and 131072 % c == 0
-    # rank smaller than width: gathered factors dominate, chunk unchanged
-    assert trainer_chunk(1024, 512, 16, 1 << 19) == 1024
+    # rank smaller than width: memory never forces a halving below the
+    # builder chunk (the ~nb/16 scan cap, not the rank, decides)
+    assert trainer_chunk(1024, 512, 16, 1 << 19) == 64
 
 
 def test_native_bucketizer_bit_identical(rng):

@@ -102,7 +102,8 @@ def test_ring_accumulator_bound_at_target_scale():
             tile = trainer_chunk(nb, w, r, 1 << 19)
             assert tile * r * max(w, r) <= 1 << 28
             assert nb % tile == 0
-    # and the tile count grows with nb (i.e. the tile itself is bounded)
+    # and the tile count grows with nb (i.e. the tile itself is bounded):
+    # a 64x bigger bucket may not grow the tile past the chunk_elems cap
     t_small = trainer_chunk(1 << 14, 64, r, 1 << 19)
     t_big = trainer_chunk(1 << 20, 64, r, 1 << 19)
-    assert t_big == t_small  # bounded tile, more tiles — not a bigger tile
+    assert t_big <= max(t_small, (1 << 19) // 64)

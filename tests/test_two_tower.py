@@ -72,6 +72,49 @@ def test_popularity_correction_changes_loss_and_stays_finite(rng):
     assert np.isfinite(np.asarray(p["item_embed"])).all()
 
 
+def test_filtered_recall_excludes_train_items(rng):
+    # user 0's strongest item (0) is a *train* interaction; held-out item 1
+    # is second-best.  Unfiltered top-1 is occupied by the train item
+    # (recall 0); the filtered protocol removes it (recall 1).
+    import jax
+
+    from tpu_als.models.two_tower import init_params
+
+    nU, nI = 3, 5
+    Uf = np.zeros((nU, 4), np.float32)
+    Vf = np.zeros((nI, 4), np.float32)
+    Uf[0, 0] = 1.0
+    Vf[0, 0] = 10.0   # train item, top score for user 0
+    Vf[1, 0] = 5.0    # held-out item, second
+    Vf[2:, 1] = 1.0
+    cfg = TwoTowerConfig(embed_dim=4, hidden=(), out_dim=4, epochs=0)
+    params = init_params(jax.random.PRNGKey(0), nU, nI, cfg,
+                         als_user_factors=Uf, als_item_factors=Vf)
+    params["user_embed"] = jax.numpy.asarray(Uf)
+    params["item_embed"] = jax.numpy.asarray(Vf)
+    eval_u, eval_i = np.array([0]), np.array([1])
+    train_u, train_i = np.array([0]), np.array([0])
+    r_plain = recall_at_k(params, eval_u, eval_i, k=1)
+    r_filt = recall_at_k(params, eval_u, eval_i, k=1,
+                         exclude=(train_u, train_i), user_batch=2)
+    assert r_plain == 0.0 and r_filt == 1.0, (r_plain, r_filt)
+
+
+def test_filtered_recall_matches_plain_when_no_overlap(rng):
+    u, i, _, _ = _interactions(rng)
+    cfg = TwoTowerConfig(embed_dim=8, hidden=(16,), out_dim=8, epochs=2,
+                         batch_size=256, seed=3)
+    params = train_two_tower(u, i, 60, 40, cfg)
+    # exclusion lists for users outside the eval set change nothing
+    other_u = np.full(5, 59)
+    other_i = np.arange(5)
+    eval_u, eval_i = u[u != 59], i[u != 59]
+    r_plain = recall_at_k(params, eval_u, eval_i, k=5)
+    r_filt = recall_at_k(params, eval_u, eval_i, k=5,
+                         exclude=(other_u, other_i), user_batch=16)
+    assert r_plain == r_filt, (r_plain, r_filt)
+
+
 def test_from_fitted_als_model(rng):
     from tpu_als import ALS, ColumnarFrame
 

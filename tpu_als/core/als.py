@@ -66,6 +66,7 @@ def resolve_solve_path(cfg: AlsConfig, rank):
     'einsum+xla_cholesky'} plus the raw probe outcomes.
     """
     from tpu_als.ops import pallas_lanes, pallas_solve
+    from tpu_als.ops.solve import auto_solve_backend
     from tpu_als.utils.platform import on_tpu
 
     tpu = on_tpu()
@@ -83,13 +84,16 @@ def resolve_solve_path(cfg: AlsConfig, rank):
         # probe costs a Mosaic compile+execute on every resolve
         path = "fused_pallas"
     else:
+        # the same probe walk solve_spd's dispatch runs — prewarming here
+        # IS the prewarm contract; the re-reads below are cache hits
+        path = {
+            "lanes": "einsum+pallas_lanes",
+            "pallas": "einsum+pallas_cholesky",
+            "xla": "einsum+xla_cholesky",
+        }[auto_solve_backend(rank)]
         lanes_ok = bool(tpu and pallas_lanes.available(rank))
-        if lanes_ok:
-            path = "einsum+pallas_lanes"
-        else:
-            solve_ok = bool(tpu and pallas_solve.available(rank))
-            path = ("einsum+pallas_cholesky" if solve_ok
-                    else "einsum+xla_cholesky")
+        solve_ok = (None if lanes_ok
+                    else bool(tpu and pallas_solve.available(rank)))
     return {
         "solve_backend_requested": cfg.solve_backend,
         "fused_kernel_probe": fused_ok,
@@ -121,6 +125,11 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     """
     r = V_full.shape[-1]
     cdt = jnp.dtype(cfg.compute_dtype)
+    # cast ONCE before the gathers: the gather reads padded_nnz × r elements
+    # (>> N × r), so under bfloat16 casting first halves the dominant HBM
+    # stream; casting after the gather would move f32 bytes and only shrink
+    # the einsum inputs
+    V_comp = V_full.astype(cdt)
     out = jnp.zeros((num_rows, r), dtype=jnp.float32)
 
     if cfg.solve_backend not in ("auto", "fused", "unfused"):
@@ -140,7 +149,7 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
         def solve_chunk(args):
             c, v, m = args
             with jax.named_scope("gather_factors"):
-                Vg = V_full[c].astype(cdt)
+                Vg = V_comp[c]
             if fused:
                 from tpu_als.ops.pallas_fused import fused_normal_solve
 

@@ -145,11 +145,20 @@ def scan_chunk(nb, width, chunk_elems):
     (any smaller power of two still divides the padded row count) when the
     rank makes the per-row normal-equation tensor, not the gathered factors,
     the dominant intermediate.  Builders pad row counts up to a multiple.
+
+    The chunk is additionally capped at ~``nb``/16 (floored at 64 rows):
+    pad-to-chunk costs up to ``chunk - 1`` fully-computed phantom rows, so
+    a chunk near ``nb`` (the old single-chunk regime) could double a
+    bucket's work at small scale, while ≥16 scan steps keep the padding
+    under ~6-12% for the cost of amortized extra launches.  The trainer's
+    re-derivation (:func:`trainer_chunk`) provably lands on the same chunk
+    for the padded count — and its gcd fallback covers any drift.
     """
     cap = max(1, chunk_elems // width)
     cap = 1 << (cap.bit_length() - 1)  # floor to power of two
     full = 1 << max(0, nb - 1).bit_length()  # ceil to power of two
-    return max(1, min(cap, full))
+    tgt = max(64, 1 << max(0, -(-nb // 16) - 1).bit_length())
+    return max(1, min(cap, full, tgt))
 
 
 def trainer_chunk(nb_padded, width, rank, chunk_elems, mem_elems=1 << 28):

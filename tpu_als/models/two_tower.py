@@ -13,6 +13,7 @@ matrices, so ``chunked_topk_scores`` serves both model families.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -169,17 +170,76 @@ def train_two_tower(u_idx, i_idx, num_users, num_items,
     return params
 
 
-def recall_at_k(params, eval_u, eval_i, k=10, item_chunk=8192):
+@functools.partial(jax.jit, static_argnames=("k",))
+def _banned_topk(zu_b, zi, ban_rows, ban_cols, k):
+    """Top-k over all items with (row, col) score entries banned.  Padding
+    bans carry row == batch size (out of bounds -> scatter-dropped)."""
+    scores = jnp.einsum("nr,cr->nc", zu_b, zi,
+                        preferred_element_type=jnp.float32)
+    scores = scores.at[ban_rows, ban_cols].set(-3.4e38, mode="drop")
+    return jax.lax.top_k(scores, k)[1]
+
+
+def recall_at_k(params, eval_u, eval_i, k=10, item_chunk=8192,
+                exclude=None, user_batch=2048):
     """Fraction of held-out (user, item) pairs whose item appears in the
-    user's top-k retrieval — the config-5 metric."""
+    user's top-k retrieval — the config-5 metric.
+
+    ``exclude``: optional ``(train_u, train_i)`` interaction arrays.  When
+    given, each user's *training* items are removed from their candidate
+    set before the top-k (the standard filtered/leave-out protocol): a
+    trained model correctly ranks the items it was trained on first, so
+    unfiltered top-k slots are occupied by train positives and held-out
+    recall is pinned near the random floor regardless of model quality.
+    """
     eval_u = np.asarray(eval_u)
     eval_i = np.asarray(eval_i)
+    num_items = params["item_embed"].shape[0]
     users, inv = np.unique(eval_u, return_inverse=True)
-    zu = user_repr(params, jnp.asarray(users))
-    zi = item_repr(params,
-                   jnp.arange(params["item_embed"].shape[0]))
-    _, topk = chunked_topk_scores(
-        zu, zi, jnp.ones(zi.shape[0], bool), k=k, item_chunk=item_chunk)
-    topk = np.asarray(topk)
+    zi = item_repr(params, jnp.arange(num_items))
+
+    if exclude is None:
+        zu = user_repr(params, jnp.asarray(users))
+        _, topk = chunked_topk_scores(
+            zu, zi, jnp.ones(num_items, bool), k=k, item_chunk=item_chunk)
+        topk = np.asarray(topk)
+        hits = (topk[inv] == eval_i[:, None]).any(axis=1)
+        return float(hits.mean())
+
+    # host-side exclusion lists: train items per eval user.  `users` is
+    # sorted (np.unique), so membership + positions are vectorized.
+    tu = np.asarray(exclude[0])
+    ti = np.asarray(exclude[1])
+    keep = np.isin(tu, users)
+    tpos = np.searchsorted(users, tu[keep])
+    tit = np.asarray(ti[keep])
+
+    # bound the [user_batch, num_items] device score tensor to ~256 MB f32
+    user_batch = max(64, min(user_batch, (1 << 26) // max(num_items, 1)))
+
+    nb = len(users)
+    topk = np.zeros((nb, k), dtype=np.int32)
+    order = np.argsort(tpos, kind="stable")
+    tpos_s, tit_s = tpos[order], tit[order]
+    bounds = np.searchsorted(tpos_s, np.arange(0, nb + user_batch,
+                                               user_batch))
+    max_bans = int((bounds[1:] - bounds[:-1]).max()) if nb else 0
+    # one padded size for all batches: a single jit specialization, and
+    # the ban lists move to device as indices (two int32 vectors), not a
+    # dense [user_batch, num_items] host bool matrix
+    max_bans = max(1, 1 << (max_bans - 1).bit_length()) if max_bans else 1
+    for bi, s in enumerate(range(0, nb, user_batch)):
+        e = min(s + user_batch, nb)
+        ub = users[s:e]
+        if len(ub) < user_batch:  # static shapes for the jit cache
+            ub = np.pad(ub, (0, user_batch - len(ub)))
+        lo, hi = bounds[bi], bounds[bi + 1]
+        rows = np.full(max_bans, user_batch, np.int32)  # pad -> row OOB
+        cols = np.zeros(max_bans, np.int32)
+        rows[: hi - lo] = tpos_s[lo:hi] - s
+        cols[: hi - lo] = tit_s[lo:hi]
+        zu_b = user_repr(params, jnp.asarray(ub))
+        topk[s:e] = np.asarray(_banned_topk(
+            zu_b, zi, jnp.asarray(rows), jnp.asarray(cols), k))[: e - s]
     hits = (topk[inv] == eval_i[:, None]).any(axis=1)
     return float(hits.mean())

@@ -42,7 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 
 
-def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, sem, *, r):
+def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, Pn, sem, *, r, panel):
     """One lane-group: factorize 128 matrices and solve.
 
     A_ref [G, r, r, LANES] stays in HBM (``memory_space=ANY``) with
@@ -54,6 +54,14 @@ def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, sem, *, r):
     negligible against the factorization anyway.  b_ref / x_ref
     [1, r, LANES].  After the loop S[j] holds column j of L (entries above
     the diagonal zeroed).
+
+    ``panel`` > 1 runs the recurrence in panels of that many columns:
+    left-looking factorization of the panel against the scratch ``Pn``
+    [panel, r, LANES], then ONE fused rank-``panel`` trailing update pass
+    over S instead of ``panel`` rank-1 passes.  The update is what bounds
+    this kernel (it sweeps all of S per column), so its VMEM traffic —
+    and the kernel's runtime — drops by ~``panel``×.  panel=1 is the
+    original rank-1 recurrence.
     """
     g = pl.program_id(0)
     cp = pltpu.make_async_copy(A_ref.at[g], S, sem)
@@ -75,7 +83,37 @@ def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, sem, *, r):
         S[j] = ncol
         return 0
 
-    jax.lax.fori_loop(0, r, col, 0, unroll=False)
+    def panel_step(ip, _):
+        base = ip * panel
+        # left-looking factorization of the panel columns: corrections
+        # from columns inside the panel come from Pn (their trailing
+        # update hasn't been applied to S yet)
+        for jj in range(panel):
+            j = base + jj
+            cj = S[j]
+            for kk in range(jj):
+                Lk = Pn[kk]
+                lkj = jnp.sum(jnp.where(sub == j, Lk, 0.0), axis=0)
+                cj = cj - Lk * lkj[None, :]
+            d = jnp.sum(jnp.where(sub == j, cj, 0.0), axis=0)
+            inv = jax.lax.rsqrt(jnp.maximum(d, 1e-30))
+            Pn[jj] = jnp.where(sub >= j, cj * inv[None, :], 0.0)
+        # one fused rank-`panel` trailing update.  Columns a < base are
+        # untouched (factor columns are zero above their pivot row); the
+        # panel's own columns ARE hit...
+        upd = Pn[0][:, None, :] * Pn[0][None, :, :]
+        for kk in range(1, panel):
+            upd = upd + Pn[kk][:, None, :] * Pn[kk][None, :, :]
+        S[:] = S[:] - upd
+        # ...and restored, same trick as the rank-1 recurrence above
+        for jj in range(panel):
+            S[base + jj] = Pn[jj]
+        return 0
+
+    if panel > 1:
+        jax.lax.fori_loop(0, r // panel, panel_step, 0, unroll=False)
+    else:
+        jax.lax.fori_loop(0, r, col, 0, unroll=False)
 
     # forward substitution L y = b: y_j = (b_j - Σ_{k<j} L[j,k] y_k)/L[j,j]
     def fwd(j, res):
@@ -103,16 +141,28 @@ def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, sem, *, r):
     x_ref[0] = jax.lax.fori_loop(0, r, bwd, y, unroll=False)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def spd_solve_lanes(A, b, interpret=False):
+# default trailing-update panel width; chosen on v5e (scripts/kernel_lab.py
+# sweep at the headline shape) — see available() which validates the
+# configured width on the local Mosaic before the kernel engages
+DEFAULT_PANEL = 8
+
+
+@functools.partial(jax.jit, static_argnames=("panel", "interpret"))
+def spd_solve_lanes(A, b, panel=None, interpret=False):
     """Batched SPD solve x = A⁻¹ b.  A [N, r, r] f32, b [N, r] f32.
 
     Drop-in for ``spd_solve_pallas``; transposes to the lanes layout on
     device (one XLA transpose each way, fused into neighbours where
-    possible).
+    possible).  ``panel``: trailing-update panel width (must divide the
+    padded rank; None = DEFAULT_PANEL, capped to the padded rank).
     """
     N, r = b.shape
     r_pad = -(-r // 8) * 8
+    if panel is None:
+        panel = DEFAULT_PANEL
+    panel = min(panel, r_pad)
+    while r_pad % panel:
+        panel -= 1
     n_pad = -(-N // LANES) * LANES
     eye_tail = jnp.eye(r_pad, dtype=jnp.float32)[None, :, :]
     Ap = jnp.pad(A, ((0, n_pad - N), (0, r_pad - r), (0, r_pad - r)))
@@ -130,7 +180,7 @@ def spd_solve_lanes(A, b, interpret=False):
         Ap.reshape(G, LANES, r_pad, r_pad), (0, 3, 2, 1))
     bt = jnp.transpose(bp.reshape(G, LANES, r_pad), (0, 2, 1))
 
-    kernel = functools.partial(_chol_lanes_kernel, r=r_pad)
+    kernel = functools.partial(_chol_lanes_kernel, r=r_pad, panel=panel)
     xt = pl.pallas_call(
         kernel,
         grid=(G,),
@@ -143,6 +193,8 @@ def spd_solve_lanes(A, b, interpret=False):
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((G, r_pad, LANES), jnp.float32),
         scratch_shapes=[pltpu.VMEM((r_pad, r_pad, LANES), jnp.float32),
+                        pltpu.VMEM((max(panel, 1), r_pad, LANES),
+                                   jnp.float32),
                         pltpu.SemaphoreType.DMA],
         cost_estimate=pl.CostEstimate(
             flops=int(n_pad * (r_pad ** 3 + 4 * r_pad ** 2)),
@@ -156,6 +208,14 @@ def spd_solve_lanes(A, b, interpret=False):
 
 
 _AVAILABLE = {}  # r_pad -> bool, probed once per process
+_PANEL = {}      # r_pad -> panel width that validated on this Mosaic
+
+
+def selected_panel(rank):
+    """Panel width ``available()`` validated for this rank (DEFAULT_PANEL
+    until a probe has run)."""
+    r_pad = -(-rank // 8) * 8
+    return _PANEL.get(r_pad, DEFAULT_PANEL)
 
 
 def supported_rank(rank):
@@ -190,10 +250,25 @@ def available(rank=128):
             M @ np.swapaxes(M, 1, 2)
             + 0.5 * np.eye(r, dtype=np.float32)[None])
         b = jnp.asarray(rng.normal(size=(n, r)).astype(np.float32))
-        x = spd_solve_lanes(A + 1e-6 * jnp.eye(r), b)
-        x.block_until_ready()
         ref = solve_spd(A, b, jnp.ones((n,), jnp.float32), backend="xla")
-        return np.allclose(np.asarray(x), np.asarray(ref), atol=1e-3,
-                           rtol=1e-2)
+        # panelized first; rank-1 as the fallback if the panel kernel's
+        # fused update trips this Mosaic version
+        for p in (DEFAULT_PANEL, 1):
+            try:
+                x = spd_solve_lanes(A + 1e-6 * jnp.eye(r), b, panel=p)
+                x.block_until_ready()
+                ok = np.allclose(np.asarray(x), np.asarray(ref), atol=1e-3,
+                                 rtol=1e-2)
+            except Exception as e:
+                from tpu_als.utils.platform import _TRANSIENT_MARKERS
+
+                msg = f"{type(e).__name__}: {e}"
+                if any(m in msg for m in _TRANSIENT_MARKERS):
+                    raise  # let probe_kernel's transient retry handle it
+                ok = False
+            if ok:
+                _PANEL[r_pad] = p
+                return True
+        return False
 
     return probe_kernel(_AVAILABLE, r_pad, probe)

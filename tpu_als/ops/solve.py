@@ -89,6 +89,28 @@ def compute_yty(V):
     return jnp.einsum("nr,ns->rs", V, V, preferred_element_type=jnp.float32)
 
 
+def auto_solve_backend(rank):
+    """THE preference-ordered probe walk for the SPD solve — the single
+    source of truth shared by ``solve_spd``'s 'auto' branch,
+    ``prewarm_solve``, and ``resolve_solve_path`` (core/als.py), so the
+    prewarmed probes are exactly the ones the dispatch consults.
+
+    Returns 'lanes' | 'pallas' | 'xla'.  Each Pallas kernel engages only
+    after its compile-and-validate probe passes on the local Mosaic
+    (probes are cached per process).
+    """
+    from tpu_als.ops import pallas_lanes, pallas_solve
+    from tpu_als.utils.platform import on_tpu
+
+    if not on_tpu():
+        return "xla"
+    if pallas_lanes.available(rank):
+        return "lanes"
+    if pallas_solve.available(rank):
+        return "pallas"
+    return "xla"
+
+
 def prewarm_solve(rank):
     """Run the solve-kernel probes EAGERLY for this rank (cached per
     process).  Anything that jit-traces a path reaching
@@ -97,15 +119,12 @@ def prewarm_solve(rank):
     that trace to the fallback path without caching), and the jit cache
     would then pin the slow path for the compiled step's lifetime.
     Callers: ``fold_in`` and ``scripts/ablate.py`` directly; the training
-    step builders (``make_step``, ``train_sharded``) get the same effect
-    through their eager ``resolve_solve_path`` call, which consults the
-    identical probe caches.
+    step builders (``make_step`` and the tpu_als.parallel.trainer
+    builders) get the same effect through their eager
+    ``resolve_solve_path`` call — all of them walk the same
+    :func:`auto_solve_backend` probe order.
     """
-    from tpu_als.ops import pallas_lanes, pallas_solve
-    from tpu_als.utils.platform import on_tpu
-
-    if on_tpu() and not pallas_lanes.available(rank):
-        pallas_solve.available(rank)
+    auto_solve_backend(rank)
 
 
 def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
@@ -132,22 +151,14 @@ def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
     empty = (count <= 0)[:, None, None]
     A = jnp.where(empty, eye, A) + jitter * eye
     if backend == "auto":
-        from tpu_als.ops import pallas_lanes, pallas_solve
-        from tpu_als.utils.platform import on_tpu
-
-        if on_tpu() and pallas_lanes.available(r):
-            backend = "lanes"
-        elif on_tpu() and pallas_solve.available(r):
-            backend = "pallas"
-        else:
-            backend = "xla"
+        backend = auto_solve_backend(r)
     if backend not in ("lanes", "pallas", "xla"):
         raise ValueError(f"unknown solve backend {backend!r} "
                          "(expected 'auto', 'lanes', 'pallas' or 'xla')")
     if backend == "lanes":
-        from tpu_als.ops.pallas_lanes import spd_solve_lanes
+        from tpu_als.ops.pallas_lanes import selected_panel, spd_solve_lanes
 
-        return spd_solve_lanes(A, b)
+        return spd_solve_lanes(A, b, panel=selected_panel(r))
     if backend == "pallas":
         from tpu_als.ops.pallas_solve import spd_solve_pallas
 

@@ -36,6 +36,18 @@ def _squeeze0(tree):
     return jax.tree.map(lambda x: x[0], tree)
 
 
+def _prewarm(cfg: AlsConfig):
+    """Probe the solve kernels EAGERLY in every step *builder*: a probe
+    firing inside the shard_map jit trace cannot run, and the jit cache
+    would pin the XLA fallback path for the compiled step's lifetime
+    (tpu_als.utils.platform.probe_kernel).  Lives here — not only in
+    train_sharded — so callers driving the builders directly get the
+    same guarantee."""
+    from tpu_als.core.als import resolve_solve_path
+
+    resolve_solve_path(cfg, cfg.rank)
+
+
 def make_sharded_step(mesh, user_sharded, item_sharded, cfg: AlsConfig):
     """Jitted full ALS iteration over the mesh.
 
@@ -50,6 +62,7 @@ def make_sharded_step(mesh, user_sharded, item_sharded, cfg: AlsConfig):
             f"mesh has {mesh.devices.size} devices but the rating shards were "
             f"built for {n_shards}; a mismatch would silently drop shards"
         )
+    _prewarm(cfg)
     per_u = user_sharded.rows_per_shard
     per_i = item_sharded.rows_per_shard
     u_chunk = user_sharded.chunk_elems
@@ -103,6 +116,7 @@ def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig):
     per_i = item_ring.rows_per_shard
     u_chunk = user_ring.chunk_elems
     i_chunk = item_ring.chunk_elems
+    _prewarm(cfg)
 
     def step_body(U_loc, V_loc, ubuckets, ibuckets, ucounts, icounts):
         ubuckets = _squeeze0(ubuckets)
@@ -146,6 +160,7 @@ def make_a2a_step(mesh, user_a2a, item_a2a, cfg: AlsConfig):
     per_i = item_a2a.rows_per_shard
     u_chunk = user_a2a.chunk_elems
     i_chunk = item_a2a.chunk_elems
+    _prewarm(cfg)
 
     def step_body(U_loc, V_loc, ubuckets, ibuckets, u_send, i_send):
         ubuckets = _squeeze0(ubuckets)
@@ -203,13 +218,6 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
     resume, SURVEY.md §5.3); rows are scattered into slot space here.
     Resumes at ``start_iter``, running the remaining iterations.
     """
-    from tpu_als.core.als import resolve_solve_path
-
-    # probe the solve kernels EAGERLY before the shard_map jit below: a
-    # probe firing inside the trace cannot run, and the jit cache would
-    # pin the fallback path for the compiled step's lifetime
-    resolve_solve_path(cfg, cfg.rank)
-
     leading = NamedSharding(mesh, P(AXIS))
     ub = jax.device_put(user_sharded.device_buckets(), leading)
     ib = jax.device_put(item_sharded.device_buckets(), leading)

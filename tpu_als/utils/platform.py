@@ -72,6 +72,21 @@ def probe_kernel(cache, key, probe):
                     break
                 except Exception as e:
                     msg = f"{type(e).__name__}: {e}"
+                    # belt-and-braces for the trace_state_clean fallback
+                    # above: if a tracer leaked into the probe anyway
+                    # (jax relocated the private API and the fallback
+                    # reported "clean"), degrade THIS call without
+                    # caching — a tracer error says nothing about the
+                    # kernel's health on this Mosaic
+                    if ("Tracer" in type(e).__name__
+                            or "ConcretizationTypeError" in type(e).__name__):
+                        warnings.warn(
+                            f"Pallas kernel probe {key} saw a tracer "
+                            f"({msg[:120]}); treating as probe-inside-"
+                            "trace: fallback path WITHOUT caching. "
+                            "Prewarm probes eagerly before tracing.",
+                            stacklevel=2)
+                        return False
                     transient = any(m in msg for m in _TRANSIENT_MARKERS)
                     if transient and k + 1 < attempts:
                         warnings.warn(

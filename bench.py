@@ -332,6 +332,12 @@ def run_foldin(args):
     log("model fitted; running fold-in batches")
 
     srv = FoldInServer(model)
+    t0 = time.time()
+    # startup prewarm: compile the pow2 shape grid the batch size implies,
+    # so the latency quantiles measure serving, not jit compiles
+    srv.prewarm(rows=(256, 512, 1024), widths=(2, 4, 8, 16, 32, 64, 128))
+    prewarm_s = time.time() - t0
+    log(f"prewarm: {prewarm_s:.1f}s")
     rng = np.random.default_rng(1)
     base = int(model._user_map.ids.max()) + 1
     batches = 30
@@ -354,6 +360,7 @@ def run_foldin(args):
         "config": {
             "rank": args.rank, "items": nI, "batch_size": args.foldin_batch,
             "batches": batches, "p95_seconds": round(p95, 4),
+            "prewarm_seconds": round(prewarm_s, 1),
             "device": str(jax.devices()[0]),
         },
     }

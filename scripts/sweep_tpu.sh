@@ -1,0 +1,34 @@
+#!/bin/bash
+# One-shot TPU measurement sweep for round 2 (run when the tunnel is up).
+# Results land in sweep_logs/; each step is independently timeout-bounded
+# so one hang cannot eat the sweep.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p sweep_logs
+
+run() {  # run <name> <timeout> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name ($(date +%H:%M:%S)) ==="
+  timeout "$to" "$@" >"sweep_logs/$name.out" 2>"sweep_logs/$name.err"
+  echo "rc=$? $(tail -c 300 "sweep_logs/$name.out" | tr '\n' ' ')"
+}
+
+# 1. solve-kernel panel sweep (sets DEFAULT_PANEL if a non-8 wins)
+run kernel_lab 580 python scripts/kernel_lab.py --panels 4 8 16
+
+# 2. headline A/Bs: f32 vs bf16 gather/einsum, width ladder 2.0 vs 1.5
+run headline_f32     580 python bench.py --iters 5
+run headline_bf16    580 python bench.py --iters 5 --compute-dtype bfloat16
+run headline_wg15    580 python bench.py --iters 5 --width-growth 1.5
+run headline_bf16_wg15 580 python bench.py --iters 5 --compute-dtype bfloat16 --width-growth 1.5
+
+# 3. quality: held-out RMSE with whatever headline config won (f32 default
+#    here; rerun with the winner's flags before updating BASELINE.md)
+run rmse 580 python bench.py --mode rmse --iters-rmse 12
+
+# 4. fold-in p50 + two-tower filtered recall (5 + 20 epochs)
+run foldin 580 python bench.py --mode foldin
+run twotower_5ep 580 python bench.py --mode twotower --tt-epochs 5
+run twotower_20ep 900 python bench.py --mode twotower
+
+echo "=== sweep done ($(date +%H:%M:%S)) ==="

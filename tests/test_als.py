@@ -101,3 +101,40 @@ def test_predict_cold_start_nan(rng):
     assert np.isfinite(p[0])
     assert np.isnan(p[1])  # item idx out of range -> NaN, even if mask says ok
     assert np.isnan(p[2])  # negative id -> NaN
+
+
+def test_bfloat16_compute_converges(rng):
+    # compute_dtype='bfloat16' moves the gather + normal-equation einsums
+    # to bf16 (f32 accumulate); the solves stay f32, so held-out quality
+    # must stay within a small factor of the f32 run
+    u, i, r, _, _ = make_ratings(rng, 80, 60, rank=3, density=0.4,
+                                 noise=0.01)
+    (tu, ti, tr), (eu, ei, er) = split(rng, u, i, r)
+    cfg32 = AlsConfig(rank=3, max_iter=12, reg_param=0.01, seed=1)
+    cfg16 = AlsConfig(rank=3, max_iter=12, reg_param=0.01, seed=1,
+                      compute_dtype="bfloat16")
+    U32, V32 = fit(tu, ti, tr, 80, 60, cfg32)
+    U16, V16 = fit(tu, ti, tr, 80, 60, cfg16)
+    e32 = rmse(U32, V32, eu, ei, er, 80, 60)
+    e16 = rmse(U16, V16, eu, ei, er, 80, 60)
+    assert e16 < 1.5 * e32 + 0.02, (e16, e32)
+
+
+def test_resolve_path_agrees_with_dispatch(rng):
+    # resolve_solve_path's attribution (what benchmarks record) must name
+    # the same backend solve_spd's 'auto' dispatch will take
+    from tpu_als.core.als import resolve_solve_path
+    from tpu_als.ops.solve import auto_solve_backend
+
+    cfg = AlsConfig(rank=16, solve_backend="auto")
+    info = resolve_solve_path(cfg, 16)
+    expect = {
+        "lanes": "einsum+pallas_lanes",
+        "pallas": "einsum+pallas_cholesky",
+        "xla": "einsum+xla_cholesky",
+    }[auto_solve_backend(16)]
+    assert info["resolved_solve_path"] == expect
+    # nonnegative always resolves to the NNLS path regardless of probes
+    assert resolve_solve_path(
+        AlsConfig(rank=16, nonnegative=True), 16
+    )["resolved_solve_path"] == "einsum+nnls"

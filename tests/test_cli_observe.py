@@ -65,3 +65,31 @@ def test_iteration_logger(tmp_path, rng):
     assert len(recs) == 3
     assert recs[-1]["probe_rmse"] < recs[0]["probe_rmse"]
     assert all("seconds" in x for x in recs)
+
+
+def test_cli_tune(tmp_path, capsys):
+    import json
+
+    out_dir = str(tmp_path / "best")
+    cli_main(["tune", "--data", "synthetic:150x60x3000",
+              "--ranks", "2,4", "--reg-params", "0.01",
+              "--max-iter", "3", "--folds", "2", "--output", out_dir])
+    line = capsys.readouterr().out.strip().splitlines()[0]
+    res = json.loads(line)
+    assert res["best_rank"] in (2, 4)
+    assert res["grid_size"] == 2
+    assert len(res["avg_metrics"]) == 2
+
+    from tpu_als.api.tuning import CrossValidatorModel
+
+    loaded = CrossValidatorModel.load(out_dir)
+    assert int(loaded.bestModel._params["rank"]) == res["best_rank"]
+
+
+def test_cli_train_profile_dir(tmp_path, capsys):
+    prof = str(tmp_path / "prof")
+    cli_main(["train", "--data", "synthetic:100x40x1500", "--rank", "3",
+              "--max-iter", "2", "--profile-dir", prof])
+    import os
+
+    assert os.path.isdir(prof) and os.listdir(prof)  # trace files exist

@@ -61,6 +61,27 @@ def test_foldin_server_existing_user_history_merge(rng):
     assert np.isfinite(srv.p50_latency())
 
 
+def test_foldin_server_prewarm_matches_serving_shapes(rng):
+    # prewarm compiles the same jit entries update() later hits: after
+    # prewarming the grid, a batch whose padded shape is in the grid adds
+    # no new cache entry (its latency is serve-only)
+    model, frame = _fitted(rng)
+    srv = FoldInServer(model)
+    srv.prewarm(rows=(4,), widths=(8,))
+    from tpu_als.core import foldin as foldin_mod
+
+    sizes0 = foldin_mod._fold_in_jit._cache_size()
+    batch = ColumnarFrame({
+        "user": np.array([1, 1, 1, 1, 1, 2, 3]),
+        "item": model._item_map.to_original(
+            np.array([0, 1, 2, 3, 4, 5, 6])),
+        "rating": np.full(7, 4.0, np.float32),
+    })
+    srv.update(batch)  # 3 touched users -> rows pad to 4; max count 5 ->
+    # width pads to 8: exactly the prewarmed (4, 8) entry
+    assert foldin_mod._fold_in_jit._cache_size() == sizes0
+
+
 def test_foldin_server_unknown_items_ignored(rng):
     model, _ = _fitted(rng)
     srv = FoldInServer(model)

@@ -76,7 +76,15 @@ def cmd_train(args):
               mesh=mesh, gatherStrategy=args.gather_strategy)
     print(f"training on {len(train):,} ratings "
           f"({len(test):,} held out)", file=sys.stderr)
-    model = als.fit(train)
+    if args.profile_dir:
+        from tpu_als.utils.observe import trace
+
+        with trace(args.profile_dir):
+            model = als.fit(train)
+        print(f"profiler trace written to {args.profile_dir}",
+              file=sys.stderr)
+    else:
+        model = als.fit(train)
     if len(test):
         rmse = RegressionEvaluator(labelCol="rating").evaluate(
             model.transform(test))
@@ -121,6 +129,40 @@ def cmd_recommend(args):
             "items": [[int(i), round(float(s), 4)]
                       for i, s in recs["recommendations"][row]],
         }))
+
+
+def cmd_tune(args):
+    """Grid search over rank/regParam with CrossValidator — the reference
+    app layer's tuning step (SURVEY.md §2.A6) as a CLI command."""
+    from tpu_als import ALS, RegressionEvaluator
+    from tpu_als.api.tuning import CrossValidator, ParamGridBuilder
+
+    frame = _load_data(args.data)
+    als = ALS(maxIter=args.max_iter, implicitPrefs=args.implicit,
+              alpha=args.alpha, seed=args.seed, coldStartStrategy="drop")
+    grid = (ParamGridBuilder()
+            .addGrid(als.rank, [int(x) for x in args.ranks.split(",")])
+            .addGrid(als.regParam,
+                     [float(x) for x in args.reg_params.split(",")])
+            .build())
+    cv = CrossValidator(
+        estimator=als,
+        estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(labelCol="rating"),
+        numFolds=args.folds,
+        seed=args.seed,
+    )
+    cv_model = cv.fit(frame)
+    best = cv_model.bestModel
+    print(json.dumps({
+        "best_rank": int(best._params["rank"]),
+        "best_regParam": float(best._params["regParam"]),
+        "avg_metrics": [round(float(m), 4) for m in cv_model.avgMetrics],
+        "grid_size": len(grid),
+    }))
+    if args.output:
+        cv_model.write().overwrite().save(args.output)
+        print(f"best model saved to {args.output}", file=sys.stderr)
 
 
 def cmd_foldin_bench(args):
@@ -174,6 +216,9 @@ def main(argv=None):
     t.add_argument("--output", default=None)
     t.add_argument("--log-file", default=None,
                    help="write per-iteration JSON log lines here")
+    t.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the fit "
+                        "(TensorBoard/Perfetto-readable)")
     t.add_argument("--devices", type=int, default=1,
                    help="train sharded over N devices (0 = all visible; "
                         "1 = single device, the default)")
@@ -195,6 +240,21 @@ def main(argv=None):
     r.add_argument("--limit", type=int, default=20,
                    help="max users to print (0 = all)")
     r.set_defaults(fn=cmd_recommend)
+
+    g = sub.add_parser("tune", help="cross-validated grid search")
+    g.add_argument("--data", required=True)
+    g.add_argument("--ranks", default="8,16,32",
+                   help="comma-separated rank grid")
+    g.add_argument("--reg-params", default="0.01,0.05,0.1",
+                   help="comma-separated regParam grid")
+    g.add_argument("--max-iter", type=int, default=10)
+    g.add_argument("--folds", type=int, default=3)
+    g.add_argument("--implicit", action="store_true")
+    g.add_argument("--alpha", type=float, default=1.0)
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--output", default=None,
+                   help="save the best model here")
+    g.set_defaults(fn=cmd_tune)
 
     f = sub.add_parser("foldin-bench", help="fold-in latency micro-benchmark")
     f.add_argument("--model", required=True)

@@ -215,7 +215,9 @@ def recall_at_k(params, eval_u, eval_i, k=10, item_chunk=8192,
     tit = np.asarray(ti[keep])
 
     # bound the [user_batch, num_items] device score tensor to ~256 MB f32
-    user_batch = max(64, min(user_batch, (1 << 26) // max(num_items, 1)))
+    # (an explicitly small user_batch is honored — tests use it to cover
+    # the multi-batch ban partitioning)
+    user_batch = min(user_batch, max(64, (1 << 26) // max(num_items, 1)))
 
     nb = len(users)
     topk = np.zeros((nb, k), dtype=np.int32)

@@ -265,6 +265,10 @@ def available(rank=128):
                 msg = f"{type(e).__name__}: {e}"
                 if any(m in msg for m in _TRANSIENT_MARKERS):
                     raise  # let probe_kernel's transient retry handle it
+                if ("Tracer" in type(e).__name__
+                        or "ConcretizationTypeError" in type(e).__name__):
+                    raise  # probe-inside-trace: probe_kernel degrades
+                    # WITHOUT caching instead of pinning False
                 ok = False
             if ok:
                 _PANEL[r_pad] = p

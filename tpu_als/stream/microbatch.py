@@ -44,6 +44,28 @@ class FoldInServer:
         self._YtY = compute_yty(self._V) if self._implicit else None
         self.stats = []  # (batch_size, touched_users, latency_seconds)
 
+    def prewarm(self, rows=(256, 512, 1024), widths=(2, 4, 8, 16, 32)):
+        """Pre-compile the fold-in kernel for a (rows, width) shape grid.
+
+        ``update`` pads batches to power-of-two shapes, so the jit cache
+        is bounded — but each NEW shape still pays its compile at serving
+        time, which is what dominates a latency benchmark's p95 early in
+        a run (observed: p95 11x p50 on the first 30 batches).  Serving
+        deployments call this once at startup with the shapes their
+        batch size implies; entries are cached per process.
+        """
+        for n in rows:
+            for w in widths:
+                fold_in(
+                    self._V,
+                    jnp.zeros((n, w), jnp.int32),
+                    jnp.zeros((n, w), jnp.float32),
+                    jnp.zeros((n, w), jnp.float32),
+                    self._reg, implicit_prefs=self._implicit,
+                    alpha=self._alpha, nonnegative=self._nonnegative,
+                    YtY=self._YtY,
+                ).block_until_ready()
+
     def update(self, batch):
         """Process one micro-batch frame (userCol/itemCol/ratingCol of the
         model).  Returns the original ids of the users whose factors moved.

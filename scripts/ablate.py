@@ -36,15 +36,18 @@ def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
         vals = b.vals.reshape(nch, chunk, w)
         mask = b.mask.reshape(nch, chunk, w)
 
+        cdt = jnp.dtype(cfgd["compute_dtype"])
+        V_comp = V_full.astype(cdt)
+
         def f(args):
             c, v, m = args
             if ab == "no-gather":
                 # same gather op, all indices 0: measures the random-access
                 # penalty (cache-resident source row) without changing the
                 # program shape
-                Vg = V_full[c * 0]
+                Vg = V_comp[c * 0]
             else:
-                Vg = V_full[c]
+                Vg = V_comp[c]
             if cfgd["solve_backend"] == "fused" and ab not in (
                     "no-neq", "no-solve"):
                 from tpu_als.ops.pallas_fused import fused_normal_solve
@@ -60,9 +63,13 @@ def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
                 cnt = jnp.sum(m, axis=-1)
             elif cfgd["implicit"]:
                 A, rhs, cnt = normal_eq_implicit(
-                    Vg, v, m, cfgd["reg"], cfgd["alpha"], YtY)
+                    Vg, v.astype(cdt), m.astype(cdt), cfgd["reg"],
+                    cfgd["alpha"], YtY)
             else:
-                A, rhs, cnt = normal_eq_explicit(Vg, v, m, cfgd["reg"])
+                A, rhs, cnt = normal_eq_explicit(
+                    Vg, v.astype(cdt), m.astype(cdt), cfgd["reg"])
+            A = A.astype(jnp.float32)
+            rhs = rhs.astype(jnp.float32)
             if ab == "no-solve":
                 return rhs
             # under --solve-backend fused the no-neq/no-solve variants fall
@@ -99,7 +106,15 @@ def main():
                          "timeout so one pathological compile cannot hang "
                          "the whole sweep")
     ap.add_argument("--variant-timeout", type=int, default=420)
+    ap.add_argument("--compute-dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="dtype for the gather/normal-equation stage")
+    ap.add_argument("--platform", default="default",
+                    choices=["default", "cpu"],
+                    help="cpu = force the CPU backend (smoke tests)")
     args = ap.parse_args()
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
     if args.subproc:
         import subprocess
@@ -110,6 +125,8 @@ def main():
                    "--scale", str(args.scale), "--rank", str(args.rank),
                    "--iters", str(args.iters),
                    "--solve-backend", args.solve_backend,
+                   "--compute-dtype", args.compute_dtype,
+                   "--platform", args.platform,
                    "--variants", v]
             if args.explicit:
                 cmd.append("--explicit")
@@ -130,7 +147,8 @@ def main():
     ub = jax.device_put(ucsr.device_buckets())
     ib = jax.device_put(icsr.device_buckets())
     cfgd = {"implicit": not args.explicit, "reg": 0.01, "alpha": 40.0,
-            "solve_backend": args.solve_backend}
+            "solve_backend": args.solve_backend,
+            "compute_dtype": args.compute_dtype}
     rank = args.rank
 
     def step_impl(U, V, ub, ib, ab):

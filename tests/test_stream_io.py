@@ -168,3 +168,12 @@ def test_fastcsv_no_trailing_newline(tmp_path):
     assert pu.tolist() == [1, 7]
     assert pr.tolist() == [3.5, 1.0]
     assert pt.tolist() == [100, 200]
+
+
+def test_synthetic_return_factors():
+    frame, Us, Vs = synthetic_movielens(50, 30, 500, seed=3,
+                                        return_factors=True)
+    assert Us.shape == (50, 16) and Vs.shape == (30, 16)
+    # same seed without factors -> identical frame
+    frame2 = synthetic_movielens(50, 30, 500, seed=3)
+    assert np.array_equal(frame["rating"], frame2["rating"])

@@ -55,12 +55,18 @@ def load_movielens_csv(path):
 
 
 def synthetic_movielens(num_users, num_items, num_ratings, seed=0,
-                        rank=16, noise=0.3, user_power=0.9, item_power=1.1):
+                        rank=16, noise=0.3, user_power=0.9, item_power=1.1,
+                        return_factors=False):
     """MovieLens-shaped synthetic ratings.
 
     Degrees follow truncated zipf-like power laws (users shallower than
     items, as in the real datasets); ratings are a planted rank-``rank``
     structure mapped to the 0.5..5.0 half-star grid.  Deterministic per seed.
+
+    ``return_factors=True`` additionally returns the planted ``(Ustar,
+    Vstar)`` — benchmarks use them to compute oracle ceilings (the best any
+    model could score under a protocol), which is what makes absolute
+    retrieval numbers on the synthetic interpretable.
     """
     rng = np.random.default_rng(seed)
 
@@ -80,10 +86,13 @@ def synthetic_movielens(num_users, num_items, num_ratings, seed=0,
     raw = raw + noise * rng.normal(size=num_ratings).astype(np.float32)
     # squash to the 0.5..5.0 half-star grid with a MovieLens-like mean
     stars = np.clip(np.round((3.5 + 1.1 * raw) * 2) / 2, 0.5, 5.0)
-    return ColumnarFrame({
+    frame = ColumnarFrame({
         "user": u.astype(np.int64),
         "item": i.astype(np.int64),
         "rating": stars.astype(np.float32),
         "timestamp": rng.integers(1_000_000_000, 1_600_000_000,
                                   num_ratings),
     })
+    if return_factors:
+        return frame, Ustar, Vstar
+    return frame

@@ -170,6 +170,29 @@ def train_two_tower(u_idx, i_idx, num_users, num_items,
     return params
 
 
+def ban_lists(users, train_u, train_i, user_batch):
+    """Partition each eval user's train items into user batches — the
+    filtered protocol's exclusion machinery, shared by :func:`recall_at_k`
+    and the benchmark's oracle ceiling (bench.py) so the two metrics can
+    never drift onto different protocols.
+
+    ``users`` must be sorted (np.unique output).  Returns ``(tpos, tit,
+    bounds)``: train positions into ``users`` (stable-sorted), their item
+    ids, and ``bounds[bi]:bounds[bi+1]`` slicing batch ``bi``'s bans
+    (rows re-base as ``tpos - bi*user_batch``).
+    """
+    tu = np.asarray(train_u)
+    ti = np.asarray(train_i)
+    keep = np.isin(tu, users)
+    tpos = np.searchsorted(users, tu[keep])
+    tit = np.asarray(ti[keep])
+    order = np.argsort(tpos, kind="stable")
+    tpos, tit = tpos[order], tit[order]
+    bounds = np.searchsorted(
+        tpos, np.arange(0, len(users) + user_batch, user_batch))
+    return tpos, tit, bounds
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def _banned_topk(zu_b, zi, ban_rows, ban_cols, k):
     """Top-k over all items with (row, col) score entries banned.  Padding
@@ -206,14 +229,6 @@ def recall_at_k(params, eval_u, eval_i, k=10, item_chunk=8192,
         hits = (topk[inv] == eval_i[:, None]).any(axis=1)
         return float(hits.mean())
 
-    # host-side exclusion lists: train items per eval user.  `users` is
-    # sorted (np.unique), so membership + positions are vectorized.
-    tu = np.asarray(exclude[0])
-    ti = np.asarray(exclude[1])
-    keep = np.isin(tu, users)
-    tpos = np.searchsorted(users, tu[keep])
-    tit = np.asarray(ti[keep])
-
     # bound the [user_batch, num_items] device score tensor to ~256 MB f32
     # (an explicitly small user_batch is honored — tests use it to cover
     # the multi-batch ban partitioning)
@@ -221,10 +236,8 @@ def recall_at_k(params, eval_u, eval_i, k=10, item_chunk=8192,
 
     nb = len(users)
     topk = np.zeros((nb, k), dtype=np.int32)
-    order = np.argsort(tpos, kind="stable")
-    tpos_s, tit_s = tpos[order], tit[order]
-    bounds = np.searchsorted(tpos_s, np.arange(0, nb + user_batch,
-                                               user_batch))
+    tpos_s, tit_s, bounds = ban_lists(users, exclude[0], exclude[1],
+                                      user_batch)
     max_bans = int((bounds[1:] - bounds[:-1]).max()) if nb else 0
     # one padded size for all batches: a single jit specialization, and
     # the ban lists move to device as indices (two int32 vectors), not a

@@ -52,8 +52,12 @@ def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
                     "no-neq", "no-solve"):
                 from tpu_als.ops.pallas_fused import fused_normal_solve
 
+                # the fused kernel is an f32 path (ablation-only):
+                # measure it at f32 regardless of --compute-dtype so its
+                # delta vs the unfused variants isn't a dtype swap
                 return fused_normal_solve(
-                    Vg, v, m, YtY if cfgd["implicit"] else None,
+                    Vg.astype(jnp.float32), v, m,
+                    YtY if cfgd["implicit"] else None,
                     reg=cfgd["reg"], implicit=cfgd["implicit"],
                     alpha=cfgd["alpha"])
             if ab == "no-neq":

@@ -153,9 +153,11 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
             if fused:
                 from tpu_als.ops.pallas_fused import fused_normal_solve
 
+                # the fused kernel is an f32 path (never auto-selected);
+                # a bfloat16 compute_dtype must not leak into it
                 with jax.named_scope("fused_normal_solve"):
                     return fused_normal_solve(
-                        Vg, v, m,
+                        Vg.astype(jnp.float32), v, m,
                         YtY.astype(jnp.float32) if cfg.implicit_prefs
                         else None,
                         reg=cfg.reg_param,

@@ -22,7 +22,12 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from tpu_als.core.ratings import Bucket, build_csr_buckets, scan_chunk
+from tpu_als.core.ratings import (
+    Bucket,
+    build_csr_buckets,
+    entity_widths,
+    scan_chunk,
+)
 
 
 @dataclass
@@ -80,57 +85,136 @@ class ShardedCsr:
     rows_per_shard: int
     chunk_elems: int
     nnz: int
+    # None = full build (leading axis spans every mesh position); a tuple
+    # = process-local build holding exactly these positions, in order
+    # (data for jax.make_array_from_process_local_data assembly)
+    positions: tuple = None
 
     def device_buckets(self):
         return list(self.buckets)
 
 
+def shard_layout(row_part, row_counts, min_width=8, chunk_elems=1 << 19,
+                 width_growth=2.0):
+    """The globally-agreed stacked-bucket layout: ``[(width, padded_nb)]``.
+
+    Computable on EVERY host from the per-entity rating counts alone
+    (O(num_entities), no rating data) — the agreement step of multi-host
+    blocking: each process builds only its own shards
+    (:func:`shard_csr` ``positions=``) into identical global shapes, so
+    ``jax.make_array_from_process_local_data`` can assemble one global
+    array per bucket leaf.  Multi-host deployments obtain global counts
+    with one count exchange (each host bincounts its local ratings; sum) —
+    O(num_entities) traffic, vs the O(nnz) rating set that never leaves
+    its host.  Mirrors the arithmetic of the full build exactly (per-shard
+    chunk padding, then cross-shard max, then re-pad to the common chunk).
+    """
+    counts = np.asarray(row_counts)
+    D = row_part.n_shards
+    rated = counts > 0
+    w_all = entity_widths(counts, min_width, width_growth)
+    layout = []
+    for w in sorted(set(w_all[rated].tolist())):
+        sel = rated & (w_all == w)
+        nb_d = np.bincount(row_part.owner[sel], minlength=D)
+        padded = [
+            -(-int(nb) // scan_chunk(int(nb), w, chunk_elems))
+            * scan_chunk(int(nb), w, chunk_elems)
+            for nb in nb_d if nb
+        ]
+        nb_max = max(padded)
+        chunk = scan_chunk(nb_max, w, chunk_elems)
+        layout.append((w, -(-nb_max // chunk) * chunk))
+    return layout
+
+
 def shard_csr(row_part, col_part, row_idx, col_idx, vals,
-              min_width=8, chunk_elems=1 << 19):
+              min_width=8, chunk_elems=1 << 19, positions=None,
+              row_counts=None):
     """Build per-device CSR buckets in slot space and stack them.
 
     row_part/col_part: Partition for the solved side / the gathered side.
+
+    ``positions``: build ONLY these mesh positions' shards (multi-host —
+    the caller feeds just its local ratings, ``multihost.local_rating_mask``)
+    laid out in the global shapes from :func:`shard_layout`; requires
+    ``row_counts`` = GLOBAL per-entity counts of the solved side.  The
+    resulting leading axis is ``len(positions)`` in the given order, and
+    slicing a full build at ``positions`` yields bit-identical arrays.
     """
     D = row_part.n_shards
+    row_idx = np.asarray(row_idx)
     owner = row_part.owner[row_idx]
     local_rows = row_part.local[row_idx]
-    slot_cols = col_part.slot[col_idx]
+    slot_cols = col_part.slot[np.asarray(col_idx)]
+
+    local = positions is not None
+    if positions is None:
+        positions = range(D)
+    elif row_counts is None:
+        # local ratings cannot derive the GLOBAL layout: silently using
+        # them would give this host different bucket shapes than its peers
+        raise ValueError(
+            "positions= requires row_counts (global per-entity counts of "
+            "the solved side; multi-host deployments sum per-host "
+            "bincounts — see shard_layout)")
+    if row_counts is None:
+        if len(row_idx):
+            row_counts = np.bincount(row_idx, minlength=len(row_part.owner))
+        else:
+            row_counts = np.zeros(len(row_part.owner), np.int64)
+    layout = shard_layout(row_part, row_counts, min_width, chunk_elems)
 
     shards = []
-    for d in range(D):
+    for d in positions:
         sel = owner == d
-        shards.append(
-            build_csr_buckets(
-                local_rows[sel], slot_cols[sel], np.asarray(vals)[sel],
-                num_rows=row_part.rows_per_shard,
-                min_width=min_width, chunk_elems=chunk_elems,
-            )
-        )
-    return stack_shards(shards, chunk_elems)
+        shards.append(build_csr_buckets(
+            local_rows[sel], slot_cols[sel], np.asarray(vals)[sel],
+            num_rows=row_part.rows_per_shard,
+            min_width=min_width, chunk_elems=chunk_elems,
+        ))
+    return stack_shards(shards, chunk_elems, layout=layout,
+                        positions=(tuple(positions) if local else None))
 
 
-def stack_shards(shards, chunk_elems):
-    """Unify bucket shapes across shards and stack on a leading axis."""
-    D = len(shards)
+def stack_shards(shards, chunk_elems, layout=None, positions=None):
+    """Unify bucket shapes across shards and stack on a leading axis.
+
+    ``layout``: optional precomputed ``[(width, padded_nb)]`` (the
+    multi-host agreement from :func:`shard_layout`); default = derive it
+    from the shards themselves (single-host path — same arithmetic).
+    Every built width must appear in the layout: a mismatch means the
+    ``row_counts`` the layout came from disagree with the actual triples,
+    and dropping the bucket would silently lose ratings.
+    """
     num_rows = shards[0].num_rows
-    widths = sorted({b.width for s in shards for b in s.buckets})
+    built_widths = sorted({b.width for s in shards for b in s.buckets})
+    if layout is None:
+        layout = []
+        for w in built_widths:
+            nb_max = max(b.rows.shape[0] for s in shards for b in s.buckets
+                         if b.width == w)
+            # keep row padding aligned to the scan chunk all shards use
+            chunk = scan_chunk(nb_max, w, chunk_elems)
+            layout.append((w, -(-nb_max // chunk) * chunk))
+    missing = set(built_widths) - {w for w, _ in layout}
+    if missing:
+        raise ValueError(
+            f"built buckets of widths {sorted(missing)} have no layout "
+            "entry — row_counts disagree with the rating triples "
+            "(stale counts?); refusing to silently drop ratings")
+    D = len(shards)
     stacked = []
-    for w in widths:
-        per = []
-        for s in shards:
-            match = [b for b in s.buckets if b.width == w]
-            per.append(match[0] if match else None)
-        nb_max = max(b.rows.shape[0] for b in per if b is not None)
-        # keep row padding aligned to the scan chunk all shards will use
-        chunk = scan_chunk(nb_max, w, chunk_elems)
-        nb_max = -(-nb_max // chunk) * chunk
+    for w, nb_max in layout:
         rows = np.full((D, nb_max), num_rows, dtype=np.int32)
         cols = np.zeros((D, nb_max, w), dtype=np.int32)
         vals = np.zeros((D, nb_max, w), dtype=np.float32)
         mask = np.zeros((D, nb_max, w), dtype=np.float32)
-        for d, b in enumerate(per):
-            if b is None:
+        for d, s in enumerate(shards):
+            match = [b for b in s.buckets if b.width == w]
+            if not match:
                 continue
+            b = match[0]
             nb = b.rows.shape[0]
             rows[d, :nb] = b.rows
             cols[d, :nb] = b.cols
@@ -142,4 +226,5 @@ def stack_shards(shards, chunk_elems):
         rows_per_shard=num_rows,
         chunk_elems=chunk_elems,
         nnz=sum(s.nnz for s in shards),
+        positions=positions,
     )

@@ -22,10 +22,16 @@ Scope (honest contract): the high-level Estimator is single-controller —
 it materializes full factor matrices host-side and raises a clear error
 under multi-process JAX rather than failing inside a collective.  The
 multi-host surface is the trainer level: these helpers + per-host rating
-shards + ``jax.make_array_from_process_local_data`` for the factor/bucket
-placement.  Wiring the Estimator itself for multi-process is future work;
-nothing in the sharded math (shard_map steps, collectives) is
-single-process-specific.
+shards (``data.shard_csr(positions=...)`` building only the local shards
+into the globally-agreed ``data.shard_layout`` shapes) +
+``jax.make_array_from_process_local_data`` for the factor/bucket
+placement.  This path is exercised END-TO-END by
+``tests/test_multihost.py::test_two_process_sharded_step_matches_single_process``:
+two spawned processes, gloo collectives over a 4-device global CPU mesh,
+per-host blocking, one sharded ALS step — asserted equal to the
+single-process result.  Wiring the Estimator itself for multi-process is
+future work; nothing in the sharded math (shard_map steps, collectives)
+is single-process-specific.
 """
 
 from __future__ import annotations

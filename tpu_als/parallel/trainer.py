@@ -57,10 +57,24 @@ def make_sharded_step(mesh, user_sharded, item_sharded, cfg: AlsConfig):
     over ``mesh``.
     """
     n_shards = user_sharded.buckets[0].rows.shape[0]
-    if mesh.devices.size != n_shards:
+    positions = getattr(user_sharded, "positions", None)
+    if positions is not None:
+        # process-local container (data.shard_csr positions=): must hold
+        # exactly this process's mesh positions, in mesh order
+        from tpu_als.parallel.multihost import local_positions
+
+        if list(positions) != local_positions(mesh):
+            raise ValueError(
+                f"rating shards were built for mesh positions "
+                f"{list(positions)} but this process owns "
+                f"{local_positions(mesh)}; a mismatch would scatter "
+                "shards onto the wrong devices"
+            )
+    elif mesh.devices.size != n_shards:
         raise ValueError(
-            f"mesh has {mesh.devices.size} devices but the rating shards were "
-            f"built for {n_shards}; a mismatch would silently drop shards"
+            f"mesh has {mesh.devices.size} devices but the rating shards "
+            f"were built for {n_shards}; a mismatch would silently drop "
+            "shards"
         )
     _prewarm(cfg)
     per_u = user_sharded.rows_per_shard

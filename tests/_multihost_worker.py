@@ -1,11 +1,12 @@
 """Worker for the REAL multi-process test (tests/test_multihost.py).
 
 Each of the two spawned processes owns 2 CPU devices of a 4-device global
-mesh, blocks ONLY its local ratings (multihost.local_rating_mask +
-data.shard_csr positions=), assembles global arrays with
-``jax.make_array_from_process_local_data``, runs one sharded ALS step over
-the global mesh (cross-process collectives via gloo), and saves its local
-factor rows for the parent to compare against a single-process run.
+mesh and starts with a DISJOINT half of the rating triples (as if each
+read its own input split).  ``train_multihost`` then redistributes,
+blocks per-host (shard_csr positions=), assembles global arrays, and runs
+the sharded trainer with cross-process gloo collectives.  The worker
+saves its local factor rows for the parent to compare against a
+single-process run over the full data.
 
 Env contract (set by the parent): JAX_COORDINATOR_ADDRESS,
 JAX_NUM_PROCESSES, JAX_PROCESS_ID (exercises init_distributed's env-var
@@ -25,76 +26,34 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
-from tpu_als.core.als import AlsConfig, init_factors
-from tpu_als.parallel.data import partition_balanced, shard_csr
-from tpu_als.parallel.mesh import AXIS, make_mesh
-from tpu_als.parallel.multihost import (
-    init_distributed,
-    local_positions,
-    local_rating_mask,
-)
-from tpu_als.parallel.trainer import make_sharded_step
+from tpu_als.core.als import AlsConfig
+from tpu_als.parallel.mesh import make_mesh
+from tpu_als.parallel.multihost import init_distributed, train_multihost
 
 
 def main():
     pid, pcount = init_distributed()  # env-var path
     assert pcount == 2, pcount
-    D = jax.device_count()
-    assert D == 4, D
-    mesh = make_mesh()  # global mesh over all 4 devices
-    positions = local_positions(mesh)
-    assert len(positions) == 2, positions
+    assert jax.device_count() == 4
+    mesh = make_mesh()
 
-    # identical synthetic data on both hosts (seeded) — only the LOCAL
-    # subset is fed to the blocking builders below
+    # identical seeded synthetic on both hosts; each KEEPS only its half
+    # (interleaved split, as if reading separate input files)
     rng = np.random.default_rng(7)
     nU, nI, nnz = 50, 30, 600
     u = rng.integers(0, nU, nnz)
     i = rng.integers(0, nI, nnz)
     r = np.abs(rng.normal(size=nnz)).astype(np.float32) + 0.1
-    ucounts = np.bincount(u, minlength=nU)
-    icounts = np.bincount(i, minlength=nI)
-    upart = partition_balanced(ucounts, D)
-    ipart = partition_balanced(icounts, D)
-
-    umask = local_rating_mask(upart, u, positions=positions)
-    imask = local_rating_mask(ipart, i, positions=positions)
-    ush = shard_csr(upart, ipart, u[umask], i[umask], r[umask], min_width=4,
-                    positions=positions, row_counts=ucounts)
-    ish = shard_csr(ipart, upart, i[imask], u[imask], r[imask], min_width=4,
-                    positions=positions, row_counts=icounts)
-
-    leading = NamedSharding(mesh, P(AXIS))
-
-    def assemble(local):
-        return jax.make_array_from_process_local_data(leading, local)
-
-    ub = jax.tree.map(assemble, ush.device_buckets())
-    ib = jax.tree.map(assemble, ish.device_buckets())
-
-    cfg = AlsConfig(rank=6, max_iter=1, reg_param=0.05, implicit_prefs=True,
+    mine = np.arange(nnz) % 2 == pid
+    cfg = AlsConfig(rank=6, max_iter=2, reg_param=0.05, implicit_prefs=True,
                     alpha=3.0, seed=0)
-    key = jax.random.PRNGKey(cfg.seed)
-    ku, kv = jax.random.split(key)
-    # slot-space factors: full init on every host (cheap), local rows fed
-    # to the global array
-    U0 = np.zeros((upart.padded_rows, cfg.rank), np.float32)
-    U0[upart.slot] = np.asarray(init_factors(ku, nU, cfg.rank))
-    V0 = np.zeros((ipart.padded_rows, cfg.rank), np.float32)
-    V0[ipart.slot] = np.asarray(init_factors(kv, nI, cfg.rank))
-    rps_u, rps_i = upart.rows_per_shard, ipart.rows_per_shard
-    U_loc = np.concatenate([U0[p * rps_u:(p + 1) * rps_u] for p in positions])
-    V_loc = np.concatenate([V0[p * rps_i:(p + 1) * rps_i] for p in positions])
-    U = jax.make_array_from_process_local_data(leading, U_loc)
-    V = jax.make_array_from_process_local_data(leading, V_loc)
-
-    step = make_sharded_step(mesh, ush, ish, cfg)
-    U1, V1 = step(U, V, ub, ib)
+    U, V, upart, ipart = train_multihost(
+        u[mine], i[mine], r[mine], nU, nI, cfg, mesh=mesh, min_width=4)
 
     out = {}
-    for name, arr, rps in (("U", U1, rps_u), ("V", V1, rps_i)):
+    for name, arr, rps in (("U", U, upart.rows_per_shard),
+                           ("V", V, ipart.rows_per_shard)):
         for s in arr.addressable_shards:
             pos = s.index[0].start // rps if s.index[0].start else 0
             out[f"{name}{pos}"] = np.asarray(s.data)

@@ -143,7 +143,7 @@ def test_two_process_sharded_step_matches_single_process(tmp_path):
     leading = NamedSharding(mesh, P(AXIS))
     ub = jax.device_put(ush.device_buckets(), leading)
     ib = jax.device_put(ish.device_buckets(), leading)
-    cfg = AlsConfig(rank=6, max_iter=1, reg_param=0.05, implicit_prefs=True,
+    cfg = AlsConfig(rank=6, max_iter=2, reg_param=0.05, implicit_prefs=True,
                     alpha=3.0, seed=0)
     key = jax.random.PRNGKey(cfg.seed)
     ku, kv = jax.random.split(key)
@@ -152,8 +152,10 @@ def test_two_process_sharded_step_matches_single_process(tmp_path):
     V0 = np.zeros((ipart.padded_rows, cfg.rank), np.float32)
     V0[ipart.slot] = np.asarray(init_factors(kv, nI, cfg.rank))
     step = make_sharded_step(mesh, ush, ish, cfg)
-    U1, V1 = step(jax.device_put(jnp.asarray(U0), leading),
-                  jax.device_put(jnp.asarray(V0), leading), ub, ib)
+    U1 = jax.device_put(jnp.asarray(U0), leading)
+    V1 = jax.device_put(jnp.asarray(V0), leading)
+    for _ in range(cfg.max_iter):
+        U1, V1 = step(U1, V1, ub, ib)
     U1, V1 = np.asarray(U1), np.asarray(V1)
 
     rps_u, rps_i = upart.rows_per_shard, ipart.rows_per_shard

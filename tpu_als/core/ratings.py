@@ -161,6 +161,15 @@ def scan_chunk(nb, width, chunk_elems):
     return max(1, min(cap, full, tgt))
 
 
+def padded_bucket_rows(nb, width, chunk_elems):
+    """Bucket row count padded to its scan chunk — THE pairing every
+    builder must use identically (numpy/native blocking, the sharded
+    stacker, and the multi-host layout agreement all call this; a drifted
+    copy would make hosts disagree on global bucket shapes)."""
+    chunk = scan_chunk(nb, width, chunk_elems)
+    return -(-nb // chunk) * chunk
+
+
 def trainer_chunk(nb_padded, width, rank, chunk_elems, mem_elems=1 << 28):
     """Trainer-side chunk: the builder chunk, halved until the largest
     per-chunk intermediate — max(Vg [chunk,w,r], A [chunk,r,r]) — fits in
@@ -237,8 +246,7 @@ def build_csr_buckets(
     for w in sorted(set(widths.tolist())):
         sel_rows = np.flatnonzero(widths == w)  # indices into uniq
         nb = len(sel_rows)
-        chunk = scan_chunk(nb, w, chunk_elems)
-        nb_pad = -(-nb // chunk) * chunk
+        nb_pad = padded_bucket_rows(nb, w, chunk_elems)
         rows = np.full(nb_pad, num_rows, dtype=np.int32)
         rows[:nb] = uniq[sel_rows]
         cols = np.zeros((nb_pad, w), dtype=np.int32)
@@ -277,8 +285,7 @@ def _build_csr_buckets_native(row_idx, col_idx, vals, num_rows, min_width,
     bucket_widths = sorted(set(w_all[rated].tolist()))
     for w in bucket_widths:
         nb = int((rated & (w_all == w)).sum())
-        chunk = scan_chunk(nb, w, chunk_elems)
-        layout.append((int(w), nb, -(-nb // chunk) * chunk))
+        layout.append((int(w), nb, padded_bucket_rows(nb, w, chunk_elems)))
     # per-entity bucket index (exact width match; -1 for unrated entities)
     ebucket = np.searchsorted(
         np.asarray(bucket_widths, dtype=np.int64), w_all

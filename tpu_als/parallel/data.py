@@ -26,7 +26,7 @@ from tpu_als.core.ratings import (
     Bucket,
     build_csr_buckets,
     entity_widths,
-    scan_chunk,
+    padded_bucket_rows,
 )
 
 
@@ -117,14 +117,9 @@ def shard_layout(row_part, row_counts, min_width=8, chunk_elems=1 << 19,
     for w in sorted(set(w_all[rated].tolist())):
         sel = rated & (w_all == w)
         nb_d = np.bincount(row_part.owner[sel], minlength=D)
-        padded = [
-            -(-int(nb) // scan_chunk(int(nb), w, chunk_elems))
-            * scan_chunk(int(nb), w, chunk_elems)
-            for nb in nb_d if nb
-        ]
-        nb_max = max(padded)
-        chunk = scan_chunk(nb_max, w, chunk_elems)
-        layout.append((w, -(-nb_max // chunk) * chunk))
+        nb_max = max(padded_bucket_rows(int(nb), w, chunk_elems)
+                     for nb in nb_d if nb)
+        layout.append((w, padded_bucket_rows(nb_max, w, chunk_elems)))
     return layout
 
 
@@ -195,8 +190,7 @@ def stack_shards(shards, chunk_elems, layout=None, positions=None):
             nb_max = max(b.rows.shape[0] for s in shards for b in s.buckets
                          if b.width == w)
             # keep row padding aligned to the scan chunk all shards use
-            chunk = scan_chunk(nb_max, w, chunk_elems)
-            layout.append((w, -(-nb_max // chunk) * chunk))
+            layout.append((w, padded_bucket_rows(nb_max, w, chunk_elems)))
     missing = set(built_widths) - {w for w, _ in layout}
     if missing:
         raise ValueError(

@@ -56,26 +56,27 @@ def make_sharded_step(mesh, user_sharded, item_sharded, cfg: AlsConfig):
     Returns ``step(U, V) -> (U, V)`` on slot-space factor arrays sharded
     over ``mesh``.
     """
-    n_shards = user_sharded.buckets[0].rows.shape[0]
-    positions = getattr(user_sharded, "positions", None)
-    if positions is not None:
-        # process-local container (data.shard_csr positions=): must hold
-        # exactly this process's mesh positions, in mesh order
-        from tpu_als.parallel.multihost import local_positions
+    for side, sharded in (("user", user_sharded), ("item", item_sharded)):
+        n_shards = sharded.buckets[0].rows.shape[0]
+        positions = getattr(sharded, "positions", None)
+        if positions is not None:
+            # process-local container (data.shard_csr positions=): must
+            # hold exactly this process's mesh positions, in mesh order
+            from tpu_als.parallel.multihost import local_positions
 
-        if list(positions) != local_positions(mesh):
+            if list(positions) != local_positions(mesh):
+                raise ValueError(
+                    f"{side} rating shards were built for mesh positions "
+                    f"{list(positions)} but this process owns "
+                    f"{local_positions(mesh)}; a mismatch would scatter "
+                    "shards onto the wrong devices"
+                )
+        elif mesh.devices.size != n_shards:
             raise ValueError(
-                f"rating shards were built for mesh positions "
-                f"{list(positions)} but this process owns "
-                f"{local_positions(mesh)}; a mismatch would scatter "
-                "shards onto the wrong devices"
+                f"mesh has {mesh.devices.size} devices but the {side} "
+                f"rating shards were built for {n_shards}; a mismatch "
+                "would silently drop shards"
             )
-    elif mesh.devices.size != n_shards:
-        raise ValueError(
-            f"mesh has {mesh.devices.size} devices but the rating shards "
-            f"were built for {n_shards}; a mismatch would silently drop "
-            "shards"
-        )
     _prewarm(cfg)
     per_u = user_sharded.rows_per_shard
     per_i = item_sharded.rows_per_shard

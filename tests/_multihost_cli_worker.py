@@ -1,0 +1,24 @@
+"""Worker for the multi-process CLI test: runs the real
+``tpu_als.cli train`` entry under a 2-process gloo deployment (CPU
+devices forced before first JAX use — the axon plugin ignores the
+JAX_PLATFORMS env var, so this must be a config knob in a wrapper)."""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from tpu_als.cli import main
+
+if __name__ == "__main__":
+    main(["train", "--data", "synthetic:120x50x3000", "--rank", "4",
+          "--max-iter", "3", "--reg-param", "0.01", "--seed", "0",
+          "--devices", "0", "--output", os.environ["MH_OUT"]])
+    print("cli worker done", flush=True)

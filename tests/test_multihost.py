@@ -170,3 +170,55 @@ def test_two_process_sharded_step_matches_single_process(tmp_path):
             np.testing.assert_allclose(dat[kname], ref, rtol=2e-5,
                                        atol=2e-5, err_msg=kname)
     assert seen == {(s, p) for s in "UV" for p in range(4)}
+
+
+def test_two_process_cli_train(tmp_path):
+    """The CLI's multi-process branch end-to-end: two spawned processes
+    run the same `train` command; process 0 evaluates and saves a model
+    the parent can load and serve."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_cli_worker.py")
+    out_dir = str(tmp_path / "model")
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update(JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
+                   MH_OUT=out_dir)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            text, _ = p.communicate(timeout=300)
+            outs.append(text)
+            assert p.returncode == 0, text[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    import json as _json
+
+    rmse_lines = [ln for text in outs for ln in text.splitlines()
+                  if ln.startswith("{") and "holdout_rmse" in ln]
+    assert len(rmse_lines) == 1, outs  # only process 0 reports
+    rmse = _json.loads(rmse_lines[0])["holdout_rmse"]
+    assert 0.0 < rmse < 1.6, rmse  # synthetic stars std ~1.0
+
+    from tpu_als import ALSModel
+    from tpu_als.io.movielens import synthetic_movielens
+
+    model = ALSModel.load(out_dir)
+    frame = synthetic_movielens(120, 50, 3000, seed=0)
+    preds = model.transform(frame)["prediction"]
+    assert np.isfinite(preds).all() and len(preds) > 0

@@ -341,8 +341,14 @@ class ALS(_ALSParams):
                           start_iter=start_iter)
             U, V = np.asarray(U), np.asarray(V)
 
+        return self._make_model(user_map, item_map, U, V)
+
+    def _make_model(self, user_map, item_map, U, V):
+        """Model assembly shared by ``fit`` and the multi-process CLI
+        path (tpu_als.cli) — one place for the params snapshot."""
         return ALSModel(
-            rank=cfg.rank, user_map=user_map, item_map=item_map,
+            rank=self.getOrDefault(self.getParam("rank")),
+            user_map=user_map, item_map=item_map,
             user_factors=U, item_factors=V,
             params={p.name: v for p, v in self.extractParamMap().items()},
             parent=self,

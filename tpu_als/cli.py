@@ -56,13 +56,7 @@ def cmd_train(args):
 
         init_distributed()  # no-op single-process; DCN rendezvous on pods
         if jax.process_count() > 1:
-            # the Estimator fit path is single-process (it would raise
-            # NotImplementedError after rendezvous); fail before training
-            # so each pod host doesn't silently train the full dataset
-            raise SystemExit(
-                "multi-process training is not wired into the CLI yet: "
-                "ALS.fit requires a single process owning all devices "
-                "(see tpu_als.parallel.multihost for the bring-up path)")
+            return _train_multiprocess(args)
         visible = len(jax.devices())
         if args.devices > visible:
             raise SystemExit(
@@ -92,6 +86,89 @@ def cmd_train(args):
     if args.output:
         # CLI --output semantics: replace (atomically) — a rerun must not
         # crash after the whole training finished
+        model.write().overwrite().save(args.output)
+        print(f"model saved to {args.output}", file=sys.stderr)
+    return model
+
+
+def _train_multiprocess(args):
+    """Multi-process training path (every pod host runs the same command).
+
+    Convention: every host loads the SAME ``--data`` (→
+    ``train_multihost(replicated=True)`` — no redundant rating exchange);
+    each then blocks only the shards its devices own.  Process 0
+    evaluates the holdout and saves the model.
+    """
+    import contextlib
+
+    import jax
+
+    from tpu_als import RegressionEvaluator
+    from tpu_als.api.estimator import ALS
+    from tpu_als.core.als import AlsConfig
+    from tpu_als.core.ratings import remap_ids
+    from tpu_als.parallel.mesh import make_mesh
+    from tpu_als.parallel.multihost import (
+        gather_entity_factors,
+        train_multihost,
+    )
+
+    pid, pcount = jax.process_index(), jax.process_count()
+    if args.gather_strategy != "all_gather":
+        raise SystemExit(
+            f"--gather-strategy {args.gather_strategy} is not wired into "
+            "the multi-process path yet (all_gather only); ring/a2a "
+            "multi-process support lives at the trainer level")
+    if args.log_file:
+        raise SystemExit(
+            "--log-file is single-process only: the per-iteration probe "
+            "materializes full factors host-side")
+    visible = len(jax.devices())
+    if args.devices not in (0, visible):
+        raise SystemExit(
+            f"--devices {args.devices} under {pcount} processes: the "
+            f"multi-process path always uses the full deployment "
+            f"({visible} devices); pass --devices 0")
+
+    frame = _load_data(args.data)
+    train, test = frame.randomSplit([1 - args.holdout, args.holdout],
+                                    seed=args.seed)  # same split everywhere
+    u_idx, user_map = remap_ids(np.asarray(train["user"]))
+    i_idx, item_map = remap_ids(np.asarray(train["item"]))
+    r = np.asarray(train["rating"], dtype=np.float32)
+
+    cfg = AlsConfig(rank=args.rank, max_iter=args.max_iter,
+                    reg_param=args.reg_param, implicit_prefs=args.implicit,
+                    alpha=args.alpha, nonnegative=args.nonnegative,
+                    seed=args.seed)
+    mesh = make_mesh()  # global mesh over every host's devices
+    print(f"[proc {pid}/{pcount}] training {len(r):,} ratings "
+          f"(replicated load) over {mesh.devices.size} devices",
+          file=sys.stderr)
+    ctx = contextlib.nullcontext()
+    if args.profile_dir:
+        from tpu_als.utils.observe import trace
+
+        ctx = trace(f"{args.profile_dir}/proc{pid}")
+    with ctx:
+        U, V, upart, ipart = train_multihost(
+            u_idx, i_idx, r, len(user_map), len(item_map),
+            cfg, mesh=mesh, replicated=True)
+    Ue = gather_entity_factors(U, upart, mesh)
+    Ve = gather_entity_factors(V, ipart, mesh)
+
+    if pid != 0:
+        return None
+    est = ALS(rank=args.rank, maxIter=args.max_iter,
+              regParam=args.reg_param, implicitPrefs=args.implicit,
+              alpha=args.alpha, nonnegative=args.nonnegative,
+              seed=args.seed, coldStartStrategy="drop")
+    model = est._make_model(user_map, item_map, Ue, Ve)
+    if len(test):
+        rmse = RegressionEvaluator(labelCol="rating").evaluate(
+            model.transform(test))
+        print(json.dumps({"holdout_rmse": round(rmse, 4)}))
+    if args.output:
         model.write().overwrite().save(args.output)
         print(f"model saved to {args.output}", file=sys.stderr)
     return model

@@ -70,7 +70,8 @@ def init_distributed(coordinator_address=None, num_processes=None,
 
 
 def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
-                    min_width=8, chunk_elems=1 << 19):
+                    min_width=8, chunk_elems=1 << 19, replicated=False,
+                    callback=None):
     """Multi-process ALS training: every process calls this with its OWN
     rating triples (global dense ids) — the analog of Spark executors each
     reading their input split and ``partitionRatings`` shuffling blocks to
@@ -78,8 +79,9 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
 
     Pipeline: (1) redistribute triples so each host sees the ratings its
     entities own — implemented with ``process_allgather`` (O(total nnz)
-    per host; at pod scale feed pre-sharded inputs through
-    :func:`local_rating_mask` instead and skip this step); (2) global
+    per host; pass ``replicated=True`` when every host already loaded the
+    FULL dataset to skip the exchange, or at pod scale feed pre-sharded
+    inputs through :func:`local_rating_mask`); (2) global
     counts → partitions → per-host blocking into the agreed
     :func:`tpu_als.parallel.data.shard_layout` shapes; (3) global-array
     assembly via ``jax.make_array_from_process_local_data``; (4) the
@@ -109,6 +111,30 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
     r = np.asarray(r, dtype=np.float32)
 
     if jax.process_count() > 1:
+        from jax.experimental import multihost_utils as mhu
+
+        # cross-host agreement check: divergent entity spaces would fail
+        # far away (mismatched global shapes inside gloo) or silently
+        # corrupt factors if shapes happened to coincide
+        dims = np.asarray(mhu.process_allgather(
+            np.array([num_users, num_items], dtype=np.int64)))
+        if not (dims == dims[0]).all():
+            raise ValueError(
+                f"hosts disagree on the entity space: (num_users, "
+                f"num_items) per process = {dims.tolist()}; all hosts "
+                "must share one id mapping")
+
+        if replicated:
+            # every host already holds the FULL triples (e.g. all loaded
+            # the same file): skip the O(total nnz) exchange
+            nnzs = np.asarray(mhu.process_allgather(
+                np.array([len(u)], dtype=np.int64))).ravel()
+            if not (nnzs == nnzs[0]).all():
+                raise ValueError(
+                    f"replicated=True but per-host nnz differ: "
+                    f"{nnzs.tolist()} — pass each host's own split with "
+                    "replicated=False instead")
+    if jax.process_count() > 1 and not replicated:
         from jax.experimental import multihost_utils as mhu
 
         n_local = np.array([len(u)], dtype=np.int64)
@@ -163,9 +189,40 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
         [V0[p * rps_i:(p + 1) * rps_i] for p in positions]))
 
     step = make_sharded_step(mesh, ush, ish, cfg)
-    for _ in range(cfg.max_iter):
+    for it in range(cfg.max_iter):
         U, V = step(U, V, ub, ib)
+        if callback is not None:
+            callback(it + 1, U, V)
     return U, V, upart, ipart
+
+
+def gather_entity_factors(arr, part, mesh):
+    """Host-replicated entity-space factors from a slot-space global array.
+
+    Small-model convenience for the serving/persistence boundary (the
+    reference's ``ALSModel`` is a driver-side object too); at pod scale
+    keep factors sharded and serve from device.  Works single- and
+    multi-process (one ``process_allgather`` of the local rows).
+    """
+    rps = part.rows_per_shard
+    rank = arr.shape[-1]
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    local = np.concatenate([np.asarray(s.data) for s in shards])
+    positions = np.asarray(local_positions(mesh), dtype=np.int64)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils as mhu
+
+        g_rows = np.asarray(mhu.process_allgather(local))      # [P, L*rps, r]
+        g_pos = np.asarray(mhu.process_allgather(positions))   # [P, L]
+        slotspace = np.zeros((part.padded_rows, rank), np.float32)
+        for p in range(g_rows.shape[0]):
+            for li, pos in enumerate(g_pos[p]):
+                slotspace[pos * rps:(pos + 1) * rps] = \
+                    g_rows[p, li * rps:(li + 1) * rps]
+    else:
+        slotspace = local
+    return slotspace[part.slot]
 
 
 def local_positions(mesh):

@@ -76,10 +76,20 @@ class ColumnarFrame:
 
     def randomSplit(self, weights, seed=None):
         """Seeded proportional split — the reference app layer's
-        ``df.randomSplit([0.8, 0.2])`` (SURVEY.md §2.A2)."""
+        ``df.randomSplit([0.8, 0.2])`` (SURVEY.md §2.A2).
+
+        The split stream lives in its own seed domain (spawn_key): a bare
+        ``default_rng(seed)`` would REPLAY the exact uniform stream of any
+        other generator seeded with the same integer — observed with the
+        synthetic dataset generator, where a same-seed split's draws were
+        the very uniforms that drew the user column, making membership
+        correlate with user id (train covered 50 of 120 users).
+        """
         w = np.asarray(weights, dtype=np.float64)
         w = w / w.sum()
-        rng = np.random.default_rng(seed)
+        rng = np.random.default_rng(
+            None if seed is None
+            else np.random.SeedSequence(seed, spawn_key=(0x5917,)))
         draws = rng.random(len(self))
         edges = np.cumsum(w)[:-1]
         bucket = np.searchsorted(edges, draws, side="right")

@@ -15,10 +15,27 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
-from tpu_als.cli import main
-
 if __name__ == "__main__":
-    main(["train", "--data", "synthetic:120x50x3000", "--rank", "4",
-          "--max-iter", "3", "--reg-param", "0.01", "--seed", "0",
-          "--devices", "0", "--output", os.environ["MH_OUT"]])
-    print("cli worker done", flush=True)
+    if os.environ.get("MH_MODE") == "fit":
+        # multi-process ALS.fit: every host fits the same replicated frame
+        import numpy as np
+
+        from tpu_als import ALS
+        from tpu_als.io.movielens import synthetic_movielens
+        from tpu_als.parallel.mesh import make_mesh
+
+        frame = synthetic_movielens(100, 40, 2500, seed=1)
+        model = ALS(rank=4, maxIter=3, regParam=0.02, seed=0,
+                    mesh=make_mesh()).fit(frame)
+        if jax.process_index() == 0:
+            np.savez(os.environ["MH_OUT"] + ".fit.npz",
+                     U=model._U, V=model._V,
+                     uids=model._user_map.ids, iids=model._item_map.ids)
+        print("fit worker done", flush=True)
+    else:
+        from tpu_als.cli import main
+
+        main(["train", "--data", "synthetic:120x50x3000", "--rank", "4",
+              "--max-iter", "3", "--reg-param", "0.01", "--seed", "0",
+              "--devices", "0", "--output", os.environ["MH_OUT"]])
+        print("cli worker done", flush=True)

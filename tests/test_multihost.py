@@ -222,3 +222,52 @@ def test_two_process_cli_train(tmp_path):
     frame = synthetic_movielens(120, 50, 3000, seed=0)
     preds = model.transform(frame)["prediction"]
     assert np.isfinite(preds).all() and len(preds) > 0
+
+
+def test_two_process_estimator_fit_matches_single_process(tmp_path):
+    """Multi-process ALS.fit == single-process mesh fit, exactly the same
+    partitions/init/layout — the Estimator-level multi-host contract."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_cli_worker.py")
+    out = str(tmp_path / "fitout")
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update(JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
+                   MH_OUT=out, MH_MODE="fit")
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    try:
+        for p in procs:
+            text, _ = p.communicate(timeout=300)
+            assert p.returncode == 0, text[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    from tpu_als import ALS
+    from tpu_als.io.movielens import synthetic_movielens
+    from tpu_als.parallel.mesh import make_mesh
+
+    frame = synthetic_movielens(100, 40, 2500, seed=1)
+    ref = ALS(rank=4, maxIter=3, regParam=0.02, seed=0,
+              mesh=make_mesh(4)).fit(frame)
+    dat = np.load(out + ".fit.npz")
+    np.testing.assert_array_equal(dat["uids"], ref._user_map.ids)
+    np.testing.assert_array_equal(dat["iids"], ref._item_map.ids)
+    # cross-process collectives reorder reductions; 3 iterations compound
+    # to ~1e-4 worst-case on f32
+    np.testing.assert_allclose(dat["U"], ref._U, rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(dat["V"], ref._V, rtol=5e-4, atol=5e-4)

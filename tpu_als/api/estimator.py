@@ -283,12 +283,40 @@ class ALS(_ALSParams):
             from tpu_als.parallel.trainer import stacked_counts, train_sharded
 
             if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "the Estimator is single-controller: it materializes "
-                    "full factor matrices host-side, which is not valid "
-                    "under multi-process JAX. For multi-host training use "
-                    "tpu_als.parallel.trainer with per-host rating shards "
-                    "(see tpu_als.parallel.multihost).")
+                # multi-process fit: every host calls fit with the SAME
+                # (replicated) dataset; blocking is per-host, training
+                # crosses hosts via collectives, and the fitted factors
+                # are re-replicated for the (driver-side) model object.
+                # Same init/partitions/layout as the single-process mesh
+                # path -> identical factors (pinned by the two-process
+                # test).  Not yet wired here: non-default gatherStrategy,
+                # checkpointing/resume, fit callbacks.
+                unsupported = [
+                    n for n, v in (
+                        ("gatherStrategy != 'all_gather'",
+                         self.gatherStrategy != "all_gather"),
+                        ("checkpointDir", self.checkpointDir),
+                        ("resumeFrom", self.resumeFrom),
+                        ("fitCallback", self.fitCallback),
+                    ) if v
+                ]
+                if unsupported:
+                    raise NotImplementedError(
+                        f"multi-process fit does not support "
+                        f"{', '.join(unsupported)} yet; use "
+                        "tpu_als.parallel.multihost.train_multihost "
+                        "directly for custom multi-host loops")
+                from tpu_als.parallel.multihost import (
+                    gather_entity_factors,
+                    train_multihost,
+                )
+
+                Us, Vs, upart, ipart = train_multihost(
+                    u_idx, i_idx, r, len(user_map), len(item_map), cfg,
+                    mesh=self.mesh, replicated=True)
+                U = gather_entity_factors(Us, upart, self.mesh)
+                V = gather_entity_factors(Vs, ipart, self.mesh)
+                return self._make_model(user_map, item_map, U, V)
             D = self.mesh.devices.size
             upart = partition_balanced(
                 np.bincount(u_idx, minlength=len(user_map)), D)

@@ -94,10 +94,10 @@ def cmd_train(args):
 def _train_multiprocess(args):
     """Multi-process training path (every pod host runs the same command).
 
-    Convention: every host loads the SAME ``--data`` (→
-    ``train_multihost(replicated=True)`` — no redundant rating exchange);
-    each then blocks only the shards its devices own.  Process 0
-    evaluates the holdout and saves the model.
+    Convention: every host loads the SAME ``--data`` and calls the same
+    ``ALS(mesh=...).fit`` — its multi-process branch blocks only the
+    shards each host's devices own and trains with cross-host
+    collectives.  Process 0 evaluates the holdout and saves the model.
     """
     import contextlib
 
@@ -105,13 +105,7 @@ def _train_multiprocess(args):
 
     from tpu_als import RegressionEvaluator
     from tpu_als.api.estimator import ALS
-    from tpu_als.core.als import AlsConfig
-    from tpu_als.core.ratings import remap_ids
     from tpu_als.parallel.mesh import make_mesh
-    from tpu_als.parallel.multihost import (
-        gather_entity_factors,
-        train_multihost,
-    )
 
     pid, pcount = jax.process_index(), jax.process_count()
     if args.gather_strategy != "all_gather":
@@ -133,37 +127,26 @@ def _train_multiprocess(args):
     frame = _load_data(args.data)
     train, test = frame.randomSplit([1 - args.holdout, args.holdout],
                                     seed=args.seed)  # same split everywhere
-    u_idx, user_map = remap_ids(np.asarray(train["user"]))
-    i_idx, item_map = remap_ids(np.asarray(train["item"]))
-    r = np.asarray(train["rating"], dtype=np.float32)
-
-    cfg = AlsConfig(rank=args.rank, max_iter=args.max_iter,
-                    reg_param=args.reg_param, implicit_prefs=args.implicit,
-                    alpha=args.alpha, nonnegative=args.nonnegative,
-                    seed=args.seed)
     mesh = make_mesh()  # global mesh over every host's devices
-    print(f"[proc {pid}/{pcount}] training {len(r):,} ratings "
+    print(f"[proc {pid}/{pcount}] training {len(train):,} ratings "
           f"(replicated load) over {mesh.devices.size} devices",
           file=sys.stderr)
+    als = ALS(rank=args.rank, maxIter=args.max_iter,
+              regParam=args.reg_param, implicitPrefs=args.implicit,
+              alpha=args.alpha, nonnegative=args.nonnegative,
+              seed=args.seed, coldStartStrategy="drop", mesh=mesh)
     ctx = contextlib.nullcontext()
     if args.profile_dir:
         from tpu_als.utils.observe import trace
 
         ctx = trace(f"{args.profile_dir}/proc{pid}")
     with ctx:
-        U, V, upart, ipart = train_multihost(
-            u_idx, i_idx, r, len(user_map), len(item_map),
-            cfg, mesh=mesh, replicated=True)
-    Ue = gather_entity_factors(U, upart, mesh)
-    Ve = gather_entity_factors(V, ipart, mesh)
+        # fit's multi-process branch: per-host blocking, cross-host
+        # collectives, replicated model on every host
+        model = als.fit(train)
 
     if pid != 0:
         return None
-    est = ALS(rank=args.rank, maxIter=args.max_iter,
-              regParam=args.reg_param, implicitPrefs=args.implicit,
-              alpha=args.alpha, nonnegative=args.nonnegative,
-              seed=args.seed, coldStartStrategy="drop")
-    model = est._make_model(user_map, item_map, Ue, Ve)
     if len(test):
         rmse = RegressionEvaluator(labelCol="rating").evaluate(
             model.transform(test))

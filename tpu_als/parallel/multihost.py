@@ -18,20 +18,20 @@ land there, so blocking (`build_csr_buckets` / `build_a2a`) runs on the
 local shard only — the analog of executors building only their own
 ``InBlock``s.
 
-Scope (honest contract): the high-level Estimator is single-controller —
-it materializes full factor matrices host-side and raises a clear error
-under multi-process JAX rather than failing inside a collective.  The
-multi-host surface is the trainer level: these helpers + per-host rating
-shards (``data.shard_csr(positions=...)`` building only the local shards
-into the globally-agreed ``data.shard_layout`` shapes) +
-``jax.make_array_from_process_local_data`` for the factor/bucket
-placement.  This path is exercised END-TO-END by
-``tests/test_multihost.py::test_two_process_sharded_step_matches_single_process``:
-two spawned processes, gloo collectives over a 4-device global CPU mesh,
-per-host blocking, one sharded ALS step — asserted equal to the
-single-process result.  Wiring the Estimator itself for multi-process is
-future work; nothing in the sharded math (shard_map steps, collectives)
-is single-process-specific.
+Scope: three multi-process entry tiers, all exercised by REAL spawned
+two-process gloo tests in ``tests/test_multihost.py``:
+
+1. ``ALS(mesh=...).fit(frame)`` — every host fits the same replicated
+   frame; factors match the single-process mesh fit exactly (same
+   partitions/init/layout).  Not yet wired there: non-default
+   gatherStrategy, checkpoint/resume, fit callbacks.
+2. ``tpu_als.cli train`` — same convention, plus holdout eval and model
+   save on process 0.
+3. :func:`train_multihost` — per-host rating splits (redistributed or
+   ``replicated=True``), for custom loops; built on
+   ``data.shard_csr(positions=...)`` blocking into the globally-agreed
+   ``data.shard_layout`` shapes and
+   ``jax.make_array_from_process_local_data`` placement.
 """
 
 from __future__ import annotations
@@ -70,8 +70,7 @@ def init_distributed(coordinator_address=None, num_processes=None,
 
 
 def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
-                    min_width=8, chunk_elems=1 << 19, replicated=False,
-                    callback=None):
+                    min_width=8, chunk_elems=1 << 19, replicated=False):
     """Multi-process ALS training: every process calls this with its OWN
     rating triples (global dense ids) — the analog of Spark executors each
     reading their input split and ``partitionRatings`` shuffling blocks to
@@ -126,14 +125,20 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
 
         if replicated:
             # every host already holds the FULL triples (e.g. all loaded
-            # the same file): skip the O(total nnz) exchange
-            nnzs = np.asarray(mhu.process_allgather(
-                np.array([len(u)], dtype=np.int64))).ravel()
-            if not (nnzs == nnzs[0]).all():
+            # the same file): skip the O(total nnz) exchange — but check
+            # CONTENT agreement, not just length (same-length divergent
+            # inputs would give hosts divergent partitions and corrupt
+            # training far from here)
+            sig = np.asarray(mhu.process_allgather(np.array(
+                [len(u), int(u.sum()), int(i.sum()),
+                 np.float64(r.astype(np.float64).sum()).view(np.int64)],
+                dtype=np.int64)))
+            if not (sig == sig[0]).all():
                 raise ValueError(
-                    f"replicated=True but per-host nnz differ: "
-                    f"{nnzs.tolist()} — pass each host's own split with "
-                    "replicated=False instead")
+                    "replicated=True but per-host rating data differ "
+                    f"(len/Σu/Σi/Σr signatures: {sig.tolist()}) — every "
+                    "host must load the SAME dataset, or pass each "
+                    "host's own split with replicated=False")
     if jax.process_count() > 1 and not replicated:
         from jax.experimental import multihost_utils as mhu
 
@@ -189,10 +194,8 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
         [V0[p * rps_i:(p + 1) * rps_i] for p in positions]))
 
     step = make_sharded_step(mesh, ush, ish, cfg)
-    for it in range(cfg.max_iter):
+    for _ in range(cfg.max_iter):
         U, V = step(U, V, ub, ib)
-        if callback is not None:
-            callback(it + 1, U, V)
     return U, V, upart, ipart
 
 

@@ -16,7 +16,7 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 if __name__ == "__main__":
-    if os.environ.get("MH_MODE") == "fit":
+    if os.environ.get("MH_MODE", "").startswith("fit"):
         # multi-process ALS.fit: every host fits the same replicated frame
         import numpy as np
 
@@ -24,9 +24,11 @@ if __name__ == "__main__":
         from tpu_als.io.movielens import synthetic_movielens
         from tpu_als.parallel.mesh import make_mesh
 
+        strategy = ("ring" if os.environ["MH_MODE"] == "fit_ring"
+                    else "all_gather")
         frame = synthetic_movielens(100, 40, 2500, seed=1)
         model = ALS(rank=4, maxIter=3, regParam=0.02, seed=0,
-                    mesh=make_mesh()).fit(frame)
+                    mesh=make_mesh(), gatherStrategy=strategy).fit(frame)
         if jax.process_index() == 0:
             np.savez(os.environ["MH_OUT"] + ".fit.npz",
                      U=model._U, V=model._V,

@@ -224,9 +224,15 @@ def test_two_process_cli_train(tmp_path):
     assert np.isfinite(preds).all() and len(preds) > 0
 
 
-def test_two_process_estimator_fit_matches_single_process(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("strategy", ["all_gather", "ring"])
+def test_two_process_estimator_fit_matches_single_process(tmp_path,
+                                                          strategy):
     """Multi-process ALS.fit == single-process mesh fit, exactly the same
-    partitions/init/layout — the Estimator-level multi-host contract."""
+    partitions/init/layout — the Estimator-level multi-host contract,
+    for both the all_gather and the ring (ppermute streaming) strategy."""
     import os
     import socket
     import subprocess
@@ -244,7 +250,9 @@ def test_two_process_estimator_fit_matches_single_process(tmp_path):
         env.pop("XLA_FLAGS", None)
         env.update(JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
                    JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
-                   MH_OUT=out, MH_MODE="fit")
+                   MH_OUT=out,
+                   MH_MODE="fit" if strategy == "all_gather"
+                   else "fit_ring")
         procs.append(subprocess.Popen(
             [sys.executable, worker], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -263,7 +271,7 @@ def test_two_process_estimator_fit_matches_single_process(tmp_path):
 
     frame = synthetic_movielens(100, 40, 2500, seed=1)
     ref = ALS(rank=4, maxIter=3, regParam=0.02, seed=0,
-              mesh=make_mesh(4)).fit(frame)
+              mesh=make_mesh(4), gatherStrategy=strategy).fit(frame)
     dat = np.load(out + ".fit.npz")
     np.testing.assert_array_equal(dat["uids"], ref._user_map.ids)
     np.testing.assert_array_equal(dat["iids"], ref._item_map.ids)
@@ -271,3 +279,44 @@ def test_two_process_estimator_fit_matches_single_process(tmp_path):
     # to ~1e-4 worst-case on f32
     np.testing.assert_allclose(dat["U"], ref._U, rtol=5e-4, atol=5e-4)
     np.testing.assert_allclose(dat["V"], ref._V, rtol=5e-4, atol=5e-4)
+
+
+def test_ring_local_slice_matches_full_grid(rng):
+    from tpu_als.parallel.comm import shard_csr_grid
+
+    nU, nI, nnz, D = 40, 30, 500, 8
+    u = rng.integers(0, nU, nnz)
+    i = rng.integers(0, nI, nnz)
+    r = rng.normal(size=nnz).astype(np.float32)
+    upart = partition_balanced(np.bincount(u, minlength=nU), D)
+    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
+    full = shard_csr_grid(upart, ipart, u, i, r, min_width=4)
+    loc = full.local_slice([2, 5, 7])
+    assert loc.positions == (2, 5, 7)
+    for bl, bf in zip(loc.buckets, full.buckets):
+        np.testing.assert_array_equal(bl.rows, bf.rows[[2, 5, 7]])
+        np.testing.assert_array_equal(bl.cols, bf.cols[[2, 5, 7]])
+
+
+def test_ring_grid_positions_build_matches_slice(rng):
+    # building only local owner rows (positions=) must equal slicing the
+    # full grid — the multi-host shape-agreement contract for ring
+    from tpu_als.parallel.comm import shard_csr_grid
+
+    nU, nI, nnz, D = 40, 30, 500, 8
+    u = rng.integers(0, nU, nnz)
+    i = rng.integers(0, nI, nnz)
+    r = rng.normal(size=nnz).astype(np.float32)
+    upart = partition_balanced(np.bincount(u, minlength=nU), D)
+    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
+    full = shard_csr_grid(upart, ipart, u, i, r, min_width=4)
+    for pos in ([0, 1, 2, 3], [6, 7]):
+        loc = shard_csr_grid(upart, ipart, u, i, r, min_width=4,
+                             positions=pos)
+        ref = full.local_slice(pos)
+        assert loc.positions == tuple(pos)
+        for bl, bf in zip(loc.buckets, ref.buckets):
+            np.testing.assert_array_equal(bl.rows, bf.rows)
+            np.testing.assert_array_equal(bl.cols, bf.cols)
+            np.testing.assert_array_equal(bl.vals, bf.vals)
+            np.testing.assert_array_equal(bl.mask, bf.mask)

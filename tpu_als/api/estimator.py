@@ -289,12 +289,12 @@ class ALS(_ALSParams):
                 # are re-replicated for the (driver-side) model object.
                 # Same init/partitions/layout as the single-process mesh
                 # path -> identical factors (pinned by the two-process
-                # test).  Not yet wired here: non-default gatherStrategy,
-                # checkpointing/resume, fit callbacks.
+                # test).  all_gather and ring strategies; not yet wired:
+                # all_to_all, checkpointing/resume, fit callbacks.
                 unsupported = [
                     n for n, v in (
-                        ("gatherStrategy != 'all_gather'",
-                         self.gatherStrategy != "all_gather"),
+                        ("gatherStrategy='all_to_all'",
+                         self.gatherStrategy == "all_to_all"),
                         ("checkpointDir", self.checkpointDir),
                         ("resumeFrom", self.resumeFrom),
                         ("fitCallback", self.fitCallback),
@@ -313,7 +313,8 @@ class ALS(_ALSParams):
 
                 Us, Vs, upart, ipart = train_multihost(
                     u_idx, i_idx, r, len(user_map), len(item_map), cfg,
-                    mesh=self.mesh, replicated=True)
+                    mesh=self.mesh, replicated=True,
+                    strategy=self.gatherStrategy)
                 U = gather_entity_factors(Us, upart, self.mesh)
                 V = gather_entity_factors(Vs, ipart, self.mesh)
                 return self._make_model(user_map, item_map, U, V)

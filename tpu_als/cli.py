@@ -108,11 +108,10 @@ def _train_multiprocess(args):
     from tpu_als.parallel.mesh import make_mesh
 
     pid, pcount = jax.process_index(), jax.process_count()
-    if args.gather_strategy != "all_gather":
+    if args.gather_strategy == "all_to_all":
         raise SystemExit(
-            f"--gather-strategy {args.gather_strategy} is not wired into "
-            "the multi-process path yet (all_gather only); ring/a2a "
-            "multi-process support lives at the trainer level")
+            "--gather-strategy all_to_all is not wired into the "
+            "multi-process path yet (use all_gather or ring)")
     if args.log_file:
         raise SystemExit(
             "--log-file is single-process only: the per-iteration probe "
@@ -134,7 +133,8 @@ def _train_multiprocess(args):
     als = ALS(rank=args.rank, maxIter=args.max_iter,
               regParam=args.reg_param, implicitPrefs=args.implicit,
               alpha=args.alpha, nonnegative=args.nonnegative,
-              seed=args.seed, coldStartStrategy="drop", mesh=mesh)
+              seed=args.seed, coldStartStrategy="drop", mesh=mesh,
+              gatherStrategy=args.gather_strategy)
     ctx = contextlib.nullcontext()
     if args.profile_dir:
         from tpu_als.utils.observe import trace

@@ -66,6 +66,10 @@ class RingCsr:
     rows_per_shard: int
     chunk_elems: int
     nnz: int
+    # None = full grid; a tuple = this process's mesh positions only
+    # (multi-host: blocking is replicated, placement is local — the grid
+    # exists to bound device HBM, not host memory)
+    positions: tuple = None
 
     def device_buckets(self):
         return list(self.buckets)
@@ -74,9 +78,24 @@ class RingCsr:
     def padded_nnz(self):
         return sum(b.mask.size for b in self.buckets)
 
+    def local_slice(self, positions):
+        """This process's owner rows of the grid, for
+        ``jax.make_array_from_process_local_data`` assembly (leading axis
+        ``len(positions)``, in the given order)."""
+        pos = list(positions)
+        return RingCsr(
+            buckets=[Bucket(rows=b.rows[pos], cols=b.cols[pos],
+                            vals=b.vals[pos], mask=b.mask[pos])
+                     for b in self.buckets],
+            rows_per_shard=self.rows_per_shard,
+            chunk_elems=self.chunk_elems,
+            nnz=self.nnz,
+            positions=tuple(pos),
+        )
+
 
 def shard_csr_grid(row_part, col_part, row_idx, col_idx, vals,
-                   min_width=8, chunk_elems=1 << 19):
+                   min_width=8, chunk_elems=1 << 19, positions=None):
     """Build the grid with a row space SHARED across source shards.
 
     Every source shard stores entity u's ratings at the same (bucket, row)
@@ -85,6 +104,11 @@ def shard_csr_grid(row_part, col_part, row_idx, col_idx, vals,
     are bucketed by their **max-per-source** degree (each shard's slice of
     a row pads to that bucket's width), trading some extra padding for the
     tile-coherent layout.
+
+    ``positions``: allocate and fill ONLY these owner devices' grid rows
+    (multi-host — the layout itself is still computed globally so every
+    host agrees on shapes; grid HBM/host memory drops D/len(positions)×).
+    The result equals slicing a full build at ``positions``.
     """
     D = row_part.n_shards
     S = col_part.n_shards
@@ -139,24 +163,33 @@ def shard_csr_grid(row_part, col_part, row_idx, col_idx, vals,
     e_w = widths_all[flat]
     e_pos = local_pos[flat]
 
+    local = positions is not None
+    pos_list = list(positions) if local else list(range(D))
+    L = len(pos_list)
+    # owner device id -> leading-axis index (or -1 for remote owners)
+    owner_to_li = np.full(D, -1, dtype=np.int64)
+    owner_to_li[pos_list] = np.arange(L)
+
     buckets = []
     for w, nb in zip(bucket_widths, nb_pads):
-        rows = np.full((D, nb), num_rows, dtype=np.int32)
-        for d in range(D):
+        rows = np.full((L, nb), num_rows, dtype=np.int32)
+        for li, d in enumerate(pos_list):
             sel = selections[w, d]
-            rows[d, :len(sel)] = sel
-        cols = np.zeros((D, S, nb, w), dtype=np.int32)
-        v = np.zeros((D, S, nb, w), dtype=np.float32)
-        m = np.zeros((D, S, nb, w), dtype=np.float32)
-        esel = e_w == w
-        dd, ss = e_owner[esel], e_src[esel]
+            rows[li, :len(sel)] = sel
+        cols = np.zeros((L, S, nb, w), dtype=np.int32)
+        v = np.zeros((L, S, nb, w), dtype=np.float32)
+        m = np.zeros((L, S, nb, w), dtype=np.float32)
+        esel = (e_w == w) & (owner_to_li[e_owner] >= 0)
+        dd = owner_to_li[e_owner[esel]]
+        ss = e_src[esel]
         pp, oo = e_pos[esel], off[esel]
         cols[dd, ss, pp, oo] = e_cols[esel]
         v[dd, ss, pp, oo] = e_vals[esel]
         m[dd, ss, pp, oo] = 1.0
         buckets.append(Bucket(rows=rows, cols=cols, vals=v, mask=m))
     return RingCsr(buckets=buckets, rows_per_shard=num_rows,
-                   chunk_elems=chunk_elems, nnz=n)
+                   chunk_elems=chunk_elems, nnz=n,
+                   positions=tuple(pos_list) if local else None)
 
 
 def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,

@@ -70,7 +70,8 @@ def init_distributed(coordinator_address=None, num_processes=None,
 
 
 def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
-                    min_width=8, chunk_elems=1 << 19, replicated=False):
+                    min_width=8, chunk_elems=1 << 19, replicated=False,
+                    strategy="all_gather"):
     """Multi-process ALS training: every process calls this with its OWN
     rating triples (global dense ids) — the analog of Spark executors each
     reading their input split and ``partitionRatings`` shuffling blocks to
@@ -164,19 +165,46 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
     ipart = partition_balanced(icounts, D)
     positions = local_positions(mesh)
 
-    umask = local_rating_mask(upart, u, positions=positions)
-    imask = local_rating_mask(ipart, i, positions=positions)
-    ush = shard_csr(upart, ipart, u[umask], i[umask], r[umask],
-                    min_width=min_width, chunk_elems=chunk_elems,
-                    positions=positions, row_counts=ucounts)
-    ish = shard_csr(ipart, upart, i[imask], u[imask], r[imask],
-                    min_width=min_width, chunk_elems=chunk_elems,
-                    positions=positions, row_counts=icounts)
-
     leading = NamedSharding(mesh, P(AXIS))
 
     def assemble(local):
         return jax.make_array_from_process_local_data(leading, local)
+
+    if strategy == "ring":
+        # ring exists to bound DEVICE HBM (opposite factors never
+        # materialize in full); its grid layout is computed globally
+        # (every host holds the full triples at this point) but only the
+        # local owner rows are allocated, filled, and placed
+        from tpu_als.parallel.comm import shard_csr_grid
+        from tpu_als.parallel.trainer import make_ring_step, stacked_counts
+
+        ush = shard_csr_grid(upart, ipart, u, i, r, min_width=min_width,
+                             chunk_elems=chunk_elems, positions=positions)
+        ish = shard_csr_grid(ipart, upart, i, u, r, min_width=min_width,
+                             chunk_elems=chunk_elems, positions=positions)
+        pos_only = cfg.implicit_prefs
+        extra = (
+            assemble(stacked_counts(upart, u, r,
+                                    positive_only=pos_only)[positions]),
+            assemble(stacked_counts(ipart, i, r,
+                                    positive_only=pos_only)[positions]),
+        )
+        step_factory = make_ring_step
+    elif strategy == "all_gather":
+        umask = local_rating_mask(upart, u, positions=positions)
+        imask = local_rating_mask(ipart, i, positions=positions)
+        ush = shard_csr(upart, ipart, u[umask], i[umask], r[umask],
+                        min_width=min_width, chunk_elems=chunk_elems,
+                        positions=positions, row_counts=ucounts)
+        ish = shard_csr(ipart, upart, i[imask], u[imask], r[imask],
+                        min_width=min_width, chunk_elems=chunk_elems,
+                        positions=positions, row_counts=icounts)
+        extra = ()
+        step_factory = make_sharded_step
+    else:
+        raise ValueError(
+            f"unknown strategy {strategy!r} for multi-host training "
+            "(expected 'all_gather' or 'ring')")
 
     ub = jax.tree.map(assemble, ush.device_buckets())
     ib = jax.tree.map(assemble, ish.device_buckets())
@@ -193,9 +221,9 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
     V = assemble(np.concatenate(
         [V0[p * rps_i:(p + 1) * rps_i] for p in positions]))
 
-    step = make_sharded_step(mesh, ush, ish, cfg)
+    step = step_factory(mesh, ush, ish, cfg)
     for _ in range(cfg.max_iter):
-        U, V = step(U, V, ub, ib)
+        U, V = step(U, V, ub, ib, *extra)
     return U, V, upart, ipart
 
 

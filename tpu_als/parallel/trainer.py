@@ -36,6 +36,32 @@ def _squeeze0(tree):
     return jax.tree.map(lambda x: x[0], tree)
 
 
+def _check_shard_containers(mesh, user_sharded, item_sharded):
+    """Shared guard for every step builder: host containers hold either
+    every mesh position's shard (single process) or exactly this
+    process's (multi-host, ``positions`` metadata) — anything else would
+    silently drop shards or scatter them onto the wrong devices."""
+    for side, sharded in (("user", user_sharded), ("item", item_sharded)):
+        n_shards = sharded.buckets[0].rows.shape[0]
+        positions = getattr(sharded, "positions", None)
+        if positions is not None:
+            from tpu_als.parallel.multihost import local_positions
+
+            if list(positions) != local_positions(mesh):
+                raise ValueError(
+                    f"{side} rating shards were built for mesh positions "
+                    f"{list(positions)} but this process owns "
+                    f"{local_positions(mesh)}; a mismatch would scatter "
+                    "shards onto the wrong devices"
+                )
+        elif mesh.devices.size != n_shards:
+            raise ValueError(
+                f"mesh has {mesh.devices.size} devices but the {side} "
+                f"rating shards were built for {n_shards}; a mismatch "
+                "would silently drop shards"
+            )
+
+
 def _prewarm(cfg: AlsConfig):
     """Probe the solve kernels EAGERLY in every step *builder*: a probe
     firing inside the shard_map jit trace cannot run, and the jit cache
@@ -56,27 +82,7 @@ def make_sharded_step(mesh, user_sharded, item_sharded, cfg: AlsConfig):
     Returns ``step(U, V) -> (U, V)`` on slot-space factor arrays sharded
     over ``mesh``.
     """
-    for side, sharded in (("user", user_sharded), ("item", item_sharded)):
-        n_shards = sharded.buckets[0].rows.shape[0]
-        positions = getattr(sharded, "positions", None)
-        if positions is not None:
-            # process-local container (data.shard_csr positions=): must
-            # hold exactly this process's mesh positions, in mesh order
-            from tpu_als.parallel.multihost import local_positions
-
-            if list(positions) != local_positions(mesh):
-                raise ValueError(
-                    f"{side} rating shards were built for mesh positions "
-                    f"{list(positions)} but this process owns "
-                    f"{local_positions(mesh)}; a mismatch would scatter "
-                    "shards onto the wrong devices"
-                )
-        elif mesh.devices.size != n_shards:
-            raise ValueError(
-                f"mesh has {mesh.devices.size} devices but the {side} "
-                f"rating shards were built for {n_shards}; a mismatch "
-                "would silently drop shards"
-            )
+    _check_shard_containers(mesh, user_sharded, item_sharded)
     _prewarm(cfg)
     per_u = user_sharded.rows_per_shard
     per_i = item_sharded.rows_per_shard
@@ -123,10 +129,7 @@ def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig):
     from tpu_als.parallel.comm import ring_half_step
 
     D = mesh.devices.size
-    if user_ring.buckets[0].rows.shape[0] != D:
-        raise ValueError(
-            f"mesh has {D} devices but the ring grid was built for "
-            f"{user_ring.buckets[0].rows.shape[0]}")
+    _check_shard_containers(mesh, user_ring, item_ring)
     per_u = user_ring.rows_per_shard
     per_i = item_ring.rows_per_shard
     u_chunk = user_ring.chunk_elems
@@ -167,10 +170,7 @@ def make_a2a_step(mesh, user_a2a, item_a2a, cfg: AlsConfig):
     from tpu_als.parallel.a2a import a2a_half_step
 
     D = mesh.devices.size
-    if user_a2a.buckets[0].rows.shape[0] != D:
-        raise ValueError(
-            f"mesh has {D} devices but the exchange plan was built for "
-            f"{user_a2a.buckets[0].rows.shape[0]}")
+    _check_shard_containers(mesh, user_a2a, item_a2a)
     per_u = user_a2a.rows_per_shard
     per_i = item_a2a.rows_per_shard
     u_chunk = user_a2a.chunk_elems

@@ -24,9 +24,21 @@ if __name__ == "__main__":
         from tpu_als.io.movielens import synthetic_movielens
         from tpu_als.parallel.mesh import make_mesh
 
-        strategy = ("ring" if os.environ["MH_MODE"] == "fit_ring"
-                    else "all_gather")
-        frame = synthetic_movielens(100, 40, 2500, seed=1)
+        strategy = {"fit": "all_gather", "fit_ring": "ring",
+                    "fit_a2a": "all_to_all"}[os.environ["MH_MODE"]]
+        if strategy == "all_to_all":
+            # banded-sparse layout: each user rates a private 4-item
+            # block, so the exchange plan is NON-degenerate at D=4
+            # (a dense frame would silently fall back to all_gather and
+            # test nothing)
+            from tpu_als.utils.frame import ColumnarFrame
+
+            uu = np.repeat(np.arange(32), 4)
+            ii = (np.arange(128) * 2) % 256
+            rr = (1.0 + (np.arange(128) % 4)).astype(np.float32)
+            frame = ColumnarFrame({"user": uu, "item": ii, "rating": rr})
+        else:
+            frame = synthetic_movielens(100, 40, 2500, seed=1)
         model = ALS(rank=4, maxIter=3, regParam=0.02, seed=0,
                     mesh=make_mesh(), gatherStrategy=strategy).fit(frame)
         if jax.process_index() == 0:

@@ -174,3 +174,27 @@ def test_estimator_falls_back_on_degenerate_plan(rng):
         np.asarray(m_a2a.transform(frame)["prediction"]),
         np.asarray(m_ag.transform(frame)["prediction"]),
         rtol=2e-3, atol=2e-3)
+
+
+def test_a2a_positions_build_matches_slice(rng):
+    # multi-host contract: building only local source rows (positions=)
+    # must equal slicing a full build
+    nU = nI = 64
+    D = 8
+    u = np.repeat(np.arange(nU), 8)
+    i = (np.tile(np.arange(8), nU) + (u // 8) * 8) % nI
+    r = np.ones(len(u), np.float32)
+    upart = partition_balanced(np.bincount(u, minlength=nU), D)
+    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
+    full = build_a2a(upart, ipart, u, i, r, min_width=4)
+    for pos in ([0, 1, 2, 3], [5, 7]):
+        loc = build_a2a(upart, ipart, u, i, r, min_width=4, positions=pos)
+        ref = full.local_slice(pos)
+        assert loc.positions == tuple(pos)
+        assert loc.request_budget == full.request_budget
+        np.testing.assert_array_equal(loc.send_idx, ref.send_idx)
+        for bl, bf in zip(loc.buckets, ref.buckets):
+            np.testing.assert_array_equal(bl.rows, bf.rows)
+            np.testing.assert_array_equal(bl.cols, bf.cols)
+            np.testing.assert_array_equal(bl.vals, bf.vals)
+            np.testing.assert_array_equal(bl.mask, bf.mask)

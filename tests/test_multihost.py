@@ -227,7 +227,8 @@ def test_two_process_cli_train(tmp_path):
 import pytest
 
 
-@pytest.mark.parametrize("strategy", ["all_gather", "ring"])
+@pytest.mark.parametrize("strategy", ["all_gather", "ring",
+                                      "all_to_all"])
 def test_two_process_estimator_fit_matches_single_process(tmp_path,
                                                           strategy):
     """Multi-process ALS.fit == single-process mesh fit, exactly the same
@@ -251,8 +252,8 @@ def test_two_process_estimator_fit_matches_single_process(tmp_path,
         env.update(JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
                    JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
                    MH_OUT=out,
-                   MH_MODE="fit" if strategy == "all_gather"
-                   else "fit_ring")
+                   MH_MODE={"all_gather": "fit", "ring": "fit_ring",
+                            "all_to_all": "fit_a2a"}[strategy])
         procs.append(subprocess.Popen(
             [sys.executable, worker], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -269,7 +270,26 @@ def test_two_process_estimator_fit_matches_single_process(tmp_path,
     from tpu_als.io.movielens import synthetic_movielens
     from tpu_als.parallel.mesh import make_mesh
 
-    frame = synthetic_movielens(100, 40, 2500, seed=1)
+    if strategy == "all_to_all":
+        from tpu_als.parallel.a2a import build_a2a
+        from tpu_als.utils.frame import ColumnarFrame
+
+        uu = np.repeat(np.arange(32), 4)
+        ii = (np.arange(128) * 2) % 256
+        rr = (1.0 + (np.arange(128) % 4)).astype(np.float32)
+        frame = ColumnarFrame({"user": uu, "item": ii, "rating": rr})
+        # the layout must actually exercise a2a: a degenerate plan would
+        # silently fall back to all_gather and this test would be vacuous
+        from tpu_als.core.ratings import remap_ids
+
+        ud, _ = remap_ids(uu)
+        id_, _ = remap_ids(ii)
+        up = partition_balanced(np.bincount(ud), 4)
+        ip = partition_balanced(np.bincount(id_), 4)
+        assert not build_a2a(up, ip, ud, id_, rr,
+                             on_degenerate="stub").degenerate
+    else:
+        frame = synthetic_movielens(100, 40, 2500, seed=1)
     ref = ALS(rank=4, maxIter=3, regParam=0.02, seed=0,
               mesh=make_mesh(4), gatherStrategy=strategy).fit(frame)
     dat = np.load(out + ".fit.npz")

@@ -289,12 +289,10 @@ class ALS(_ALSParams):
                 # are re-replicated for the (driver-side) model object.
                 # Same init/partitions/layout as the single-process mesh
                 # path -> identical factors (pinned by the two-process
-                # test).  all_gather and ring strategies; not yet wired:
-                # all_to_all, checkpointing/resume, fit callbacks.
+                # test).  All three gather strategies; not yet wired:
+                # checkpointing/resume, fit callbacks.
                 unsupported = [
                     n for n, v in (
-                        ("gatherStrategy='all_to_all'",
-                         self.gatherStrategy == "all_to_all"),
                         ("checkpointDir", self.checkpointDir),
                         ("resumeFrom", self.resumeFrom),
                         ("fitCallback", self.fitCallback),

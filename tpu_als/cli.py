@@ -108,10 +108,6 @@ def _train_multiprocess(args):
     from tpu_als.parallel.mesh import make_mesh
 
     pid, pcount = jax.process_index(), jax.process_count()
-    if args.gather_strategy == "all_to_all":
-        raise SystemExit(
-            "--gather-strategy all_to_all is not wired into the "
-            "multi-process path yet (use all_gather or ring)")
     if args.log_file:
         raise SystemExit(
             "--log-file is single-process only: the per-iteration probe "

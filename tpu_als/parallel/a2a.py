@@ -61,13 +61,35 @@ class A2aCsr:
     # and escape that degeneration
     padding_ratio: float = 1.0  # D²·R / true request-list entries
     degenerate: bool = False    # True when exchanged rows >= all_gather's
+    # None = full build; a tuple = this process's mesh positions only
+    positions: tuple = None
 
     def device_buckets(self):
         return list(self.buckets)
 
+    def local_slice(self, positions):
+        """This process's source rows of the shards + send tables, for
+        ``jax.make_array_from_process_local_data`` assembly (the exchange
+        plan itself is computed globally — every host agrees on R and the
+        recv-table layout)."""
+        import dataclasses
+
+        from tpu_als.core.ratings import Bucket
+
+        pos = list(positions)
+        return dataclasses.replace(
+            self,
+            buckets=[Bucket(rows=b.rows[pos], cols=b.cols[pos],
+                            vals=b.vals[pos], mask=b.mask[pos])
+                     for b in self.buckets],
+            send_idx=self.send_idx[pos],
+            positions=tuple(pos),
+        )
+
 
 def build_a2a(row_part, col_part, row_idx, col_idx, vals,
-              min_width=8, chunk_elems=1 << 19, on_degenerate="build"):
+              min_width=8, chunk_elems=1 << 19, on_degenerate="build",
+              positions=None):
     """Build rating shards with compact column ids + the exchange plan.
 
     row_part/col_part: Partition for the solved side / the gathered side
@@ -82,6 +104,11 @@ def build_a2a(row_part, col_part, row_idx, col_idx, vals,
     terabyte-class host allocation — the caller must check the flag and
     fall back before anything that size is materialized); a stub plan is
     not trainable.
+
+    ``positions``: allocate and fill ONLY these mesh positions' source
+    rows of the shards and send tables (multi-host; the exchange plan —
+    R, recv layout, degeneration — is still computed globally so every
+    host agrees).  Equals slicing a full build at ``positions``.
     """
     D = row_part.n_shards
     if col_part.n_shards != D:
@@ -136,23 +163,45 @@ def build_a2a(row_part, col_part, row_idx, col_idx, vals,
                 padding_ratio=padding_ratio, degenerate=True,
             )
 
-    send_idx = np.zeros((D, D, R), dtype=np.int32)
+    local = positions is not None
+    pos_list = list(positions) if local else list(range(D))
+    L = len(pos_list)
+    pos_of = np.full(D, -1, dtype=np.int64)
+    pos_of[pos_list] = np.arange(L)
+
     dst = grp // D
     src = grp % D
-    send_idx[src, dst, pos] = loc
+    send_idx = np.zeros((L, D, R), dtype=np.int32)
+    ssel = pos_of[src] >= 0
+    send_idx[pos_of[src[ssel]], dst[ssel], pos[ssel]] = loc[ssel]
 
     # compact col id per rating: src_shard * R + request position
     compact = (owner_c.astype(np.int64) * R + pos[inv]).astype(np.int64)
 
     shards = []
-    for d in range(D):
+    for d in pos_list:
         sel = owner_r == d
         shards.append(build_csr_buckets(
             local_r[sel], compact[sel], vals[sel],
             num_rows=row_part.rows_per_shard,
             min_width=min_width, chunk_elems=chunk_elems,
         ))
-    stacked = stack_shards(shards, chunk_elems)
+    # globally-agreed layout: counts per (device, local row) slot feed the
+    # same arithmetic stack_shards would derive from a full build, so a
+    # positions build matches a slice of the full one exactly
+    from tpu_als.parallel.data import Partition, shard_layout
+
+    rps_row = row_part.rows_per_shard
+    flat_counts = np.bincount(
+        owner_r.astype(np.int64) * rps_row + local_r,
+        minlength=D * rps_row)
+    slot_part = Partition(
+        owner=np.repeat(np.arange(D, dtype=np.int32), rps_row),
+        local=np.tile(np.arange(rps_row, dtype=np.int32), D),
+        rows_per_shard=rps_row, n_shards=D)
+    layout = shard_layout(slot_part, flat_counts, min_width, chunk_elems)
+    stacked = stack_shards(shards, chunk_elems, layout=layout,
+                           positions=tuple(pos_list) if local else None)
     return A2aCsr(
         buckets=stacked.buckets,
         send_idx=send_idx,
@@ -162,6 +211,7 @@ def build_a2a(row_part, col_part, row_idx, col_idx, vals,
         nnz=len(row_idx),
         padding_ratio=padding_ratio,
         degenerate=degenerate,
+        positions=tuple(pos_list) if local else None,
     )
 
 

@@ -201,10 +201,31 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
                         positions=positions, row_counts=icounts)
         extra = ()
         step_factory = make_sharded_step
+    elif strategy == "all_to_all":
+        # exchange plan computed globally (full triples are present),
+        # only the local source rows placed; degenerate plans (one hot
+        # (src, dst) pair pushing the uniform budget past all_gather
+        # bytes) fall back to all_gather, same as single-process fit
+        from tpu_als.parallel.a2a import build_a2a
+        from tpu_als.parallel.trainer import make_a2a_step
+
+        ush = build_a2a(upart, ipart, u, i, r, min_width=min_width,
+                        chunk_elems=chunk_elems, on_degenerate="stub",
+                        positions=positions)
+        ish = build_a2a(ipart, upart, i, u, r, min_width=min_width,
+                        chunk_elems=chunk_elems, on_degenerate="stub",
+                        positions=positions)
+        if ush.degenerate or ish.degenerate:
+            return train_multihost(
+                u, i, r, num_users, num_items, cfg, mesh=mesh,
+                min_width=min_width, chunk_elems=chunk_elems,
+                replicated=True, strategy="all_gather")
+        extra = (assemble(ush.send_idx), assemble(ish.send_idx))
+        step_factory = make_a2a_step
     else:
         raise ValueError(
             f"unknown strategy {strategy!r} for multi-host training "
-            "(expected 'all_gather' or 'ring')")
+            "(expected 'all_gather', 'ring' or 'all_to_all')")
 
     ub = jax.tree.map(assemble, ush.device_buckets())
     ib = jax.tree.map(assemble, ish.device_buckets())

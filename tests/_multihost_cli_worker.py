@@ -16,7 +16,30 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 if __name__ == "__main__":
-    if os.environ.get("MH_MODE", "").startswith("fit"):
+    if os.environ.get("MH_MODE") == "fit_ckpt":
+        # multi-process checkpoint -> resume == uninterrupted run
+        import numpy as np
+
+        from tpu_als import ALS
+        from tpu_als.io.movielens import synthetic_movielens
+        from tpu_als.parallel.mesh import make_mesh
+
+        frame = synthetic_movielens(80, 30, 1500, seed=2)
+        ckdir = os.environ["MH_OUT"] + ".ckpt"
+        ALS(rank=3, maxIter=2, regParam=0.02, seed=0, mesh=make_mesh(),
+            checkpointDir=ckdir, checkpointInterval=2).fit(frame)
+        resumed = ALS(rank=3, maxIter=4, regParam=0.02, seed=0,
+                      mesh=make_mesh(),
+                      resumeFrom=os.path.join(ckdir, "als_checkpoint"),
+                      ).fit(frame)
+        straight = ALS(rank=3, maxIter=4, regParam=0.02, seed=0,
+                       mesh=make_mesh()).fit(frame)
+        if jax.process_index() == 0:
+            np.savez(os.environ["MH_OUT"] + ".ckpt.npz",
+                     Ur=resumed._U, Vr=resumed._V,
+                     Us=straight._U, Vs=straight._V)
+        print("ckpt worker done", flush=True)
+    elif os.environ.get("MH_MODE", "").startswith("fit"):
         # multi-process ALS.fit: every host fits the same replicated frame
         import numpy as np
 

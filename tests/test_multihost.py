@@ -86,6 +86,41 @@ def test_positions_without_counts_rejected(rng):
         shard_csr(upart, ipart, u, i, r, positions=[0])
 
 
+def _spawn_two_procs(worker, env_extra, timeout=300):
+    """Spawn two rendezvousing worker processes; return their outputs.
+    Kills survivors on failure (a crashed peer leaves the other blocked
+    in distributed init forever)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:  # free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update(JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
+                   **env_extra)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            text, _ = p.communicate(timeout=timeout)
+            outs.append(text)
+            assert p.returncode == 0, text[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
 def test_two_process_sharded_step_matches_single_process(tmp_path):
     """REAL multi-process run: 2 spawned processes x 2 CPU devices, gloo
     collectives over a 4-device global mesh, per-host blocking — the
@@ -104,30 +139,9 @@ def test_two_process_sharded_step_matches_single_process(tmp_path):
     from tpu_als.parallel.mesh import AXIS
     from tpu_als.parallel.trainer import make_sharded_step
 
-    with socket.socket() as s:  # free port for the coordinator
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
     out = str(tmp_path / "mh")
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)
-        env.update(JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
-                   MH_OUT=out)
-        procs.append(subprocess.Popen(
-            [sys.executable, worker], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    try:
-        for p in procs:
-            out_text, _ = p.communicate(timeout=300)
-            assert p.returncode == 0, out_text[-2000:]
-    finally:  # a failed worker must not orphan its peer (blocked in
-        # distributed init waiting for the rendezvous)
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    _spawn_two_procs(worker, {"MH_OUT": out})
 
     # single-process reference: same data, all 4 shards on 4 local devices
     rng = np.random.default_rng(7)
@@ -181,32 +195,10 @@ def test_two_process_cli_train(tmp_path):
     import subprocess
     import sys
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     worker = os.path.join(os.path.dirname(__file__),
                           "_multihost_cli_worker.py")
     out_dir = str(tmp_path / "model")
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)
-        env.update(JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
-                   MH_OUT=out_dir)
-        procs.append(subprocess.Popen(
-            [sys.executable, worker], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = []
-    try:
-        for p in procs:
-            text, _ = p.communicate(timeout=300)
-            outs.append(text)
-            assert p.returncode == 0, text[-2000:]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    outs = _spawn_two_procs(worker, {"MH_OUT": out_dir})
     import json as _json
 
     rmse_lines = [ln for text in outs for ln in text.splitlines()
@@ -239,32 +231,13 @@ def test_two_process_estimator_fit_matches_single_process(tmp_path,
     import subprocess
     import sys
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     worker = os.path.join(os.path.dirname(__file__),
                           "_multihost_cli_worker.py")
     out = str(tmp_path / "fitout")
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)
-        env.update(JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
-                   MH_OUT=out,
-                   MH_MODE={"all_gather": "fit", "ring": "fit_ring",
-                            "all_to_all": "fit_a2a"}[strategy])
-        procs.append(subprocess.Popen(
-            [sys.executable, worker], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    try:
-        for p in procs:
-            text, _ = p.communicate(timeout=300)
-            assert p.returncode == 0, text[-2000:]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    _spawn_two_procs(worker, {
+        "MH_OUT": out,
+        "MH_MODE": {"all_gather": "fit", "ring": "fit_ring",
+                    "all_to_all": "fit_a2a"}[strategy]})
 
     from tpu_als import ALS
     from tpu_als.io.movielens import synthetic_movielens
@@ -340,3 +313,20 @@ def test_ring_grid_positions_build_matches_slice(rng):
             np.testing.assert_array_equal(bl.cols, bf.cols)
             np.testing.assert_array_equal(bl.vals, bf.vals)
             np.testing.assert_array_equal(bl.mask, bf.mask)
+
+
+def test_two_process_checkpoint_resume(tmp_path):
+    """Multi-process fit writes checkpoints (collective gather, process-0
+    write) and a resumed run reproduces the uninterrupted one."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_cli_worker.py")
+    out = str(tmp_path / "ck")
+    _spawn_two_procs(worker, {"MH_OUT": out, "MH_MODE": "fit_ckpt"})
+    dat = np.load(out + ".ckpt.npz")
+    np.testing.assert_allclose(dat["Ur"], dat["Us"], rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(dat["Vr"], dat["Vs"], rtol=5e-4, atol=5e-4)

@@ -289,19 +289,17 @@ class ALS(_ALSParams):
                 # are re-replicated for the (driver-side) model object.
                 # Same init/partitions/layout as the single-process mesh
                 # path -> identical factors (pinned by the two-process
-                # test).  All three gather strategies; not yet wired:
-                # checkpointing/resume, fit callbacks.
-                unsupported = [
-                    n for n, v in (
-                        ("checkpointDir", self.checkpointDir),
-                        ("resumeFrom", self.resumeFrom),
-                        ("fitCallback", self.fitCallback),
-                    ) if v
-                ]
-                if unsupported:
+                # test).  All three gather strategies + checkpoint/resume
+                # (the checkpoint gather is collective, the write is
+                # process-0-only; resume reads the shared-FS checkpoint on
+                # every host — same files serve both).  Not wired:
+                # fitCallback (entity-space callbacks would force a
+                # cross-host gather every iteration).
+                if self.fitCallback:
                     raise NotImplementedError(
-                        f"multi-process fit does not support "
-                        f"{', '.join(unsupported)} yet; use "
+                        "multi-process fit does not support fitCallback "
+                        "(an entity-space callback costs a cross-host "
+                        "factor gather per iteration); use "
                         "tpu_als.parallel.multihost.train_multihost "
                         "directly for custom multi-host loops")
                 from tpu_als.parallel.multihost import (
@@ -309,12 +307,32 @@ class ALS(_ALSParams):
                     train_multihost,
                 )
 
+                mp_cb = None
+                last_gather = {}  # iteration -> (Ue, Ve); reused below so
+                # a final-iteration checkpoint doesn't repeat the most
+                # expensive end-of-training collective
+                interval = self.getCheckpointInterval()
+                if self.checkpointDir is not None and interval >= 1:
+                    def mp_cb(iteration, Us, Vs, up, ip):
+                        if iteration % interval:
+                            return
+                        Ue = gather_entity_factors(Us, up, self.mesh)
+                        Ve = gather_entity_factors(Vs, ip, self.mesh)
+                        last_gather.clear()
+                        last_gather[iteration] = (Ue, Ve)
+                        if jax.process_index() == 0:
+                            callback(iteration, Ue, Ve)
+
                 Us, Vs, upart, ipart = train_multihost(
                     u_idx, i_idx, r, len(user_map), len(item_map), cfg,
                     mesh=self.mesh, replicated=True,
-                    strategy=self.gatherStrategy)
-                U = gather_entity_factors(Us, upart, self.mesh)
-                V = gather_entity_factors(Vs, ipart, self.mesh)
+                    strategy=self.gatherStrategy,
+                    init=init, start_iter=start_iter, callback=mp_cb)
+                if cfg.max_iter in last_gather:
+                    U, V = last_gather[cfg.max_iter]
+                else:
+                    U = gather_entity_factors(Us, upart, self.mesh)
+                    V = gather_entity_factors(Vs, ipart, self.mesh)
                 return self._make_model(user_map, item_map, U, V)
             D = self.mesh.devices.size
             upart = partition_balanced(

@@ -71,7 +71,8 @@ def init_distributed(coordinator_address=None, num_processes=None,
 
 def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
                     min_width=8, chunk_elems=1 << 19, replicated=False,
-                    strategy="all_gather"):
+                    strategy="all_gather", init=None, start_iter=0,
+                    callback=None):
     """Multi-process ALS training: every process calls this with its OWN
     rating triples (global dense ids) — the analog of Spark executors each
     reading their input split and ``partitionRatings`` shuffling blocks to
@@ -219,7 +220,8 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
             return train_multihost(
                 u, i, r, num_users, num_items, cfg, mesh=mesh,
                 min_width=min_width, chunk_elems=chunk_elems,
-                replicated=True, strategy="all_gather")
+                replicated=True, strategy="all_gather",
+                init=init, start_iter=start_iter, callback=callback)
         extra = (assemble(ush.send_idx), assemble(ish.send_idx))
         step_factory = make_a2a_step
     else:
@@ -230,12 +232,17 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
     ub = jax.tree.map(assemble, ush.device_buckets())
     ib = jax.tree.map(assemble, ish.device_buckets())
 
-    key = jax.random.PRNGKey(cfg.seed)
-    ku, kv = jax.random.split(key)
     U0 = np.zeros((upart.padded_rows, cfg.rank), np.float32)
-    U0[upart.slot] = np.asarray(init_factors(ku, num_users, cfg.rank))
     V0 = np.zeros((ipart.padded_rows, cfg.rank), np.float32)
-    V0[ipart.slot] = np.asarray(init_factors(kv, num_items, cfg.rank))
+    if init is not None:
+        # entity-space warm start (checkpoint resume): scatter to slots
+        U0[upart.slot] = np.asarray(init[0], dtype=np.float32)
+        V0[ipart.slot] = np.asarray(init[1], dtype=np.float32)
+    else:
+        key = jax.random.PRNGKey(cfg.seed)
+        ku, kv = jax.random.split(key)
+        U0[upart.slot] = np.asarray(init_factors(ku, num_users, cfg.rank))
+        V0[ipart.slot] = np.asarray(init_factors(kv, num_items, cfg.rank))
     rps_u, rps_i = upart.rows_per_shard, ipart.rows_per_shard
     U = assemble(np.concatenate(
         [U0[p * rps_u:(p + 1) * rps_u] for p in positions]))
@@ -243,8 +250,14 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
         [V0[p * rps_i:(p + 1) * rps_i] for p in positions]))
 
     step = step_factory(mesh, ush, ish, cfg)
-    for _ in range(cfg.max_iter):
+    for it in range(start_iter, cfg.max_iter):
         U, V = step(U, V, ub, ib, *extra)
+        if callback is not None:
+            # slot-space global arrays + the partitions to unscatter them;
+            # collective work inside the callback (e.g. a
+            # gather_entity_factors for checkpointing) must run on EVERY
+            # process
+            callback(it + 1, U, V, upart, ipart)
     return U, V, upart, ipart
 
 

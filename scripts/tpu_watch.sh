@@ -1,0 +1,32 @@
+#!/bin/bash
+# Opportunistic TPU capture (VERDICT r2 #1): probe the tunnel on a loop and
+# fire scripts/sweep_tpu.sh the FIRST time it comes up, instead of leaving
+# measurement to the end-of-round window (which missed two rounds running).
+# Every attempt is dated and logged so the round has evidence of bounded
+# tries even if the tunnel never recovers.
+#
+#   bash scripts/tpu_watch.sh [max_attempts] [sleep_seconds]
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p sweep_logs
+MAX=${1:-60}
+NAP=${2:-540}
+LOG=sweep_logs/watch.log
+
+for attempt in $(seq 1 "$MAX"); do
+  echo "$(date -Is) attempt $attempt/$MAX: probing tunnel" >>"$LOG"
+  timeout 120 python -c \
+    "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d" \
+    >/dev/null 2>&1
+  rc=$?
+  echo "$(date -Is) attempt $attempt: probe rc=$rc" >>"$LOG"
+  if [ "$rc" -eq 0 ]; then
+    echo "$(date -Is) tunnel UP — starting sweep" >>"$LOG"
+    bash scripts/sweep_tpu.sh >>"$LOG" 2>&1
+    echo "$(date -Is) sweep finished" >>"$LOG"
+    exit 0
+  fi
+  sleep "$NAP"
+done
+echo "$(date -Is) giving up after $MAX attempts" >>"$LOG"
+exit 1

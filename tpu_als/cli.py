@@ -43,10 +43,9 @@ def cmd_train(args):
     from tpu_als import ALS, RegressionEvaluator
     from tpu_als.utils.observe import IterationLogger
 
-    frame = _load_data(args.data)
-    train, test = frame.randomSplit([1 - args.holdout, args.holdout],
-                                    seed=args.seed)
-    logger = IterationLogger(path=args.log_file) if args.log_file else None
+    # resolve the multi-process branch BEFORE loading data: every pod host
+    # runs this same command, and _train_multiprocess does its own load —
+    # loading here first would double the host I/O and peak memory
     mesh = None
     if args.devices != 1:
         import jax
@@ -63,6 +62,10 @@ def cmd_train(args):
                 f"--devices {args.devices} but only {visible} visible; "
                 "refusing to silently train on fewer devices")
         mesh = make_mesh(None if args.devices == 0 else args.devices)
+    frame = _load_data(args.data)
+    train, test = frame.randomSplit([1 - args.holdout, args.holdout],
+                                    seed=args.seed)
+    logger = IterationLogger(path=args.log_file) if args.log_file else None
     als = ALS(rank=args.rank, maxIter=args.max_iter, regParam=args.reg_param,
               implicitPrefs=args.implicit, alpha=args.alpha,
               nonnegative=args.nonnegative, seed=args.seed,

@@ -260,15 +260,13 @@ def available(rank=128):
                 ok = np.allclose(np.asarray(x), np.asarray(ref), atol=1e-3,
                                  rtol=1e-2)
             except Exception as e:
-                from tpu_als.utils.platform import _TRANSIENT_MARKERS
+                from tpu_als.utils.platform import classify_probe_error
 
-                msg = f"{type(e).__name__}: {e}"
-                if any(m in msg for m in _TRANSIENT_MARKERS):
-                    raise  # let probe_kernel's transient retry handle it
-                if ("Tracer" in type(e).__name__
-                        or "ConcretizationTypeError" in type(e).__name__):
-                    raise  # probe-inside-trace: probe_kernel degrades
-                    # WITHOUT caching instead of pinning False
+                if classify_probe_error(e) != "kernel":
+                    # transient tunnel drop -> probe_kernel's retry;
+                    # tracer leak -> probe_kernel degrades WITHOUT
+                    # caching instead of pinning False
+                    raise
                 ok = False
             if ok:
                 _PANEL[r_pad] = p

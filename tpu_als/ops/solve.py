@@ -156,9 +156,20 @@ def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
         raise ValueError(f"unknown solve backend {backend!r} "
                          "(expected 'auto', 'lanes', 'pallas' or 'xla')")
     if backend == "lanes":
-        from tpu_als.ops.pallas_lanes import selected_panel, spd_solve_lanes
+        from tpu_als.ops import pallas_lanes
 
-        return spd_solve_lanes(A, b, panel=selected_panel(r))
+        # forced-lanes path: validate the panel width on this Mosaic first
+        # (cached per process; free after an eager prewarm).  Without this,
+        # selected_panel(r) returns DEFAULT_PANEL when available() never
+        # ran, and the panel=8 fused trailing update's extra [panel, r,
+        # LANES] scratch could hit a VMEM/Mosaic failure the auto path's
+        # probe-and-fallback would have avoided (ADVICE r2).  When the
+        # probe could NOT validate a width (off-TPU, probe failure, or
+        # probe-inside-trace degrade), run the rank-1 recurrence (panel=1)
+        # — never an unvalidated fused update.
+        panel = (pallas_lanes.selected_panel(r)
+                 if pallas_lanes.available(r) else 1)
+        return pallas_lanes.spd_solve_lanes(A, b, panel=panel)
     if backend == "pallas":
         from tpu_als.ops.pallas_solve import spd_solve_pallas
 

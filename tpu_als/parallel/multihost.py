@@ -22,9 +22,13 @@ Scope: three multi-process entry tiers, all exercised by REAL spawned
 two-process gloo tests in ``tests/test_multihost.py``:
 
 1. ``ALS(mesh=...).fit(frame)`` — every host fits the same replicated
-   frame; factors match the single-process mesh fit exactly (same
-   partitions/init/layout).  Not yet wired there: non-default
-   gatherStrategy, checkpoint/resume, fit callbacks.
+   frame (``dataMode='replicated'``, the default) or its own disjoint
+   split (``dataMode='per_host'``: id maps are agreed via
+   :func:`global_id_union`, triples exchanged inside
+   :func:`train_multihost`); factors match the single-process mesh fit
+   exactly (same partitions/init/layout).  All runtime knobs are wired:
+   gatherStrategy, checkpoint/resume, and ``fitCallback`` (entity-space
+   gather every ``fitCallbackInterval`` iterations, invoked on process 0).
 2. ``tpu_als.cli train`` — same convention, plus holdout eval and model
    save on process 0.
 3. :func:`train_multihost` — per-host rating splits (redistributed or
@@ -56,6 +60,11 @@ def init_distributed(coordinator_address=None, num_processes=None,
     """
     coordinator_address = (coordinator_address
                            or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if coordinator_address and _already_initialized():
+        # idempotent: a launcher (or test worker) may have rendezvoused
+        # before handing control to code that also calls this — a second
+        # jax.distributed.initialize would raise (the backend is up)
+        coordinator_address = None
     if coordinator_address:
         kw = {"coordinator_address": coordinator_address}
         num_processes = num_processes or os.environ.get("JAX_NUM_PROCESSES")
@@ -67,6 +76,39 @@ def init_distributed(coordinator_address=None, num_processes=None,
             kw["process_id"] = int(process_id)
         jax.distributed.initialize(**kw)
     return jax.process_index(), jax.process_count()
+
+
+def _ragged_allgather(arr, fill=0):
+    """Concatenate every process's 1-D array (ragged lengths allowed).
+
+    The shared collective idiom of this module: lengths are agreed first,
+    locals are padded to the max, one ``process_allgather`` moves the
+    data, padding is dropped.  O(P · max_len) host memory.
+    """
+    from jax.experimental import multihost_utils as mhu
+
+    arr = np.asarray(arr)
+    lens = np.asarray(mhu.process_allgather(
+        np.array([len(arr)], dtype=np.int64))).ravel()
+    pad = int(lens.max())
+    buf = np.full(pad, fill, dtype=arr.dtype)
+    buf[: len(arr)] = arr
+    g = np.asarray(mhu.process_allgather(buf))
+    keep = np.arange(pad)[None, :] < lens[:, None]
+    return g[keep]
+
+
+def _already_initialized():
+    """True when this process has an active jax.distributed client."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:  # fallback for jax versions without the public probe
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:
+        return False
 
 
 def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
@@ -144,20 +186,24 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
     if jax.process_count() > 1 and not replicated:
         from jax.experimental import multihost_utils as mhu
 
-        n_local = np.array([len(u)], dtype=np.int64)
-        lens = np.asarray(mhu.process_allgather(n_local)).ravel()
-        pad = int(lens.max())
-
-        def _pad(x, fill):
-            out = np.full(pad, fill, dtype=x.dtype)
-            out[: len(x)] = x
-            return out
-
-        gu = np.asarray(mhu.process_allgather(_pad(u, 0)))
-        gi = np.asarray(mhu.process_allgather(_pad(i, 0)))
-        gr = np.asarray(mhu.process_allgather(_pad(r, 0.0)))
-        keep = np.arange(pad)[None, :] < lens[:, None]
-        u, i, r = gu[keep], gi[keep], gr[keep]
+        # catch the duplicated-load mistake BEFORE the exchange doubles
+        # every rating: per-host splits with identical content signatures
+        # mean every host read the SAME file (replicated=False would then
+        # train on P copies of each rating — effective regularization
+        # silently divided by P)
+        sig = np.asarray(mhu.process_allgather(np.array(
+            [len(u), int(u.sum()), int(i.sum()),
+             np.float64(r.astype(np.float64).sum()).view(np.int64)],
+            dtype=np.int64)))
+        if len(u) and (sig == sig[0]).all():
+            raise ValueError(
+                "replicated=False but every process passed IDENTICAL "
+                "rating triples — each host must pass its OWN disjoint "
+                "split (per-host input files), or pass replicated=True "
+                "for a shared load")
+        u = _ragged_allgather(u)
+        i = _ragged_allgather(i)
+        r = _ragged_allgather(r)
 
     D = mesh.devices.size
     ucounts = np.bincount(u, minlength=num_users)
@@ -259,6 +305,24 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
             # process
             callback(it + 1, U, V, upart, ipart)
     return U, V, upart, ipart
+
+
+def global_id_union(local_ids):
+    """Sorted union of every process's id set — the agreed entity space of
+    a per-host-split fit (``ALS(dataMode='per_host')``).
+
+    The reference analog is ``partitionRatings`` seeing the global id space
+    through the shuffle (SURVEY.md §3.1); here each host contributes only
+    its O(local unique) ids, so no host materializes the remote *ratings*
+    to agree on the *entities*.  Deterministic (sorted) on every host, so
+    the resulting ``IdMap`` — and everything downstream: partitions,
+    layouts, init — is identical across processes.  Single-process: plain
+    ``np.unique``.
+    """
+    uniq = np.unique(np.asarray(local_ids))
+    if jax.process_count() == 1:
+        return uniq
+    return np.unique(_ragged_allgather(uniq.astype(np.int64)))
 
 
 def gather_entity_factors(arr, part, mesh):

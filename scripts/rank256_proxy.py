@@ -1,0 +1,144 @@
+"""Single-chip rank-256 throughput proxy — BASELINE row 3 (config 3).
+
+Config 3 is Amazon-2023 (~570M ratings, rank 256) on a v5e-32 mesh; the
+mesh is not available here, so this measures the per-core slice: a
+synthetic problem sized to ONE v5e core at the production rank (nnz and
+entity counts scaled to 1/32 of the full set, rank kept at 256).  What it
+establishes on real hardware:
+
+- the rank-256 solve path (``pallas_solve`` — the lanes kernel caps at
+  rank 128, so config 3 rides the blocked kernel): probe outcome and
+  resolved dispatch are printed;
+- seconds/iteration for the full half-step pipeline at rank 256;
+- peak HBM via ``device.memory_stats()`` — the model the CPU-mesh tests
+  (tests/test_rank256.py) verify shape-by-shape, priced on chip.
+
+Prints ONE JSON line (same contract as bench.py).  Queued in
+scripts/sweep_tpu.sh so the tunnel watcher captures it opportunistically.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--users", type=int, default=1_700_000,
+                    help="~54.5M Amazon-2023 users / 32 cores")
+    ap.add_argument("--items", type=int, default=1_500_000,
+                    help="~48M items / 32 cores")
+    ap.add_argument("--nnz", type=int, default=18_000_000,
+                    help="~570M ratings / 32 cores")
+    ap.add_argument("--rank", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=2)
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="shrink users/items/nnz together (quick checks)")
+    ap.add_argument("--platform", default="default",
+                    choices=["default", "cpu"])
+    args = ap.parse_args()
+
+    metric = f"als_iters_per_sec_rank{args.rank}_single_core_proxy"
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from bench import tpu_ready
+
+        ok, err = tpu_ready()
+        if not ok:
+            print(json.dumps({"metric": metric, "value": None,
+                              "unit": "iters/sec", "vs_baseline": None,
+                              "error": err}))
+            return
+
+    import numpy as np
+
+    import jax
+
+    from bench import analytic_flops_per_iter, call_with_timeout, log
+    from tpu_als.core.als import (
+        AlsConfig, init_factors, make_step, resolve_solve_path)
+    from tpu_als.core.ratings import build_csr_buckets
+    from tpu_als.io.movielens import synthetic_movielens
+    from tpu_als.utils.platform import fence
+
+    nU = max(64, int(args.users * args.scale))
+    nI = max(64, int(args.items * args.scale))
+    nnz = max(1024, int(args.nnz * args.scale))
+    devs = call_with_timeout(jax.devices, 180, "jax.devices() hung")
+    log(f"devices: {devs}")
+
+    t0 = time.time()
+    frame = synthetic_movielens(nU, nI, nnz, seed=0)
+    u = np.asarray(frame["user"])
+    i = np.asarray(frame["item"])
+    r = np.asarray(frame["rating"])
+    log(f"synthesized {nnz:,} ratings ({time.time()-t0:.1f}s)")
+    ucsr = build_csr_buckets(u, i, r, nU)
+    icsr = build_csr_buckets(i, u, r, nI)
+    waste = (ucsr.padded_nnz + icsr.padded_nnz) / (2.0 * nnz)
+    log(f"blocked (waste {waste:.2f}x)")
+
+    cfg = AlsConfig(rank=args.rank, max_iter=1, reg_param=0.01,
+                    implicit_prefs=True, alpha=40.0, seed=0)
+    backends = resolve_solve_path(cfg, cfg.rank)
+    log(f"resolved rank-{args.rank} backends: {backends}")
+
+    key = jax.random.PRNGKey(0)
+    ku, kv = jax.random.split(key)
+    U = init_factors(ku, nU, cfg.rank)
+    V = init_factors(kv, nI, cfg.rank)
+    ub = jax.device_put(ucsr.device_buckets())
+    ib = jax.device_put(icsr.device_buckets())
+    step = make_step(ub, ib, nU, nI, cfg, ucsr.chunk_elems, icsr.chunk_elems)
+
+    t0 = time.time()
+    U, V = step(U, V)
+    U.block_until_ready()
+    fence(U)
+    log(f"warmup (compile + 1 iter): {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    for _ in range(args.iters):
+        U, V = step(U, V)
+    U.block_until_ready()
+    fence(U)
+    dt = time.time() - t0
+    ips = args.iters / dt
+    log(f"{args.iters} iters in {dt:.1f}s -> {ips:.4f} iters/sec")
+
+    stats = {}
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        pass
+    peak = stats.get("peak_bytes_in_use")
+    flops = analytic_flops_per_iter(nnz, nU, nI, cfg.rank, implicit=True)
+    print(json.dumps({
+        "metric": metric,
+        "value": round(ips, 4),
+        "unit": "iters/sec",
+        "vs_baseline": None,
+        "baseline_note": "config-3 per-core slice (full set / 32); no "
+                         "reference number exists for this config",
+        "config": {
+            "users": nU, "items": nI, "ratings": nnz, "rank": args.rank,
+            "seconds_per_iter": round(dt / args.iters, 3),
+            "padding_waste": round(waste, 3),
+            "peak_hbm_gb": round(peak / 1e9, 3) if peak else None,
+            "tflops_per_iter_analytic": round(flops / 1e12, 3),
+            "achieved_tflops": round(flops * ips / 1e12, 3),
+            "device": str(jax.devices()[0]),
+            **backends,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,10 @@ run headline_bf16_wg15 580 python bench.py --iters 5 --compute-dtype bfloat16 --
 #    here; rerun with the winner's flags before updating BASELINE.md)
 run rmse 580 python bench.py --mode rmse --iters-rmse 12
 
+# 3b. rank-256 single-core proxy (BASELINE row 3 / config 3 evidence:
+#     pallas_solve at the production rank, s/iter, peak HBM)
+run rank256_proxy 900 python scripts/rank256_proxy.py
+
 # 4. fold-in p50 + two-tower filtered recall (5 + 20 epochs)
 run foldin 580 python bench.py --mode foldin
 run twotower_5ep 580 python bench.py --mode twotower --tt-epochs 5

@@ -263,6 +263,35 @@ class ALS(_ALSParams):
                              f"(columns: {frame.columns}); set ratingCol='' "
                              "for unit ratings")
 
+        if self.mesh is not None:
+            import jax
+
+            if jax.process_count() > 1:
+                # the FIRST collective of every multi-process fit, on
+                # every configuration: agree on the knobs that decide
+                # which collectives follow (dataMode picks the id-map
+                # path, the observer knobs gate mp_cb's gathers).  A
+                # divergence would otherwise pair MISMATCHED collectives
+                # across processes — a distributed hang or a cryptic
+                # gloo shape error instead of this ValueError.
+                from jax.experimental import multihost_utils as mhu
+
+                interval = self.getCheckpointInterval()
+                ckpt_on = (self.checkpointDir is not None
+                           and interval >= 1)
+                gate = np.asarray(mhu.process_allgather(np.array(
+                    [int(self.dataMode == "per_host"),
+                     int(self.fitCallback is not None),
+                     self.fitCallbackInterval,
+                     int(ckpt_on), interval], dtype=np.int64)))
+                if not (gate == gate[0]).all():
+                    raise ValueError(
+                        "processes disagree on multi-process fit config "
+                        "(dataMode, fitCallback present, "
+                        "fitCallbackInterval, checkpointing, "
+                        f"checkpointInterval): {gate.tolist()} — pass "
+                        "the SAME knobs on every process (peers may use "
+                        "an inert callback; only process 0's is invoked)")
         if self.dataMode == "per_host":
             # every process holds a DIFFERENT split, so the entity space
             # must be agreed before anything derives from it (id maps →
@@ -338,34 +367,14 @@ class ALS(_ALSParams):
                 # fitCallback (collective entity-space gather every
                 # fitCallbackInterval iterations, invoked on process 0 —
                 # the gather is the cost, the interval amortizes it).
-                from jax.experimental import multihost_utils as mhu
-
                 from tpu_als.parallel.multihost import (
                     gather_entity_factors,
                     train_multihost,
                 )
 
-                # every process must agree on WHEN mp_cb gathers — the
-                # gather is collective, so a fitCallback passed on one
-                # process only (or divergent intervals/checkpoint config)
-                # would deadlock the fit inside the collective.  Fail
-                # fast instead (same discipline as train_multihost's
-                # entity-space agreement check).
-                interval = self.getCheckpointInterval()
-                ckpt_on = self.checkpointDir is not None and interval >= 1
-                gate = np.asarray(mhu.process_allgather(np.array(
-                    [int(self.fitCallback is not None),
-                     self.fitCallbackInterval,
-                     int(ckpt_on), interval], dtype=np.int64)))
-                if not (gate == gate[0]).all():
-                    raise ValueError(
-                        "processes disagree on the fit-observer config "
-                        "(fitCallback present, fitCallbackInterval, "
-                        f"checkpointing, checkpointInterval): "
-                        f"{gate.tolist()} — pass the SAME callbacks and "
-                        "intervals on every process (peers may use an "
-                        "inert lambda; only process 0's is invoked)")
-
+                # observer/dataMode agreement was checked by the gate at
+                # the top of fit — the FIRST collective on every path —
+                # so mp_cb's collective gathers below fire in lockstep
                 mp_cb = None
                 last_gather = {}  # iteration -> (Ue, Ve); reused below so
                 # a final-iteration gather isn't repeated after training

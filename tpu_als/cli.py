@@ -134,11 +134,17 @@ def _train_multiprocess(args):
     spec = args.data.replace("{proc}", str(pid))
     if args.per_host_data and args.data == spec and pcount > 1:
         print(f"[proc {pid}] warning: --per-host-data without a {{proc}} "
-              "placeholder in --data — every host loads the same file",
+              "placeholder in --data — every host loads the same path "
+              "(valid only for host-LOCAL disks holding different "
+              "splits; identical content is rejected at train time)",
               file=sys.stderr)
     frame = _load_data(spec)
+    # the split seed is deliberately IDENTICAL across hosts: per-host
+    # data is disjoint anyway, and a per-pid seed would decorrelate the
+    # splits of an accidentally-shared file, defeating the trainer's
+    # duplicated-content rejection (code-review r3)
     train, test = frame.randomSplit([1 - args.holdout, args.holdout],
-                                    seed=args.seed + pid * args.per_host_data)
+                                    seed=args.seed)
     mesh = make_mesh()  # global mesh over every host's devices
     # a non-None fitCallback must be passed on EVERY process (the
     # per-iteration factor gather it triggers is collective); only

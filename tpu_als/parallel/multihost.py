@@ -78,6 +78,22 @@ def init_distributed(coordinator_address=None, num_processes=None,
     return jax.process_index(), jax.process_count()
 
 
+def _triples_digest(u, i, r):
+    """Order-independent int64 digest of (u, i, r) triples: blake2b over
+    the lexicographically sorted rows.  Used to detect identical per-host
+    inputs without false positives on coincidentally-equal summary stats."""
+    import hashlib
+
+    order = np.lexsort((np.asarray(r), np.asarray(i), np.asarray(u)))
+    buf = np.concatenate([
+        np.asarray(u, dtype=np.int64)[order].view(np.uint8),
+        np.asarray(i, dtype=np.int64)[order].view(np.uint8),
+        np.asarray(r, dtype=np.float32)[order].view(np.uint8),
+    ])
+    h = hashlib.blake2b(buf.tobytes(), digest_size=8).digest()
+    return int(np.frombuffer(h, dtype=np.int64)[0])
+
+
 def _ragged_allgather(arr, fill=0):
     """Concatenate every process's 1-D array (ragged lengths allowed).
 
@@ -187,14 +203,15 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
         from jax.experimental import multihost_utils as mhu
 
         # catch the duplicated-load mistake BEFORE the exchange doubles
-        # every rating: per-host splits with identical content signatures
-        # mean every host read the SAME file (replicated=False would then
-        # train on P copies of each rating — effective regularization
-        # silently divided by P)
+        # every rating: per-host splits with identical content mean every
+        # host read the SAME file (replicated=False would then train on P
+        # copies of each rating — effective regularization silently
+        # divided by P).  Content = an order-independent 64-bit digest of
+        # the sorted triples, not summary stats (equal sums on genuinely
+        # disjoint splits would false-positive; a hash collision is
+        # ~2^-64)
         sig = np.asarray(mhu.process_allgather(np.array(
-            [len(u), int(u.sum()), int(i.sum()),
-             np.float64(r.astype(np.float64).sum()).view(np.int64)],
-            dtype=np.int64)))
+            [len(u), _triples_digest(u, i, r)], dtype=np.int64)))
         if len(u) and (sig == sig[0]).all():
             raise ValueError(
                 "replicated=False but every process passed IDENTICAL "

@@ -158,7 +158,8 @@ def run_headline(args):
     cfg = AlsConfig(rank=args.rank, max_iter=1, reg_param=0.01,
                     implicit_prefs=True, alpha=40.0, seed=0,
                     solve_backend=args.solve_backend,
-                    compute_dtype=args.compute_dtype)
+                    compute_dtype=args.compute_dtype,
+                    cg_iters=args.cg_iters)
     key = jax.random.PRNGKey(0)
     ku, kv = jax.random.split(key)
     U = init_factors(ku, nU, cfg.rank)
@@ -261,7 +262,8 @@ def run_rmse(args):
     cfg = AlsConfig(rank=args.rank, max_iter=args.iters_rmse,
                     reg_param=args.reg, implicit_prefs=False, seed=0,
                     solve_backend=args.solve_backend,
-                    compute_dtype=args.compute_dtype)
+                    compute_dtype=args.compute_dtype,
+                    cg_iters=args.cg_iters)
     t0 = time.time()
     U, V = train(ucsr, icsr, cfg)
     U.block_until_ready()
@@ -524,6 +526,11 @@ def main():
     ap.add_argument("--compute-dtype", default="float32",
                     choices=["float32", "bfloat16"],
                     help="dtype for the gather/einsum stage")
+    ap.add_argument("--cg-iters", type=int, default=0,
+                    help="> 0: inexact ALS — replace the exact per-row "
+                         "solve with this many warm-started CG steps "
+                         "(batched MXU matvecs instead of r^3 "
+                         "factorizations); 0 = exact Cholesky path")
     ap.add_argument("--foldin-batch", type=int, default=512,
                     help="ratings per micro-batch (foldin mode)")
     ap.add_argument("--tt-epochs", type=int, default=20,

@@ -16,11 +16,17 @@ run() {  # run <name> <timeout> <cmd...>
 # 1. solve-kernel panel sweep (sets DEFAULT_PANEL if a non-8 wins)
 run kernel_lab 580 python scripts/kernel_lab.py --panels 4 8 16
 
-# 2. headline A/Bs: f32 vs bf16 gather/einsum, width ladder 2.0 vs 1.5
+# 2. headline A/Bs: f32 vs bf16 gather/einsum, width ladder 2.0 vs 1.5,
+#    and the warm-started-CG inexact solve (2 and 3 steps)
 run headline_f32     580 python bench.py --iters 5
 run headline_bf16    580 python bench.py --iters 5 --compute-dtype bfloat16
 run headline_wg15    580 python bench.py --iters 5 --width-growth 1.5
 run headline_bf16_wg15 580 python bench.py --iters 5 --compute-dtype bfloat16 --width-growth 1.5
+run headline_cg2     580 python bench.py --iters 5 --cg-iters 2
+run headline_cg3     580 python bench.py --iters 5 --cg-iters 3
+run headline_cg2_bf16 580 python bench.py --iters 5 --cg-iters 2 --compute-dtype bfloat16
+# quality parity of the inexact solve at the headline rank
+run rmse_cg2 580 python bench.py --mode rmse --iters-rmse 12 --cg-iters 2
 
 # 3. quality: held-out RMSE with whatever headline config won (f32 default
 #    here; rerun with the winner's flags before updating BASELINE.md)

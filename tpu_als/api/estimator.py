@@ -190,16 +190,23 @@ class ALS(_ALSParams):
     SAME dataset to ``fit``) or ``'per_host'`` (every process passes its
     OWN disjoint split — e.g. one input file per pod host; the entity
     space is agreed via ``multihost.global_id_union`` and the triples are
-    redistributed inside ``train_multihost``).
+    redistributed inside ``train_multihost``);
+    ``cgIters`` — > 0 replaces the exact per-row solve with that many
+    warm-started conjugate-gradient steps (inexact ALS,
+    ``ops.solve.solve_cg``): the r³ factorization becomes a few batched
+    MXU matvecs; 0 (default) keeps the exact batched Cholesky.
     """
 
     def __init__(self, *, mesh=None, gatherStrategy="all_gather",
                  checkpointDir=None, resumeFrom=None,
                  fitCallback=None, fitCallbackInterval=1,
-                 dataMode="replicated",
+                 dataMode="replicated", cgIters=0,
                  **kwargs):
         super().__init__()
         self.mesh = mesh
+        if int(cgIters) < 0:
+            raise ValueError("cgIters must be >= 0 (0 = exact solve)")
+        self.cgIters = int(cgIters)
         if gatherStrategy not in ("all_gather", "ring", "all_to_all"):
             raise ValueError(
                 f"unknown gatherStrategy {gatherStrategy!r} (expected "
@@ -234,6 +241,7 @@ class ALS(_ALSParams):
             alpha=get("alpha"),
             nonnegative=get("nonnegative"),
             seed=get("seed") or 0,
+            cg_iters=self.cgIters,
         )
 
     def fit(self, dataset, params=None):
@@ -283,15 +291,17 @@ class ALS(_ALSParams):
                     [int(self.dataMode == "per_host"),
                      int(self.fitCallback is not None),
                      self.fitCallbackInterval,
-                     int(ckpt_on), interval], dtype=np.int64)))
+                     int(ckpt_on), interval,
+                     self.getMaxIter()], dtype=np.int64)))
                 if not (gate == gate[0]).all():
                     raise ValueError(
                         "processes disagree on multi-process fit config "
                         "(dataMode, fitCallback present, "
                         "fitCallbackInterval, checkpointing, "
-                        f"checkpointInterval): {gate.tolist()} — pass "
-                        "the SAME knobs on every process (peers may use "
-                        "an inert callback; only process 0's is invoked)")
+                        "checkpointInterval, maxIter): "
+                        f"{gate.tolist()} — pass the SAME knobs on every "
+                        "process (peers may use an inert callback; only "
+                        "process 0's is invoked)")
         if self.dataMode == "per_host":
             # every process holds a DIFFERENT split, so the entity space
             # must be agreed before anything derives from it (id maps →

@@ -26,6 +26,7 @@ from tpu_als.ops.solve import (
     compute_yty,
     normal_eq_explicit,
     normal_eq_implicit,
+    solve_cg,
     solve_nnls,
     solve_spd,
 )
@@ -53,6 +54,13 @@ class AlsConfig:
     # and for regimes where the A tensor's HBM round-trip dominates);
     # 'unfused' forces the einsum path (NNLS always uses unfused)
     solve_backend: str = "auto"
+    # > 0: replace the exact per-row factorization with that many
+    # warm-started Jacobi-CG steps (ops.solve.solve_cg) — inexact ALS.
+    # The solve cost drops from r³/3 serial-recurrence work to cg_iters
+    # batched MXU matvecs; the warm start is the previous ALS iterate, so
+    # the outer fixed-point loop converges to the same solution.
+    # Precedence: nonnegative (NNLS) > solve_backend='fused' > cg_iters.
+    cg_iters: int = 0
 
 
 def resolve_solve_path(cfg: AlsConfig, rank):
@@ -83,6 +91,10 @@ def resolve_solve_path(cfg: AlsConfig, rank):
         # forced: no probe — dispatch would ignore its outcome, and the
         # probe costs a Mosaic compile+execute on every resolve
         path = "fused_pallas"
+    elif cfg.cg_iters > 0:
+        # inexact ALS: no factorization, no Pallas kernel, no probe —
+        # the solve is cg_iters batched matvecs on the einsum-built A
+        path = f"einsum+cg{cfg.cg_iters}_warmstart"
     else:
         # the same probe walk solve_spd's dispatch runs — prewarming here
         # IS the prewarm contract; the re-reads below are cache hits
@@ -113,7 +125,7 @@ def init_factors(key, num_rows, rank, dtype=jnp.float32):
 
 
 def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
-                    chunk_elems=1 << 19):
+                    chunk_elems=1 << 19, prev=None):
     """Solve all rows of one side given the full opposite factor matrix.
 
     V_full [N_opposite, r]; buckets: list[Bucket] (device arrays); returns
@@ -122,6 +134,10 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     within the HBM budget set by ``chunk_elems`` — pass the value the buckets
     were built with (``CsrBuckets.chunk_elems``) so row padding divides the
     chunk exactly.
+
+    ``prev`` [num_rows, r]: the solved side's CURRENT factors — the warm
+    start for the inexact-ALS CG path (``cfg.cg_iters > 0``); ignored by
+    the exact solvers.
     """
     r = V_full.shape[-1]
     cdt = jnp.dtype(cfg.compute_dtype)
@@ -137,6 +153,7 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
             f"unknown solve_backend {cfg.solve_backend!r} "
             "(expected 'auto', 'fused' or 'unfused')")
     fused = resolve_solve_path(cfg, r)["resolved_solve_path"] == "fused_pallas"
+    cg = cfg.cg_iters > 0 and not cfg.nonnegative and not fused
 
     for b in buckets:
         nb, w = b.cols.shape
@@ -145,9 +162,10 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
         cols = b.cols.reshape(nchunks, chunk, w)
         vals = b.vals.reshape(nchunks, chunk, w)
         mask = b.mask.reshape(nchunks, chunk, w)
+        rows = b.rows.reshape(nchunks, chunk)
 
         def solve_chunk(args):
-            c, v, m = args
+            c, v, m, rw = args
             with jax.named_scope("gather_factors"):
                 Vg = V_comp[c]
             if fused:
@@ -178,13 +196,22 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
             with jax.named_scope("solve"):
                 if cfg.nonnegative:
                     return solve_nnls(A, rhs, count, sweeps=cfg.nnls_sweeps)
+                if cg:
+                    # padding rows (index num_rows) clip to a real row's
+                    # stale value, but their count is 0 so CG drives them
+                    # to 0 and the scatter drops them anyway
+                    x0 = (prev.astype(jnp.float32)[jnp.clip(rw, 0,
+                                                            num_rows - 1)]
+                          if prev is not None else None)
+                    return solve_cg(A, rhs, count, x0=x0,
+                                    iters=cfg.cg_iters)
                 return solve_spd(A, rhs, count)
 
         if nchunks == 1:
-            x = solve_chunk((cols[0], vals[0], mask[0]))
+            x = solve_chunk((cols[0], vals[0], mask[0], rows[0]))
             xs = x[None]
         else:
-            xs = jax.lax.map(solve_chunk, (cols, vals, mask))
+            xs = jax.lax.map(solve_chunk, (cols, vals, mask, rows))
         # padding rows carry index num_rows -> out of bounds -> dropped
         out = out.at[b.rows].set(
             xs.reshape(nb, r), mode="drop", unique_indices=True
@@ -212,15 +239,15 @@ def make_step(user_buckets, item_buckets, num_users, num_items, cfg: AlsConfig,
         if cfg.implicit_prefs:
             YtY_u = compute_yty(U)
             V = local_half_step(U, ib, num_items, cfg, YtY_u,
-                                item_chunk_elems)
+                                item_chunk_elems, prev=V)
             YtY_v = compute_yty(V)
             U = local_half_step(V, ub, num_users, cfg, YtY_v,
-                                user_chunk_elems)
+                                user_chunk_elems, prev=U)
         else:
             V = local_half_step(U, ib, num_items, cfg,
-                                chunk_elems=item_chunk_elems)
+                                chunk_elems=item_chunk_elems, prev=V)
             U = local_half_step(V, ub, num_users, cfg,
-                                chunk_elems=user_chunk_elems)
+                                chunk_elems=user_chunk_elems, prev=U)
         return U, V
 
     jitted = jax.jit(step_impl, donate_argnums=(0, 1))

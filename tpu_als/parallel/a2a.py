@@ -216,16 +216,17 @@ def build_a2a(row_part, col_part, row_idx, col_idx, vals,
 
 
 def a2a_half_step(V_loc, send_idx, buckets, num_rows, cfg, chunk_elems,
-                  YtY=None):
+                  YtY=None, prev=None):
     """One half-step with the ragged exchange (inside ``shard_map``).
 
     V_loc [per_opposite, r]: this device's shard of the opposite factors.
     send_idx [D, R]: this device's outgoing request lists (one per dst).
     The exchange builds the compact [D·R, r] recv table the rating shards'
-    col ids index; the solve is the shared ``local_half_step``.
+    col ids index; the solve is the shared ``local_half_step`` (``prev`` =
+    the solved side's current shard, its CG warm start).
     """
     Vsend = V_loc[send_idx]                                    # [D, R, r]
     Vrecv = jax.lax.all_to_all(Vsend, AXIS, split_axis=0, concat_axis=0)
     V_compact = Vrecv.reshape(-1, V_loc.shape[-1])             # [D*R, r]
     return local_half_step(V_compact, buckets, num_rows, cfg, YtY,
-                           chunk_elems)
+                           chunk_elems, prev=prev)

@@ -50,7 +50,7 @@ from tpu_als.core.ratings import (
     scan_chunk,
     trainer_chunk,
 )
-from tpu_als.ops.solve import solve_nnls, solve_spd
+from tpu_als.ops.solve import solve_cg, solve_nnls, solve_spd
 from tpu_als.parallel.mesh import AXIS
 
 
@@ -193,7 +193,7 @@ def shard_csr_grid(row_part, col_part, row_idx, col_idx, vals,
 
 
 def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
-                   chunk_elems, YtY=None):
+                   chunk_elems, YtY=None, prev=None):
     """One half-step with streaming factor shards (inside ``shard_map``).
 
     V_shard [per_opposite, r]: this device's shard of the opposite factors.
@@ -201,6 +201,8 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
     cols/vals/mask [S, nb, w].
     counts [num_rows]: per-row rating counts (for the λ·n ridge; for
     implicit feedback, the positive-rating counts).
+    prev [num_rows, r]: the solved side's current local factors — the CG
+    warm start when ``cfg.cg_iters > 0``.
 
     Rows are processed in tiles (``trainer_chunk``): per tile, one full
     ring pass of ``n_shards`` ppermute rotations accumulates
@@ -258,6 +260,14 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
         with jax.named_scope("ring_solve"):
             if cfg.nonnegative:
                 x = solve_nnls(A, bb, cnt, sweeps=cfg.nnls_sweeps)
+            elif cfg.cg_iters > 0 and cfg.solve_backend != "fused":
+                # same precedence as local_half_step (AlsConfig doc:
+                # nonnegative > 'fused' > cg) so one config means one
+                # solver across every gatherStrategy; ring has no fused
+                # kernel, so 'fused' degrades to the exact solve here
+                x0 = (prev[jnp.clip(rows, 0, num_rows - 1)]
+                      if prev is not None else None)
+                x = solve_cg(A, bb, cnt, x0=x0, iters=cfg.cg_iters)
             else:
                 x = solve_spd(A, bb, cnt)
         return V_c, x

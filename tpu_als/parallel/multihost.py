@@ -174,14 +174,18 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
 
         # cross-host agreement check: divergent entity spaces would fail
         # far away (mismatched global shapes inside gloo) or silently
-        # corrupt factors if shapes happened to coincide
-        dims = np.asarray(mhu.process_allgather(
-            np.array([num_users, num_items], dtype=np.int64)))
+        # corrupt factors if shapes happened to coincide; divergent
+        # iteration windows would have one host exit the training loop
+        # while peers keep issuing collectives — a silent hang
+        dims = np.asarray(mhu.process_allgather(np.array(
+            [num_users, num_items, int(start_iter), int(cfg.max_iter)],
+            dtype=np.int64)))
         if not (dims == dims[0]).all():
             raise ValueError(
-                f"hosts disagree on the entity space: (num_users, "
-                f"num_items) per process = {dims.tolist()}; all hosts "
-                "must share one id mapping")
+                "hosts disagree on (num_users, num_items, start_iter, "
+                f"max_iter): {dims.tolist()}; all hosts must share one "
+                "id mapping and one iteration window (same resumeFrom "
+                "checkpoint, same maxIter)")
 
         if replicated:
             # every host already holds the FULL triples (e.g. all loaded

@@ -96,18 +96,20 @@ def make_sharded_step(mesh, user_sharded, item_sharded, cfg: AlsConfig):
         U_full = jax.lax.all_gather(U_loc, AXIS, axis=0, tiled=True)
         if cfg.implicit_prefs:
             YtY_u = jax.lax.psum(compute_yty(U_loc), AXIS)
-            V_new = local_half_step(U_full, ibuckets, per_i, cfg, YtY_u, i_chunk)
+            V_new = local_half_step(U_full, ibuckets, per_i, cfg, YtY_u,
+                                    i_chunk, prev=V_loc)
         else:
             V_new = local_half_step(U_full, ibuckets, per_i, cfg,
-                                    chunk_elems=i_chunk)
+                                    chunk_elems=i_chunk, prev=V_loc)
         # --- user half-step: gather V, solve owned user rows ---
         V_full = jax.lax.all_gather(V_new, AXIS, axis=0, tiled=True)
         if cfg.implicit_prefs:
             YtY_v = jax.lax.psum(compute_yty(V_new), AXIS)
-            U_new = local_half_step(V_full, ubuckets, per_u, cfg, YtY_v, u_chunk)
+            U_new = local_half_step(V_full, ubuckets, per_u, cfg, YtY_v,
+                                    u_chunk, prev=U_loc)
         else:
             U_new = local_half_step(V_full, ubuckets, per_u, cfg,
-                                    chunk_elems=u_chunk)
+                                    chunk_elems=u_chunk, prev=U_loc)
         return U_new, V_new
 
     sharded = shard_map(
@@ -144,11 +146,11 @@ def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig):
         YtY_u = (jax.lax.psum(compute_yty(U_loc), AXIS)
                  if cfg.implicit_prefs else None)
         V_new = ring_half_step(U_loc, ibuckets, icounts, per_i, D, cfg,
-                               i_chunk, YtY_u)
+                               i_chunk, YtY_u, prev=V_loc)
         YtY_v = (jax.lax.psum(compute_yty(V_new), AXIS)
                  if cfg.implicit_prefs else None)
         U_new = ring_half_step(V_new, ubuckets, ucounts, per_u, D, cfg,
-                               u_chunk, YtY_v)
+                               u_chunk, YtY_v, prev=U_loc)
         return U_new, V_new
 
     sharded = shard_map(
@@ -187,11 +189,11 @@ def make_a2a_step(mesh, user_a2a, item_a2a, cfg: AlsConfig):
         YtY_u = (jax.lax.psum(compute_yty(U_loc), AXIS)
                  if cfg.implicit_prefs else None)
         V_new = a2a_half_step(U_loc, i_send, ibuckets, per_i, cfg, i_chunk,
-                              YtY_u)
+                              YtY_u, prev=V_loc)
         YtY_v = (jax.lax.psum(compute_yty(V_new), AXIS)
                  if cfg.implicit_prefs else None)
         U_new = a2a_half_step(V_new, u_send, ubuckets, per_u, cfg, u_chunk,
-                              YtY_v)
+                              YtY_v, prev=U_loc)
         return U_new, V_new
 
     sharded = shard_map(

@@ -50,6 +50,25 @@ if __name__ == "__main__":
                      Ur=resumed._U, Vr=resumed._V,
                      Us=straight._U, Vs=straight._V)
         print("ckpt worker done", flush=True)
+    elif os.environ.get("MH_MODE") == "gate_diverge":
+        # processes deliberately disagree on a fit knob: the config gate
+        # (fit's FIRST collective) must turn what would be a distributed
+        # hang into a ValueError on EVERY process
+        from tpu_als import ALS
+        from tpu_als.io.movielens import synthetic_movielens
+        from tpu_als.parallel.mesh import make_mesh
+
+        pid = jax.process_index()
+        frame = synthetic_movielens(60, 30, 800, seed=3)
+        try:
+            ALS(rank=3, maxIter=2, seed=0, mesh=make_mesh(),
+                fitCallbackInterval=1 + pid,  # the divergence
+                fitCallback=lambda it, U, V: None).fit(frame)
+        except ValueError as e:
+            assert "disagree" in str(e), e
+            print("gate worker caught divergence", flush=True)
+        else:
+            raise AssertionError("divergent fit config was not rejected")
     elif os.environ.get("MH_MODE") == "fit_perhost":
         # per-host disjoint files: each process writes + loads ONLY its
         # half of the dataset (row parity split), fits with

@@ -37,6 +37,14 @@ def test_cli_train_evaluate_recommend(tmp_path, capsys):
     assert len(lines2) == 2
 
 
+def test_cli_per_host_data_single_process_rejected():
+    import pytest
+
+    with pytest.raises(SystemExit, match="multi-process only"):
+        cli_main(["train", "--data", "synthetic:50x20x500",
+                  "--per-host-data"])
+
+
 def test_cli_foldin_bench(tmp_path, capsys):
     model_dir = str(tmp_path / "m")
     cli_main(["train", "--data", "synthetic:100x50x2000", "--rank", "3",

@@ -301,6 +301,21 @@ def test_two_process_per_host_files_fit_matches_replicated(tmp_path):
     np.testing.assert_allclose(dat["V"], ref._V, rtol=5e-4, atol=5e-4)
 
 
+def test_two_process_divergent_config_fails_fast(tmp_path):
+    """A fit knob that differs across processes (here fitCallbackInterval)
+    must raise the config-gate ValueError on every process instead of
+    deadlocking inside a one-sided collective gather."""
+    import os
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_cli_worker.py")
+    outs = _spawn_two_procs(worker, {"MH_OUT": str(tmp_path / "g"),
+                                     "MH_MODE": "gate_diverge"},
+                            timeout=180)
+    for o in outs:
+        assert "gate worker caught divergence" in o, o[-1500:]
+
+
 def test_ring_local_slice_matches_full_grid(rng):
     from tpu_als.parallel.comm import shard_csr_grid
 

@@ -115,6 +115,37 @@ def test_filtered_recall_matches_plain_when_no_overlap(rng):
     assert r_plain == r_filt, (r_plain, r_filt)
 
 
+def test_serving_bias_steers_topk_toward_biased_items(rng):
+    """An item_bias large on one item must pull it into every top-k (and a
+    zero bias must change nothing) — the serving-time popularity-prior
+    mechanism, exercised through both the biased and ban machinery."""
+    from tpu_als.models.two_tower import serving_bias
+
+    u, i, _, _ = _interactions(rng)
+    cfg = TwoTowerConfig(embed_dim=8, hidden=(16,), out_dim=8, epochs=2,
+                         batch_size=256, seed=3)
+    params = train_two_tower(u, i, 60, 40, cfg)
+    plain = recall_at_k(params, u, i, k=5)
+    zero = recall_at_k(params, u, i, k=5, item_bias=np.zeros(40, np.float32))
+    assert plain == zero
+    # a huge bias on item 7 forces it into every user's top-k: recall
+    # becomes exactly the share of eval pairs whose item is 7 plus
+    # whatever still ranks in the remaining 4 slots >= pairs-with-7 share
+    bias = np.zeros(40, np.float32)
+    bias[7] = 1e4
+    boosted = recall_at_k(params, u, i, k=1, item_bias=bias)
+    assert boosted == float((i == 7).mean())
+    # the real helper: temperature-scaled log q, finite, and strictly
+    # higher for the hottest item than for a zero-count one
+    counts = np.bincount(i, minlength=40)
+    sb = serving_bias(counts, cfg.temperature)
+    assert np.isfinite(sb).all()
+    hot = int(np.argmax(counts))
+    cold_ = int(np.argmin(counts))
+    assert counts[hot] > counts[cold_]
+    assert sb[hot] > sb[cold_]
+
+
 def test_from_fitted_als_model(rng):
     from tpu_als import ALS, ColumnarFrame
 

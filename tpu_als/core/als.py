@@ -70,7 +70,8 @@ def resolve_solve_path(cfg: AlsConfig, rank):
     backends, not requested ones).
 
     Returns a dict with ``resolved_solve_path`` ∈ {'einsum+nnls',
-    'fused_pallas', 'einsum+pallas_lanes', 'einsum+pallas_cholesky',
+    'fused_pallas', 'einsum+cg{n}_warmstart' (inexact ALS, n =
+    cfg.cg_iters), 'einsum+pallas_lanes', 'einsum+pallas_cholesky',
     'einsum+xla_cholesky'} plus the raw probe outcomes.
     """
     from tpu_als.ops import pallas_lanes, pallas_solve

@@ -140,9 +140,9 @@ def train_two_tower(u_idx, i_idx, num_users, num_items,
 
     log_q = None
     if cfg.popularity_correction:
-        counts = np.bincount(i_idx, minlength=num_items).astype(np.float64)
-        q = (counts + 1.0) / (counts.sum() + num_items)  # add-1 smoothing
-        log_q = jnp.asarray(np.log(q), dtype=jnp.float32)
+        log_q = jnp.asarray(
+            log_popularity(np.bincount(i_idx, minlength=num_items)),
+            dtype=jnp.float32)
 
     @jax.jit
     def step(params, opt_state, ub, ib, wb):
@@ -193,18 +193,50 @@ def ban_lists(users, train_u, train_i, user_batch):
     return tpos, tit, bounds
 
 
+def log_popularity(item_counts):
+    """Add-1-smoothed log empirical item popularity, ``log q(item)``.
+
+    THE shared formula behind three sites that must agree exactly: the
+    training logQ correction (:func:`train_two_tower`), the serving prior
+    (:func:`serving_bias` — which exists to add back precisely what
+    training removed), and the benchmark's Bayes oracle ceiling
+    (bench.py).  A divergence between them would silently break the
+    'serving = oracle form' premise.
+    """
+    counts = np.asarray(item_counts, dtype=np.float64)
+    q = (counts + 1.0) / (counts.sum() + len(counts))
+    return np.log(q)
+
+
+def serving_bias(item_counts, temperature):
+    """Popularity prior for serving: ``temperature · log q(item)``.
+
+    The towers are TRAINED with the logQ correction (preference scores,
+    popularity removed), but when the target distribution is itself
+    popularity-biased — like this protocol's test draws, and like most
+    real recommendation traffic — the optimal serving score adds the
+    popularity prior back: ``score/T + log q``, exactly the form of the
+    benchmark's Bayes oracle.  Returned pre-scaled by ``temperature`` so
+    it can be passed as ``recall_at_k(..., item_bias=...)`` where scores
+    are raw (un-tempered) cosines.
+    """
+    return (temperature * log_popularity(item_counts)).astype(np.float32)
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
-def _banned_topk(zu_b, zi, ban_rows, ban_cols, k):
+def _banned_topk(zu_b, zi, ban_rows, ban_cols, bias, k):
     """Top-k over all items with (row, col) score entries banned.  Padding
-    bans carry row == batch size (out of bounds -> scatter-dropped)."""
+    bans carry row == batch size (out of bounds -> scatter-dropped).
+    ``bias`` [num_items] is added to every user's scores."""
     scores = jnp.einsum("nr,cr->nc", zu_b, zi,
                         preferred_element_type=jnp.float32)
+    scores = scores + bias[None, :]
     scores = scores.at[ban_rows, ban_cols].set(-3.4e38, mode="drop")
     return jax.lax.top_k(scores, k)[1]
 
 
 def recall_at_k(params, eval_u, eval_i, k=10, item_chunk=8192,
-                exclude=None, user_batch=2048):
+                exclude=None, user_batch=2048, item_bias=None):
     """Fraction of held-out (user, item) pairs whose item appears in the
     user's top-k retrieval — the config-5 metric.
 
@@ -214,6 +246,10 @@ def recall_at_k(params, eval_u, eval_i, k=10, item_chunk=8192,
     trained model correctly ranks the items it was trained on first, so
     unfiltered top-k slots are occupied by train positives and held-out
     recall is pinned near the random floor regardless of model quality.
+
+    ``item_bias`` [num_items]: optional additive per-item score bias —
+    :func:`serving_bias` restores the popularity prior the logQ-corrected
+    training removed.
     """
     eval_u = np.asarray(eval_u)
     eval_i = np.asarray(eval_i)
@@ -221,19 +257,23 @@ def recall_at_k(params, eval_u, eval_i, k=10, item_chunk=8192,
     users, inv = np.unique(eval_u, return_inverse=True)
     zi = item_repr(params, jnp.arange(num_items))
 
-    if exclude is None:
+    if exclude is None and item_bias is None:
         zu = user_repr(params, jnp.asarray(users))
         _, topk = chunked_topk_scores(
             zu, zi, jnp.ones(num_items, bool), k=k, item_chunk=item_chunk)
         topk = np.asarray(topk)
         hits = (topk[inv] == eval_i[:, None]).any(axis=1)
         return float(hits.mean())
+    if exclude is None:
+        exclude = (np.empty(0, np.int64), np.empty(0, np.int64))
 
     # bound the [user_batch, num_items] device score tensor to ~256 MB f32
     # (an explicitly small user_batch is honored — tests use it to cover
     # the multi-batch ban partitioning)
     user_batch = min(user_batch, max(64, (1 << 26) // max(num_items, 1)))
 
+    bias = (jnp.zeros(num_items, jnp.float32) if item_bias is None
+            else jnp.asarray(item_bias, dtype=jnp.float32))
     nb = len(users)
     topk = np.zeros((nb, k), dtype=np.int32)
     tpos_s, tit_s, bounds = ban_lists(users, exclude[0], exclude[1],
@@ -255,6 +295,7 @@ def recall_at_k(params, eval_u, eval_i, k=10, item_chunk=8192,
         cols[: hi - lo] = tit_s[lo:hi]
         zu_b = user_repr(params, jnp.asarray(ub))
         topk[s:e] = np.asarray(_banned_topk(
-            zu_b, zi, jnp.asarray(rows), jnp.asarray(cols), k))[: e - s]
+            zu_b, zi, jnp.asarray(rows), jnp.asarray(cols), bias,
+            k))[: e - s]
     hits = (topk[inv] == eval_i[:, None]).any(axis=1)
     return float(hits.mean())

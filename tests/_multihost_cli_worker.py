@@ -50,6 +50,34 @@ if __name__ == "__main__":
                      Ur=resumed._U, Vr=resumed._V,
                      Us=straight._U, Vs=straight._V)
         print("ckpt worker done", flush=True)
+    elif os.environ.get("MH_MODE") == "cli_perhost":
+        # the CLI per-host surface end-to-end: each process writes its
+        # own csv split, the SAME command with --per-host-data and a
+        # {proc} placeholder loads them, trains, and process 0 saves
+        import numpy as np
+
+        from tpu_als.cli import main
+        from tpu_als.io.movielens import synthetic_movielens
+
+        pid = jax.process_index()
+        full = synthetic_movielens(90, 35, 2000, seed=4)
+        sel = np.arange(len(full)) % 2 == pid
+        base = os.environ["MH_OUT"]
+        np.savetxt(
+            base + f".part{pid}.csv",
+            np.column_stack([
+                np.asarray(full["user"])[sel],
+                np.asarray(full["item"])[sel],
+                np.asarray(full["rating"])[sel],
+                np.zeros(int(sel.sum()), np.int64),
+            ]),
+            delimiter=",", header="userId,movieId,rating,timestamp",
+            comments="", fmt=["%d", "%d", "%.6f", "%d"])
+        main(["train", "--data", "csv:" + base + ".part{proc}.csv",
+              "--per-host-data", "--devices", "0", "--rank", "4",
+              "--max-iter", "3", "--reg-param", "0.02", "--seed", "0",
+              "--output", base + ".model"])
+        print("cli perhost worker done", flush=True)
     elif os.environ.get("MH_MODE") == "gate_diverge":
         # processes deliberately disagree on a fit knob: the config gate
         # (fit's FIRST collective) must turn what would be a distributed

@@ -301,6 +301,32 @@ def test_two_process_per_host_files_fit_matches_replicated(tmp_path):
     np.testing.assert_allclose(dat["V"], ref._V, rtol=5e-4, atol=5e-4)
 
 
+def test_two_process_cli_per_host_data(tmp_path):
+    """`cli train --per-host-data --data csv:...part-{proc}.csv`: each
+    process loads only its split; process 0 reports holdout RMSE and
+    saves a model the parent can serve."""
+    import json as _json
+    import os
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_cli_worker.py")
+    out = str(tmp_path / "clip")
+    outs = _spawn_two_procs(worker, {"MH_OUT": out,
+                                     "MH_MODE": "cli_perhost"})
+    rmse_lines = [ln for text in outs for ln in text.splitlines()
+                  if ln.startswith("{") and "holdout_rmse" in ln]
+    assert len(rmse_lines) == 1, outs  # process 0 only
+    assert 0.0 < _json.loads(rmse_lines[0])["holdout_rmse"] < 2.0
+
+    from tpu_als import ALSModel
+    from tpu_als.io.movielens import synthetic_movielens
+
+    model = ALSModel.load(out + ".model")
+    frame = synthetic_movielens(90, 35, 2000, seed=4)
+    preds = model.transform(frame)["prediction"]
+    assert np.isfinite(preds).any() and len(preds) > 0
+
+
 def test_two_process_divergent_config_fails_fast(tmp_path):
     """A fit knob that differs across processes (here fitCallbackInterval)
     must raise the config-gate ValueError on every process instead of

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from tpu_als.core.ratings import build_csr_buckets, trainer_chunk
 from tpu_als.io.movielens import ML25M_SHAPE, synthetic_movielens
 from tpu_als.ops.solve import (
-    compute_yty, normal_eq_explicit, normal_eq_implicit, solve_spd)
+    compute_yty, normal_eq_explicit, normal_eq_implicit, solve_cg,
+    solve_spd)
 
 
 def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
@@ -76,10 +77,14 @@ def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
             rhs = rhs.astype(jnp.float32)
             if ab == "no-solve":
                 return rhs
+            sb = cfgd["solve_backend"]
+            if cfgd["cg_iters"] > 0 and sb != "fused":
+                # inexact-ALS solve: timing is warm-start-invariant (same
+                # fixed iteration count), so the ablation runs it cold
+                return solve_cg(A, rhs, cnt, iters=cfgd["cg_iters"])
             # under --solve-backend fused the no-neq/no-solve variants fall
             # back to the unfused path; use the XLA solver there so the
             # stage delta isn't conflated with a solver swap
-            sb = cfgd["solve_backend"]
             return solve_spd(A, rhs, cnt,
                              backend="xla" if sb == "fused" else sb)
 
@@ -113,10 +118,19 @@ def main():
     ap.add_argument("--compute-dtype", default="float32",
                     choices=["float32", "bfloat16"],
                     help="dtype for the gather/normal-equation stage")
+    ap.add_argument("--cg-iters", type=int, default=0,
+                    help="> 0: ablate with the inexact-ALS CG solve "
+                         "instead of the factorization")
     ap.add_argument("--platform", default="default",
                     choices=["default", "cpu"],
                     help="cpu = force the CPU backend (smoke tests)")
     args = ap.parse_args()
+    if args.cg_iters > 0 and args.solve_backend == "fused":
+        # fused takes precedence over cg (core/als.py doc) — refusing the
+        # combination beats printing fused timings under a CG label
+        ap.error("--cg-iters cannot be combined with --solve-backend "
+                 "fused (the fused kernel would run and the output would "
+                 "be mislabeled as a CG ablation)")
     if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
@@ -130,6 +144,7 @@ def main():
                    "--iters", str(args.iters),
                    "--solve-backend", args.solve_backend,
                    "--compute-dtype", args.compute_dtype,
+                   "--cg-iters", str(args.cg_iters),
                    "--platform", args.platform,
                    "--variants", v]
             if args.explicit:
@@ -152,7 +167,8 @@ def main():
     ib = jax.device_put(icsr.device_buckets())
     cfgd = {"implicit": not args.explicit, "reg": 0.01, "alpha": 40.0,
             "solve_backend": args.solve_backend,
-            "compute_dtype": args.compute_dtype}
+            "compute_dtype": args.compute_dtype,
+            "cg_iters": args.cg_iters}
     rank = args.rank
 
     def step_impl(U, V, ub, ib, ab):
@@ -164,11 +180,13 @@ def main():
 
     from tpu_als.utils.platform import fence
 
-    if args.solve_backend in ("auto", "pallas", "lanes"):
+    if args.solve_backend in ("auto", "pallas", "lanes") and \
+            args.cg_iters == 0:
         # probe the solve kernels EAGERLY: probes cannot run inside the
         # jit traces below (probe_kernel degrades that trace to the
         # fallback without caching), which would silently measure the XLA
-        # path under an 'auto' label
+        # path under an 'auto' label.  The CG path never touches the
+        # Pallas solvers, so probing there would only burn compile time.
         from tpu_als.ops.solve import prewarm_solve
 
         prewarm_solve(rank)

@@ -36,6 +36,10 @@ run rmse 580 python bench.py --mode rmse --iters-rmse 12
 #     pallas_solve at the production rank, s/iter, peak HBM)
 run rank256_proxy 900 python scripts/rank256_proxy.py
 
+# 3c. full-scale stage attribution of the CG solve (what the cg2 headline
+#     win is made of)
+run ablate_full_cg2 900 python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2
+
 # 4. fold-in p50 + two-tower filtered recall (5 + 20 epochs)
 run foldin 580 python bench.py --mode foldin
 run twotower_5ep 580 python bench.py --mode twotower --tt-epochs 5

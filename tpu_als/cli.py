@@ -75,7 +75,8 @@ def cmd_train(args):
               implicitPrefs=args.implicit, alpha=args.alpha,
               nonnegative=args.nonnegative, seed=args.seed,
               coldStartStrategy="drop", fitCallback=logger,
-              mesh=mesh, gatherStrategy=args.gather_strategy)
+              mesh=mesh, gatherStrategy=args.gather_strategy,
+              cgIters=args.cg_iters)
     print(f"training on {len(train):,} ratings "
           f"({len(test):,} held out)", file=sys.stderr)
     if args.profile_dir:
@@ -162,7 +163,8 @@ def _train_multiprocess(args):
               alpha=args.alpha, nonnegative=args.nonnegative,
               seed=args.seed, coldStartStrategy="drop", mesh=mesh,
               gatherStrategy=args.gather_strategy, fitCallback=logger,
-              dataMode="per_host" if args.per_host_data else "replicated")
+              dataMode="per_host" if args.per_host_data else "replicated",
+              cgIters=args.cg_iters)
     ctx = contextlib.nullcontext()
     if args.profile_dir:
         from tpu_als.utils.observe import trace
@@ -227,7 +229,8 @@ def cmd_tune(args):
 
     frame = _load_data(args.data)
     als = ALS(maxIter=args.max_iter, implicitPrefs=args.implicit,
-              alpha=args.alpha, seed=args.seed, coldStartStrategy="drop")
+              alpha=args.alpha, seed=args.seed, coldStartStrategy="drop",
+              cgIters=args.cg_iters)
     grid = (ParamGridBuilder()
             .addGrid(als.rank, [int(x) for x in args.ranks.split(",")])
             .addGrid(als.regParam,
@@ -317,6 +320,10 @@ def main(argv=None):
                    help="multi-process only: each process loads its OWN "
                         "--data split ('{proc}' in the spec expands to "
                         "the process index) instead of a replicated load")
+    t.add_argument("--cg-iters", type=int, default=0,
+                   help="> 0: inexact ALS — warm-started CG solve with "
+                        "this many steps per half-step (0 = exact "
+                        "batched Cholesky)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("evaluate", help="score a dataset with a saved model")
@@ -346,6 +353,9 @@ def main(argv=None):
     g.add_argument("--seed", type=int, default=0)
     g.add_argument("--output", default=None,
                    help="save the best model here")
+    g.add_argument("--cg-iters", type=int, default=0,
+                   help="> 0: inexact-ALS CG solve for every grid fit "
+                        "(k x numFolds fits amortize the speedup)")
     g.set_defaults(fn=cmd_tune)
 
     f = sub.add_parser("foldin-bench", help="fold-in latency micro-benchmark")
@@ -355,6 +365,14 @@ def main(argv=None):
     f.set_defaults(fn=cmd_foldin_bench)
 
     args = ap.parse_args(argv)
+    if getattr(args, "nonnegative", False) and \
+            getattr(args, "cg_iters", 0) > 0:
+        # solver precedence is nonnegative (NNLS) > cg (core/als.py);
+        # refusing beats silently running the exact NNLS path under a
+        # CG label (same stance as scripts/ablate.py's fused+cg guard)
+        ap.error("--cg-iters cannot be combined with --nonnegative "
+                 "(the NNLS solver takes precedence and the CG request "
+                 "would be silently ignored)")
     args.fn(args)
 
 

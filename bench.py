@@ -159,7 +159,7 @@ def run_headline(args):
                     implicit_prefs=True, alpha=40.0, seed=0,
                     solve_backend=args.solve_backend,
                     compute_dtype=args.compute_dtype,
-                    cg_iters=args.cg_iters)
+                    cg_iters=args.cg_iters, cg_mode=args.cg_mode)
     key = jax.random.PRNGKey(0)
     ku, kv = jax.random.split(key)
     U = init_factors(ku, nU, cfg.rank)
@@ -263,7 +263,7 @@ def run_rmse(args):
                     reg_param=args.reg, implicit_prefs=False, seed=0,
                     solve_backend=args.solve_backend,
                     compute_dtype=args.compute_dtype,
-                    cg_iters=args.cg_iters)
+                    cg_iters=args.cg_iters, cg_mode=args.cg_mode)
     t0 = time.time()
     U, V = train(ucsr, icsr, cfg)
     U.block_until_ready()
@@ -542,6 +542,11 @@ def main():
                          "solve with this many warm-started CG steps "
                          "(batched MXU matvecs instead of r^3 "
                          "factorizations); 0 = exact Cholesky path")
+    ap.add_argument("--cg-mode", default="matfree",
+                    choices=["matfree", "dense"],
+                    help="matfree: apply A through the gathered factors "
+                         "(no [n,r,r] tensor, no NE einsum); dense: "
+                         "build A once and run CG on it")
     ap.add_argument("--foldin-batch", type=int, default=512,
                     help="ratings per micro-batch (foldin mode)")
     ap.add_argument("--tt-epochs", type=int, default=20,

@@ -24,6 +24,7 @@ run headline_wg15    580 python bench.py --iters 5 --width-growth 1.5
 run headline_bf16_wg15 580 python bench.py --iters 5 --compute-dtype bfloat16 --width-growth 1.5
 run headline_cg2     580 python bench.py --iters 5 --cg-iters 2
 run headline_cg3     580 python bench.py --iters 5 --cg-iters 3
+run headline_cg2_dense 580 python bench.py --iters 5 --cg-iters 2 --cg-mode dense
 run headline_cg2_bf16 580 python bench.py --iters 5 --cg-iters 2 --compute-dtype bfloat16
 # quality parity of the inexact solve at the headline rank
 run rmse_cg2 580 python bench.py --mode rmse --iters-rmse 12 --cg-iters 2

@@ -121,6 +121,44 @@ def test_inexact_als_matches_exact_quality(rng, implicit):
         assert rmse_c < rmse_e * 1.05 + 5e-3
 
 
+@pytest.mark.parametrize("implicit", [False, True])
+def test_matfree_equals_dense_cg(rng, implicit):
+    """The matrix-free half-step applies the SAME operator the dense path
+    builds — at equal iterations and warm starts the two Krylov
+    trajectories coincide (to fp reordering), so whole trainings must
+    agree pointwise."""
+    u, i, r, _, _ = make_ratings(rng, 70, 40, rank=4, density=0.3,
+                                 noise=0.05)
+    if implicit:
+        r = np.abs(r) * 4 + 0.1
+    kw = dict(rank=6, max_iter=6, reg_param=0.01,
+              implicit_prefs=implicit, alpha=8.0, seed=0, cg_iters=3)
+    ucsr = build_csr_buckets(u, i, r, 70)
+    icsr = build_csr_buckets(i, u, r, 40)
+    Um, Vm = train(ucsr, icsr, AlsConfig(**kw, cg_mode="matfree"))
+    Ud, Vd = train(ucsr, icsr, AlsConfig(**kw, cg_mode="dense"))
+    np.testing.assert_allclose(np.asarray(Um), np.asarray(Ud),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(Vm), np.asarray(Vd),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_matfree_bf16_quality_tracks_f32(rng):
+    """The sweep's bf16+cg entry runs matfree with a bfloat16 Vg: only
+    the big gathered tensor narrows — every Krylov intermediate stays
+    f32 — so training quality must track the f32 run closely."""
+    u, i, r, _, _ = make_ratings(rng, 80, 50, rank=4, density=0.3,
+                                 noise=0.05)
+    kw = dict(rank=4, max_iter=8, reg_param=0.01, seed=0, cg_iters=2)
+    ucsr = build_csr_buckets(u, i, r, 80)
+    icsr = build_csr_buckets(i, u, r, 50)
+    Uf, Vf = train(ucsr, icsr, AlsConfig(**kw, compute_dtype="float32"))
+    Ub, Vb = train(ucsr, icsr, AlsConfig(**kw, compute_dtype="bfloat16"))
+    rmse_f = _rmse(Uf, Vf, u, i, r)
+    rmse_b = _rmse(Ub, Vb, u, i, r)
+    assert rmse_b < rmse_f * 1.1 + 1e-2, (rmse_f, rmse_b)
+
+
 def test_inexact_als_sharded_matches_single_device(rng):
     import jax
 

@@ -200,13 +200,17 @@ class ALS(_ALSParams):
     def __init__(self, *, mesh=None, gatherStrategy="all_gather",
                  checkpointDir=None, resumeFrom=None,
                  fitCallback=None, fitCallbackInterval=1,
-                 dataMode="replicated", cgIters=0,
+                 dataMode="replicated", cgIters=0, cgMode="matfree",
                  **kwargs):
         super().__init__()
         self.mesh = mesh
         if int(cgIters) < 0:
             raise ValueError("cgIters must be >= 0 (0 = exact solve)")
+        if cgMode not in ("matfree", "dense"):
+            raise ValueError(f"unknown cgMode {cgMode!r} (expected "
+                             "'matfree' or 'dense')")
         self.cgIters = int(cgIters)
+        self.cgMode = cgMode
         if gatherStrategy not in ("all_gather", "ring", "all_to_all"):
             raise ValueError(
                 f"unknown gatherStrategy {gatherStrategy!r} (expected "
@@ -242,6 +246,7 @@ class ALS(_ALSParams):
             nonnegative=get("nonnegative"),
             seed=get("seed") or 0,
             cg_iters=self.cgIters,
+            cg_mode=self.cgMode,
         )
 
     def fit(self, dataset, params=None):

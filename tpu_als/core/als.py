@@ -27,6 +27,7 @@ from tpu_als.ops.solve import (
     normal_eq_explicit,
     normal_eq_implicit,
     solve_cg,
+    solve_cg_matfree,
     solve_nnls,
     solve_spd,
 )
@@ -55,24 +56,38 @@ class AlsConfig:
     # 'unfused' forces the einsum path (NNLS always uses unfused)
     solve_backend: str = "auto"
     # > 0: replace the exact per-row factorization with that many
-    # warm-started Jacobi-CG steps (ops.solve.solve_cg) — inexact ALS.
+    # warm-started Jacobi-CG steps (ops.solve) — inexact ALS.
     # The solve cost drops from r³/3 serial-recurrence work to cg_iters
     # batched MXU matvecs; the warm start is the previous ALS iterate, so
     # the outer fixed-point loop converges to the same solution.
     # Precedence: nonnegative (NNLS) > solve_backend='fused' > cg_iters.
     cg_iters: int = 0
+    # 'matfree' (default): apply A through the gathered factor rows —
+    # A·p = YtY·p + Vgᵀ((c−1) ⊙ (Vg·p)) + λn·p — so the [n, r, r]
+    # normal-equation tensor is NEVER built (kills both the NE einsum and
+    # A's HBM round-trips).  'dense': build A once, run CG on it (the
+    # A/B partner; also what the ring strategy always uses — its A is
+    # accumulated across streamed shards, which a matvec can't replay
+    # without re-streaming the ring per CG step).
+    cg_mode: str = "matfree"
 
 
-def resolve_solve_path(cfg: AlsConfig, rank):
+def resolve_solve_path(cfg: AlsConfig, rank, matfree_capable=True):
     """Which solve path the probes actually select for this config — the
     single source of truth for both the half-step dispatch and the
     benchmark's attribution fields (VERDICT r1 weak #3: record *resolved*
     backends, not requested ones).
 
     Returns a dict with ``resolved_solve_path`` ∈ {'einsum+nnls',
-    'fused_pallas', 'einsum+cg{n}_warmstart' (inexact ALS, n =
-    cfg.cg_iters), 'einsum+pallas_lanes', 'einsum+pallas_cholesky',
+    'fused_pallas', 'matfree_cg{n}_warmstart' (inexact ALS, no NE einsum;
+    n = cfg.cg_iters), 'einsum+cg{n}_warmstart' (inexact ALS on the
+    einsum-built A), 'einsum+pallas_lanes', 'einsum+pallas_cholesky',
     'einsum+xla_cholesky'} plus the raw probe outcomes.
+
+    ``matfree_capable=False``: the caller's half-step cannot apply A
+    matrix-free (the ring strategy — its A is accumulated across
+    streamed shards) — cg_mode='matfree' then RESOLVES to the dense CG
+    label, because that is what executes.
     """
     from tpu_als.ops import pallas_lanes, pallas_solve
     from tpu_als.ops.solve import auto_solve_backend
@@ -94,8 +109,11 @@ def resolve_solve_path(cfg: AlsConfig, rank):
         path = "fused_pallas"
     elif cfg.cg_iters > 0:
         # inexact ALS: no factorization, no Pallas kernel, no probe —
-        # the solve is cg_iters batched matvecs on the einsum-built A
-        path = f"einsum+cg{cfg.cg_iters}_warmstart"
+        # matfree applies A through the factor rows (no NE einsum at
+        # all); dense runs the matvecs on the einsum-built A
+        path = (f"matfree_cg{cfg.cg_iters}_warmstart"
+                if cfg.cg_mode == "matfree" and matfree_capable
+                else f"einsum+cg{cfg.cg_iters}_warmstart")
     else:
         # the same probe walk solve_spd's dispatch runs — prewarming here
         # IS the prewarm contract; the re-reads below are cache hits
@@ -155,6 +173,10 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
             "(expected 'auto', 'fused' or 'unfused')")
     fused = resolve_solve_path(cfg, r)["resolved_solve_path"] == "fused_pallas"
     cg = cfg.cg_iters > 0 and not cfg.nonnegative and not fused
+    if cfg.cg_mode not in ("matfree", "dense"):
+        raise ValueError(f"unknown cg_mode {cfg.cg_mode!r} "
+                         "(expected 'matfree' or 'dense')")
+    matfree = cg and cfg.cg_mode == "matfree"
 
     for b in buckets:
         nb, w = b.cols.shape
@@ -169,6 +191,17 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
             c, v, m, rw = args
             with jax.named_scope("gather_factors"):
                 Vg = V_comp[c]
+            if matfree:
+                # matrix-free inexact solve (ops.solve.solve_cg_matfree):
+                # A applied through Vg — neither the NE einsum nor the
+                # [chunk, r, r] tensor ever exists
+                with jax.named_scope("cg_matfree"):
+                    x0 = (prev.astype(jnp.float32)[jnp.clip(
+                        rw, 0, num_rows - 1)] if prev is not None else None)
+                    return solve_cg_matfree(
+                        Vg, v, m, cfg.reg_param,
+                        implicit=cfg.implicit_prefs, alpha=cfg.alpha,
+                        YtY=YtY, x0=x0, iters=cfg.cg_iters)
             if fused:
                 from tpu_als.ops.pallas_fused import fused_normal_solve
 

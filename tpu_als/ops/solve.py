@@ -48,6 +48,19 @@ def normal_eq_explicit(Vg, vals, mask, reg):
     return A, b, count
 
 
+def implicit_weights(vals, mask, alpha):
+    """Hu–Koren–Volinsky weighting: ``(c − 1, preference)``.
+
+    THE shared formula consumed by the dense normal-equation build
+    (:func:`normal_eq_implicit`) and the matrix-free CG operator
+    (:func:`solve_cg_matfree`) — one site, so the two solvers cannot
+    drift on the confidence/preference semantics.
+    """
+    conf_m1 = alpha * jnp.abs(vals) * mask          # c − 1, 0 in padding
+    pref = (vals > 0).astype(vals.dtype)
+    return conf_m1, pref
+
+
 def normal_eq_implicit(Vg, vals, mask, reg, alpha, YtY):
     """Normal equations for implicit-feedback ALS (Hu–Koren–Volinsky).
 
@@ -64,8 +77,7 @@ def normal_eq_implicit(Vg, vals, mask, reg, alpha, YtY):
 
     Returns ``(A [n,r,r], b [n,r], count [n])``.
     """
-    conf_m1 = alpha * jnp.abs(vals) * mask          # c - 1, zeroed in padding
-    pref = (vals > 0).astype(Vg.dtype)
+    conf_m1, pref = implicit_weights(vals, mask, alpha)
     A = jnp.einsum(
         "nw,nwr,nws->nrs", conf_m1, Vg, Vg, preferred_element_type=jnp.float32
     )
@@ -182,6 +194,39 @@ def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
     return x
 
 
+def pcg(matvec, b, diag, x0=None, iters=3):
+    """Generic batched Jacobi-preconditioned CG, fixed iterations.
+
+    ``matvec``: callable [n, r] -> [n, r] applying the (batched) SPD
+    operator; ``diag`` [n, r]: its diagonal (the Jacobi preconditioner).
+    Shared engine of :func:`solve_cg` (dense A) and the matrix-free
+    half-step path (tpu_als.core.als.local_half_step), which applies A
+    through the gathered factor rows without ever materializing the
+    [n, r, r] tensor.
+    """
+    x = jnp.zeros_like(b) if x0 is None else x0.astype(b.dtype)
+    res = b - matvec(x)
+    z = res / diag
+    p = z
+    rz = jnp.einsum("nr,nr->n", res, z)
+
+    def body(_, carry):
+        x, res, p, rz = carry
+        Ap = matvec(p)
+        denom = jnp.einsum("nr,nr->n", p, Ap)
+        alpha = rz / jnp.maximum(denom, 1e-30)
+        x = x + alpha[:, None] * p
+        res = res - alpha[:, None] * Ap
+        z = res / diag
+        rz_new = jnp.einsum("nr,nr->n", res, z)
+        beta = rz_new / jnp.maximum(rz, 1e-30)
+        p = z + beta[:, None] * p
+        return x, res, p, rz_new
+
+    x, _, _, _ = jax.lax.fori_loop(0, iters, body, (x, res, p, rz))
+    return x
+
+
 def solve_cg(A, b, count, x0=None, iters=3):
     """Batched Jacobi-preconditioned conjugate gradient, fixed iterations.
 
@@ -210,29 +255,73 @@ def solve_cg(A, b, count, x0=None, iters=3):
     A = jnp.where(empty, eye, A) + 1e-6 * eye
     diag = jnp.diagonal(A, axis1=-2, axis2=-1)          # Jacobi precond
 
-    x = jnp.zeros_like(b) if x0 is None else x0.astype(b.dtype)
-    res = b - jnp.einsum("nrs,ns->nr", A, x,
+    def matvec(p):
+        return jnp.einsum("nrs,ns->nr", A, p,
+                          preferred_element_type=jnp.float32)
+
+    return pcg(matvec, b, diag, x0=x0, iters=iters)
+
+
+def solve_cg_matfree(Vg, vals, mask, reg, implicit=False, alpha=1.0,
+                     YtY=None, x0=None, iters=3, jitter=1e-6):
+    """Matrix-free inexact solve: warm-started Jacobi-CG where A is
+    applied THROUGH the gathered factor rows —
+
+        A·p = YtY·p + Vgᵀ((c−1) ⊙ (Vg·p)) + (λn + jitter)·p
+
+    — so the [n, r, r] normal-equation tensor is never materialized: the
+    NE einsum and A's HBM round-trips both disappear; what remains per CG
+    step is two nnz-proportional contractions the MXU runs well.
+
+    ``Vg`` may be reduced precision (bfloat16): the big tensor stays
+    narrow in HBM while every reduction and every Krylov intermediate
+    accumulates in f32 (mixed-dtype einsums promote — the dense path
+    builds A once with f32 accumulation, and this path must not add
+    per-iteration bf16 rounding the dense path doesn't have).
+
+    Same weighting formulas as the dense build (:func:`implicit_weights`,
+    the ``numExplicits`` count rule) and same cold-row contract as
+    :func:`solve_spd`: rows with count 0 act as A := I, b = 0, landing
+    exactly on x = 0 from any warm start.
+    """
+    dt = Vg.dtype
+    mA = mask.astype(dt)
+    vA = vals.astype(dt)
+    if implicit:
+        w_conf, pref = implicit_weights(vA, mA, alpha)
+        rhs = jnp.einsum("nw,nwr->nr", (1.0 + w_conf) * pref * mA, Vg,
                          preferred_element_type=jnp.float32)
-    z = res / diag
-    p = z
-    rz = jnp.einsum("nr,nr->n", res, z)
+        count = jnp.sum(pref.astype(jnp.float32) * mask, axis=-1)
+    else:
+        w_conf = mA
+        rhs = jnp.einsum("nw,nwr->nr", vA * mA, Vg,
+                         preferred_element_type=jnp.float32)
+        count = jnp.sum(mask, axis=-1)
+    rhs = rhs.astype(jnp.float32)
+    w32 = w_conf.astype(jnp.float32)
+    ridge = (reg * count + jitter)[:, None]
+    empty = (count <= 0)[:, None]
+    diag = jnp.einsum("nw,nwr->nr", w_conf, Vg * Vg,
+                      preferred_element_type=jnp.float32) + ridge
+    YtYf = YtY.astype(jnp.float32) if implicit else None
+    if YtYf is not None:
+        diag = diag + jnp.diagonal(YtYf)[None, :]
+    diag = jnp.where(empty, 1.0, diag)
 
-    def body(_, carry):
-        x, res, p, rz = carry
-        Ap = jnp.einsum("nrs,ns->nr", A, p,
+    def matvec(p):
+        # mixed-dtype einsums: p/t stay f32, only Vg is (possibly) bf16
+        t = jnp.einsum("nwr,nr->nw", Vg, p,
+                       preferred_element_type=jnp.float32)
+        mv = jnp.einsum("nw,nwr->nr", w32 * t, Vg,
                         preferred_element_type=jnp.float32)
-        denom = jnp.einsum("nr,nr->n", p, Ap)
-        alpha = rz / jnp.maximum(denom, 1e-30)
-        x = x + alpha[:, None] * p
-        res = res - alpha[:, None] * Ap
-        z = res / diag
-        rz_new = jnp.einsum("nr,nr->n", res, z)
-        beta = rz_new / jnp.maximum(rz, 1e-30)
-        p = z + beta[:, None] * p
-        return x, res, p, rz_new
+        mv = mv + ridge * p
+        if YtYf is not None:
+            mv = mv + p @ YtYf
+        # empty rows (chunk padding / cold entities): A := I so CG lands
+        # exactly on x = 0 (their b is 0)
+        return jnp.where(empty, p, mv)
 
-    x, _, _, _ = jax.lax.fori_loop(0, iters, body, (x, res, p, rz))
-    return x
+    return pcg(matvec, rhs, diag, x0=x0, iters=iters)
 
 
 @functools.partial(jax.jit, static_argnames=("sweeps",))

@@ -62,16 +62,17 @@ def _check_shard_containers(mesh, user_sharded, item_sharded):
             )
 
 
-def _prewarm(cfg: AlsConfig):
+def _prewarm(cfg: AlsConfig, matfree_capable=True):
     """Probe the solve kernels EAGERLY in every step *builder*: a probe
     firing inside the shard_map jit trace cannot run, and the jit cache
     would pin the XLA fallback path for the compiled step's lifetime
     (tpu_als.utils.platform.probe_kernel).  Lives here — not only in
     train_sharded — so callers driving the builders directly get the
-    same guarantee."""
+    same guarantee.  ``matfree_capable=False`` = the ring builder, whose
+    solve cannot run matrix-free (attribution resolves to dense CG)."""
     from tpu_als.core.als import resolve_solve_path
 
-    resolve_solve_path(cfg, cfg.rank)
+    resolve_solve_path(cfg, cfg.rank, matfree_capable=matfree_capable)
 
 
 def make_sharded_step(mesh, user_sharded, item_sharded, cfg: AlsConfig):
@@ -136,7 +137,7 @@ def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig):
     per_i = item_ring.rows_per_shard
     u_chunk = user_ring.chunk_elems
     i_chunk = item_ring.chunk_elems
-    _prewarm(cfg)
+    _prewarm(cfg, matfree_capable=False)
 
     def step_body(U_loc, V_loc, ubuckets, ibuckets, ucounts, icounts):
         ubuckets = _squeeze0(ubuckets)

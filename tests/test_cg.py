@@ -187,6 +187,31 @@ def test_inexact_als_sharded_matches_single_device(rng):
                                rtol=2e-3, atol=2e-3)
 
 
+def test_cg_knobs_persist_and_gate_resume(rng, tmp_path):
+    """cgIters/cgMode travel with estimator saves, and a resume that
+    switches solver (inexact -> exact) is rejected — the trajectory the
+    checkpoint froze cannot be reproduced by a different solver."""
+    import os
+
+    from tpu_als.api.estimator import ALS
+    from tpu_als.utils.frame import ColumnarFrame
+
+    u, i, r, _, _ = make_ratings(rng, 50, 30, rank=3, density=0.4)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+
+    est_dir = str(tmp_path / "est")
+    ALS(rank=3, maxIter=4, cgIters=2, cgMode="dense").save(est_dir)
+    got = ALS.load(est_dir)
+    assert got.cgIters == 2 and got.cgMode == "dense"
+
+    ck = str(tmp_path / "ck")
+    ALS(rank=3, maxIter=2, cgIters=2, checkpointDir=ck,
+        checkpointInterval=2, seed=0).fit(frame)
+    with pytest.raises(ValueError, match="cgIters"):
+        ALS(rank=3, maxIter=4, cgIters=0, seed=0,
+            resumeFrom=os.path.join(ck, "als_checkpoint")).fit(frame)
+
+
 def test_estimator_cg_knob(rng):
     from tpu_als.api.estimator import ALS
     from tpu_als.utils.frame import ColumnarFrame

@@ -192,9 +192,15 @@ class ALS(_ALSParams):
     space is agreed via ``multihost.global_id_union`` and the triples are
     redistributed inside ``train_multihost``);
     ``cgIters`` — > 0 replaces the exact per-row solve with that many
-    warm-started conjugate-gradient steps (inexact ALS,
-    ``ops.solve.solve_cg``): the r³ factorization becomes a few batched
-    MXU matvecs; 0 (default) keeps the exact batched Cholesky.
+    warm-started conjugate-gradient steps (inexact ALS): the r³
+    factorization becomes a few batched MXU matvecs; 0 (default) keeps
+    the exact batched Cholesky;
+    ``cgMode`` — ``'matfree'`` (default: ``ops.solve.solve_cg_matfree``
+    applies the normal equations through the gathered factor rows, never
+    materializing the [n, r, r] tensor) or ``'dense'``
+    (``ops.solve.solve_cg`` on the einsum-built tensor); the ring
+    strategy always solves dense (its A accumulates across streamed
+    shards).
     """
 
     def __init__(self, *, mesh=None, gatherStrategy="all_gather",
@@ -347,10 +353,14 @@ class ALS(_ALSParams):
                 raise ValueError("resumeFrom checkpoint id maps do not match "
                                  "the dataset being fit")
             # exact recovery requires identical solver hyperparameters too
+            # (cgIters/cgMode change the trajectory: inexact ALS resumes
+            # must continue with the same solver)
             ck = manifest.get("params", {})
-            for name in ("regParam", "implicitPrefs", "alpha", "nonnegative"):
+            for name in ("regParam", "implicitPrefs", "alpha", "nonnegative",
+                         "cgIters", "cgMode"):
                 if name in ck:
-                    mine = self.getOrDefault(self.getParam(name))
+                    mine = (getattr(self, name) if name.startswith("cg")
+                            else self.getOrDefault(self.getParam(name)))
                     if ck[name] != mine:
                         raise ValueError(
                             f"resumeFrom checkpoint was trained with "
@@ -478,11 +488,16 @@ class ALS(_ALSParams):
     def _make_model(self, user_map, item_map, U, V):
         """Model assembly shared by ``fit`` and the multi-process CLI
         path (tpu_als.cli) — one place for the params snapshot."""
+        params = {p.name: v for p, v in self.extractParamMap().items()}
+        # record which solver produced the factors (trajectory-changing
+        # knobs — same reason checkpoints persist them)
+        params["cgIters"] = self.cgIters
+        params["cgMode"] = self.cgMode
         return ALSModel(
             rank=self.getOrDefault(self.getParam("rank")),
             user_map=user_map, item_map=item_map,
             user_factors=U, item_factors=V,
-            params={p.name: v for p, v in self.extractParamMap().items()},
+            params=params,
             parent=self,
         )
 
@@ -508,6 +523,10 @@ class ALS(_ALSParams):
             "defaultParamMap": {p.name: v
                                 for p, v in self._defaultParamMap.items()},
             "gatherStrategy": self.gatherStrategy,
+            # algorithm-affecting runtime knobs travel with the estimator
+            # (unlike process-bound ones: mesh, callbacks, dataMode)
+            "cgIters": self.cgIters,
+            "cgMode": self.cgMode,
         }
         tmp = os.path.join(path, "estimator.json.tmp")
         with open(tmp, "w") as f:
@@ -526,7 +545,9 @@ class ALS(_ALSParams):
             raise ValueError(
                 f"{path} holds a {meta.get('class')!r} save, not an ALS "
                 "estimator")
-        est = cls(gatherStrategy=meta.get("gatherStrategy", "all_gather"))
+        est = cls(gatherStrategy=meta.get("gatherStrategy", "all_gather"),
+                  cgIters=meta.get("cgIters", 0),
+                  cgMode=meta.get("cgMode", "matfree"))
         # restore saved defaults too (DefaultParamsReader semantics): a
         # class default that changed after the save must not silently
         # apply to the loaded instance
@@ -538,10 +559,15 @@ class ALS(_ALSParams):
     def _save_checkpoint(self, user_map, item_map, iteration, U, V):
         import os
 
+        params = {p.name: v for p, v in self.extractParamMap().items()}
+        # the cg knobs change the training trajectory — persist them so
+        # the resume-compatibility check can reject a solver switch
+        params["cgIters"] = self.cgIters
+        params["cgMode"] = self.cgMode
         save_factors(
             os.path.join(self.checkpointDir, "als_checkpoint"),
             user_map.ids, np.asarray(U), item_map.ids, np.asarray(V),
-            params={p.name: v for p, v in self.extractParamMap().items()},
+            params=params,
             iteration=iteration,
         )
 

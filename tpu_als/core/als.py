@@ -191,13 +191,19 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
             c, v, m, rw = args
             with jax.named_scope("gather_factors"):
                 Vg = V_comp[c]
+            # warm start for the inexact (CG) solvers: the solved side's
+            # current rows.  Padding rows (index num_rows) clip to a real
+            # row's stale value, but their count is 0 so CG drives them
+            # to 0 and the scatter drops them anyway.  One site for both
+            # CG modes so their trajectories cannot diverge.
+            x0 = None
+            if cg and prev is not None:
+                x0 = prev.astype(jnp.float32)[jnp.clip(rw, 0, num_rows - 1)]
             if matfree:
                 # matrix-free inexact solve (ops.solve.solve_cg_matfree):
                 # A applied through Vg — neither the NE einsum nor the
                 # [chunk, r, r] tensor ever exists
                 with jax.named_scope("cg_matfree"):
-                    x0 = (prev.astype(jnp.float32)[jnp.clip(
-                        rw, 0, num_rows - 1)] if prev is not None else None)
                     return solve_cg_matfree(
                         Vg, v, m, cfg.reg_param,
                         implicit=cfg.implicit_prefs, alpha=cfg.alpha,
@@ -231,12 +237,6 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
                 if cfg.nonnegative:
                     return solve_nnls(A, rhs, count, sweeps=cfg.nnls_sweeps)
                 if cg:
-                    # padding rows (index num_rows) clip to a real row's
-                    # stale value, but their count is 0 so CG drives them
-                    # to 0 and the scatter drops them anyway
-                    x0 = (prev.astype(jnp.float32)[jnp.clip(rw, 0,
-                                                            num_rows - 1)]
-                          if prev is not None else None)
                     return solve_cg(A, rhs, count, x0=x0,
                                     iters=cfg.cg_iters)
                 return solve_spd(A, rhs, count)

@@ -130,6 +130,43 @@ def test_legacy_mllib_api(rng):
     assert np.isfinite(model2.predict(int(u[0]), int(i[0])))
 
 
+def test_legacy_bulk_recommenders(rng):
+    """The bulk legacy surface (recommendProductsForUsers /
+    recommendUsersForProducts / recommendUsers) — iterates the structured
+    recommendations column exactly like the reference's RDD-of-Rating
+    shape (SURVEY.md §2.B2/§2.B6)."""
+    from tpu_als.api.legacy import ALS as LegacyALS, Rating
+
+    u, i, r, _, _ = make_ratings(rng, 25, 15, rank=2, density=0.5)
+    ratings = [Rating(int(a), int(b), float(c)) for a, b, c in zip(u, i, r)]
+    model = LegacyALS.train(ratings, rank=3, iterations=4, seed=0)
+
+    per_user = dict(model.recommendProductsForUsers(4))
+    assert set(per_user) == {int(x) for x in np.unique(u)}
+    for uid, rs in per_user.items():
+        assert len(rs) == 4
+        assert all(isinstance(x, Rating) and x.user == uid for x in rs)
+        scores = [x.rating for x in rs]
+        assert scores == sorted(scores, reverse=True)
+
+    per_item = dict(model.recommendUsersForProducts(3))
+    assert set(per_item) == {int(x) for x in np.unique(i)}
+    for pid, rs in per_item.items():
+        assert len(rs) == 3
+        assert all(x.product == pid for x in rs)
+        scores = [x.rating for x in rs]
+        assert scores == sorted(scores, reverse=True)
+
+    ru = model.recommendUsers(int(i[0]), 5)
+    assert len(ru) == 5 and all(x.product == int(i[0]) for x in ru)
+    ru_scores = [x.rating for x in ru]
+    assert ru_scores == sorted(ru_scores, reverse=True)
+    # both bulk views must agree with the subset call for a sample user
+    uid = int(u[0])
+    direct = model.recommendProducts(uid, 4)
+    assert [x.product for x in per_user[uid]] == [x.product for x in direct]
+
+
 def test_legacy_save_load(rng, tmp_path):
     from tpu_als.api.legacy import ALS as LegacyALS, MatrixFactorizationModel, Rating
 

@@ -27,7 +27,36 @@ if __name__ == "__main__":
 
     _, _pcount = init_distributed()
     assert _pcount == 2, f"expected a 2-process rendezvous, got {_pcount}"
-    if os.environ.get("MH_MODE") == "fit_ckpt":
+    if os.environ.get("MH_MODE") == "fit_ckpt_sharded":
+        # shard-per-process checkpointing: no cross-host factor gather on
+        # the checkpoint path; a resume from the sharded directory must
+        # reproduce the uninterrupted run
+        import numpy as np
+
+        from tpu_als import ALS
+        from tpu_als.io.movielens import synthetic_movielens
+        from tpu_als.parallel.mesh import make_mesh
+
+        frame = synthetic_movielens(80, 30, 1500, seed=2)
+        ckdir = os.environ["MH_OUT"] + ".ckpt"
+        ALS(rank=3, maxIter=2, regParam=0.02, seed=0, mesh=make_mesh(),
+            checkpointDir=ckdir, checkpointInterval=2,
+            checkpointSharded=True).fit(frame)
+        ckpt = os.path.join(ckdir, "als_checkpoint")
+        import json
+
+        with open(os.path.join(ckpt, "manifest.json")) as f:
+            assert json.load(f)["sharded"] is True
+        resumed = ALS(rank=3, maxIter=4, regParam=0.02, seed=0,
+                      mesh=make_mesh(), resumeFrom=ckpt).fit(frame)
+        straight = ALS(rank=3, maxIter=4, regParam=0.02, seed=0,
+                       mesh=make_mesh()).fit(frame)
+        if jax.process_index() == 0:
+            np.savez(os.environ["MH_OUT"] + ".ckpt.npz",
+                     Ur=resumed._U, Vr=resumed._V,
+                     Us=straight._U, Vs=straight._V)
+        print("sharded ckpt worker done", flush=True)
+    elif os.environ.get("MH_MODE") == "fit_ckpt":
         # multi-process checkpoint -> resume == uninterrupted run
         import numpy as np
 

@@ -383,18 +383,64 @@ def test_ring_grid_positions_build_matches_slice(rng):
             np.testing.assert_array_equal(bl.mask, bf.mask)
 
 
-def test_two_process_checkpoint_resume(tmp_path):
-    """Multi-process fit writes checkpoints (collective gather, process-0
-    write) and a resumed run reproduces the uninterrupted one."""
+def test_sharded_checkpoint_roundtrip(rng, tmp_path):
+    """save_checkpoint_sharded + load_factors: per-position shard files
+    must reassemble to exactly the entity-space factors a gather would
+    produce, through the standard load path (same return contract as the
+    replicated format)."""
+    from tpu_als.core.als import AlsConfig
+    from tpu_als.core.ratings import IdMap
+    from tpu_als.io.checkpoint import load_factors
+    from tpu_als.parallel.data import shard_csr
+    from tpu_als.parallel.multihost import save_checkpoint_sharded
+    from tpu_als.parallel.trainer import train_sharded
+
+    nU, nI, nnz, D = 50, 30, 600, 8
+    u = rng.integers(0, nU, nnz)
+    i = rng.integers(0, nI, nnz)
+    r = np.abs(rng.normal(size=nnz)).astype(np.float32) + 0.1
+    upart = partition_balanced(np.bincount(u, minlength=nU), D)
+    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
+    mesh = make_mesh(D)
+    cfg = AlsConfig(rank=5, max_iter=2, reg_param=0.05, seed=0)
+    Us, Vs = train_sharded(
+        mesh, upart, ipart,
+        shard_csr(upart, ipart, u, i, r, min_width=4),
+        shard_csr(ipart, upart, i, u, r, min_width=4), cfg)
+
+    user_map = IdMap(ids=np.arange(nU))
+    item_map = IdMap(ids=np.arange(nI))
+    path = str(tmp_path / "ck")
+    save_checkpoint_sharded(path, Us, Vs, upart, ipart, user_map,
+                            item_map, mesh, params={"regParam": 0.05},
+                            iteration=2)
+    manifest, uids, U, iids, V = load_factors(path)
+    assert manifest["sharded"] and manifest["iteration"] == 2
+    np.testing.assert_array_equal(uids, user_map.ids)
+    np.testing.assert_allclose(U, np.asarray(Us)[upart.slot], rtol=0,
+                               atol=0)
+    np.testing.assert_allclose(V, np.asarray(Vs)[ipart.slot], rtol=0,
+                               atol=0)
+    # overwrite path: a second save must swap atomically, old removed
+    save_checkpoint_sharded(path, Us, Vs, upart, ipart, user_map,
+                            item_map, mesh, iteration=3)
+    manifest2, _, U2, _, _ = load_factors(path)
+    assert manifest2["iteration"] == 3
+    np.testing.assert_array_equal(U2, U)
+
+
+@pytest.mark.parametrize("mode", ["fit_ckpt", "fit_ckpt_sharded"])
+def test_two_process_checkpoint_resume(tmp_path, mode):
+    """Multi-process fit writes checkpoints and a resumed run reproduces
+    the uninterrupted one — for both formats: replicated (collective
+    gather, process-0 write) and sharded (each process writes its own
+    factor shards, NO cross-host factor bytes)."""
     import os
-    import socket
-    import subprocess
-    import sys
 
     worker = os.path.join(os.path.dirname(__file__),
                           "_multihost_cli_worker.py")
     out = str(tmp_path / "ck")
-    _spawn_two_procs(worker, {"MH_OUT": out, "MH_MODE": "fit_ckpt"})
+    _spawn_two_procs(worker, {"MH_OUT": out, "MH_MODE": mode})
     dat = np.load(out + ".ckpt.npz")
     np.testing.assert_allclose(dat["Ur"], dat["Us"], rtol=5e-4, atol=5e-4)
     np.testing.assert_allclose(dat["Vr"], dat["Vs"], rtol=5e-4, atol=5e-4)

@@ -200,13 +200,20 @@ class ALS(_ALSParams):
     materializing the [n, r, r] tensor) or ``'dense'``
     (``ops.solve.solve_cg`` on the einsum-built tensor); the ring
     strategy always solves dense (its A accumulates across streamed
-    shards).
+    shards);
+    ``checkpointSharded`` — multi-process fits only: each process writes
+    its own factor shards (``multihost.save_checkpoint_sharded``) instead
+    of gathering full factors to process 0 per checkpoint — the O(N·r)
+    cross-host gather disappears from the checkpoint path; resume reads
+    the sharded directory transparently.  Single-process fits ignore the
+    knob (they hold entity-space factors already).
     """
 
     def __init__(self, *, mesh=None, gatherStrategy="all_gather",
                  checkpointDir=None, resumeFrom=None,
                  fitCallback=None, fitCallbackInterval=1,
                  dataMode="replicated", cgIters=0, cgMode="matfree",
+                 checkpointSharded=False,
                  **kwargs):
         super().__init__()
         self.mesh = mesh
@@ -232,6 +239,7 @@ class ALS(_ALSParams):
         self.fitCallback = fitCallback
         self.fitCallbackInterval = int(fitCallbackInterval)
         self.dataMode = dataMode
+        self.checkpointSharded = bool(checkpointSharded)
         self.setParams(**kwargs)
 
     def setParams(self, **kwargs):
@@ -303,13 +311,14 @@ class ALS(_ALSParams):
                      int(self.fitCallback is not None),
                      self.fitCallbackInterval,
                      int(ckpt_on), interval,
+                     int(self.checkpointSharded),
                      self.getMaxIter()], dtype=np.int64)))
                 if not (gate == gate[0]).all():
                     raise ValueError(
                         "processes disagree on multi-process fit config "
                         "(dataMode, fitCallback present, "
                         "fitCallbackInterval, checkpointing, "
-                        "checkpointInterval, maxIter): "
+                        "checkpointInterval, checkpointSharded, maxIter): "
                         f"{gate.tolist()} — pass the SAME knobs on every "
                         "process (peers may use an inert callback; only "
                         "process 0's is invoked)")
@@ -399,14 +408,33 @@ class ALS(_ALSParams):
 
                 # observer/dataMode agreement was checked by the gate at
                 # the top of fit — the FIRST collective on every path —
-                # so mp_cb's collective gathers below fire in lockstep
+                # so mp_cb's collectives below fire in lockstep
                 mp_cb = None
                 last_gather = {}  # iteration -> (Ue, Ve); reused below so
                 # a final-iteration gather isn't repeated after training
                 # (the most expensive end-of-training collective)
                 if callback is not None:
                     def mp_cb(iteration, Us, Vs, up, ip):
-                        if not any(self._due(iteration)):
+                        due_cb, due_ck = self._due(iteration)
+                        if due_ck and self.checkpointSharded:
+                            # factor bytes never cross hosts: each
+                            # process writes its own shards (barriers
+                            # inside); the gather below then happens
+                            # only when the callback needs it
+                            import os
+
+                            from tpu_als.parallel.multihost import (
+                                save_checkpoint_sharded,
+                            )
+
+                            save_checkpoint_sharded(
+                                os.path.join(self.checkpointDir,
+                                             "als_checkpoint"),
+                                Us, Vs, up, ip, user_map, item_map,
+                                self.mesh, params=self._ckpt_params(),
+                                iteration=iteration)
+                            due_ck = False
+                        if not (due_cb or due_ck):
                             return
                         # the gathers are collective: EVERY process runs
                         # them; only process 0 observes the result
@@ -415,9 +443,13 @@ class ALS(_ALSParams):
                         last_gather.clear()
                         last_gather[iteration] = (Ue, Ve)
                         if jax.process_index() == 0:
-                            # the shared single-process callback: same
-                            # gating (_due), same save/invoke logic
-                            callback(iteration, Ue, Ve)
+                            # same primitives the single-process callback
+                            # composes, gated by the shared _due rule
+                            if due_cb and self.fitCallback is not None:
+                                self.fitCallback(iteration, Ue, Ve)
+                            if due_ck:
+                                self._save_checkpoint(
+                                    user_map, item_map, iteration, Ue, Ve)
 
                 Us, Vs, upart, ipart = train_multihost(
                     u_idx, i_idx, r, len(user_map), len(item_map), cfg,
@@ -488,16 +520,13 @@ class ALS(_ALSParams):
     def _make_model(self, user_map, item_map, U, V):
         """Model assembly shared by ``fit`` and the multi-process CLI
         path (tpu_als.cli) — one place for the params snapshot."""
-        params = {p.name: v for p, v in self.extractParamMap().items()}
-        # record which solver produced the factors (trajectory-changing
-        # knobs — same reason checkpoints persist them)
-        params["cgIters"] = self.cgIters
-        params["cgMode"] = self.cgMode
         return ALSModel(
             rank=self.getOrDefault(self.getParam("rank")),
             user_map=user_map, item_map=item_map,
             user_factors=U, item_factors=V,
-            params=params,
+            # records which solver produced the factors (trajectory-
+            # changing knobs — same snapshot checkpoints persist)
+            params=self._ckpt_params(),
             parent=self,
         )
 
@@ -556,18 +585,22 @@ class ALS(_ALSParams):
         est.setParams(**meta.get("paramMap", {}))
         return est
 
+    def _ckpt_params(self):
+        """The params snapshot persisted with checkpoints and models —
+        Param map plus the trajectory-changing runtime knobs, so the
+        resume-compatibility check can reject a solver switch."""
+        params = {p.name: v for p, v in self.extractParamMap().items()}
+        params["cgIters"] = self.cgIters
+        params["cgMode"] = self.cgMode
+        return params
+
     def _save_checkpoint(self, user_map, item_map, iteration, U, V):
         import os
 
-        params = {p.name: v for p, v in self.extractParamMap().items()}
-        # the cg knobs change the training trajectory — persist them so
-        # the resume-compatibility check can reject a solver switch
-        params["cgIters"] = self.cgIters
-        params["cgMode"] = self.cgMode
         save_factors(
             os.path.join(self.checkpointDir, "als_checkpoint"),
             user_map.ids, np.asarray(U), item_map.ids, np.asarray(V),
-            params=params,
+            params=self._ckpt_params(),
             iteration=iteration,
         )
 

@@ -16,20 +16,52 @@ import os
 
 import numpy as np
 
-FORMAT_VERSION = 1
+# 1 = replicated layout (user_factors.npz / item_factors.npz);
+# 2 = shard-per-process layout (user_shard_*.npz + slots.npz, written by
+#     tpu_als.parallel.multihost.save_checkpoint_sharded).
+# FORMAT_VERSION is the NEWEST layout this build reads: a build predating
+# the sharded layout carries FORMAT_VERSION 1, so a sharded manifest's
+# format_version 2 fails there with the designed "newer than this build"
+# error instead of a bare FileNotFoundError.
+REPLICATED_FORMAT = 1
+SHARDED_FORMAT = 2
+FORMAT_VERSION = 2
+
+
+def atomic_install(tmp, path):
+    """Install a fully-written ``tmp`` directory at ``path``: rename any
+    old save aside, install, delete the old.  A complete save exists at
+    ``path`` or ``path + '.old'`` at every instant; :func:`load_factors`
+    falls back to ``.old`` if a crash hit the window between the renames.
+    THE swap shared by both checkpoint formats — the ``.old`` contract
+    must never diverge between them."""
+    import shutil
+
+    old = path + ".old"
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    if os.path.exists(path):
+        os.rename(path, old)
+    os.rename(tmp, path)
+    if os.path.exists(old):
+        shutil.rmtree(old)
 
 
 def save_factors(path, user_ids, user_factors, item_ids, item_factors,
                  params=None, iteration=None, extra=None):
     """Write a checkpoint/model directory (atomic via tmp+rename)."""
+    import shutil
+
     tmp = path + ".tmp"
-    os.makedirs(tmp, exist_ok=True)
+    if os.path.exists(tmp):  # stale leftovers from a crashed attempt
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
     np.savez(os.path.join(tmp, "user_factors.npz"),
              ids=np.asarray(user_ids), factors=np.asarray(user_factors))
     np.savez(os.path.join(tmp, "item_factors.npz"),
              ids=np.asarray(item_ids), factors=np.asarray(item_factors))
     manifest = {
-        "format_version": FORMAT_VERSION,
+        "format_version": REPLICATED_FORMAT,
         "rank": int(np.asarray(user_factors).shape[1]),
         "num_users": int(np.asarray(user_factors).shape[0]),
         "num_items": int(np.asarray(item_factors).shape[0]),
@@ -39,19 +71,7 @@ def save_factors(path, user_ids, user_factors, item_ids, item_factors,
     }
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
-    # swap so a complete checkpoint exists at `path` or `path.old` at every
-    # instant: rename old aside, install new, then delete old.  load_factors
-    # falls back to `.old` if a crash hit the window between the renames.
-    old = path + ".old"
-    import shutil
-
-    if os.path.exists(old):
-        shutil.rmtree(old)
-    if os.path.exists(path):
-        os.rename(path, old)
-    os.rename(tmp, path)
-    if os.path.exists(old):
-        shutil.rmtree(old)
+    atomic_install(tmp, path)
 
 
 def load_factors(path):
@@ -69,6 +89,30 @@ def load_factors(path):
             f"checkpoint format {manifest['format_version']} is newer than "
             f"this build supports ({FORMAT_VERSION})"
         )
+    if manifest.get("sharded"):
+        # shard-per-process layout (multihost.save_checkpoint_sharded):
+        # reassemble slot space from the per-position files, then map to
+        # entity space through the saved slot arrays — same return
+        # contract as the replicated format
+        slots = np.load(os.path.join(path, "slots.npz"),
+                        allow_pickle=False)
+        rank = int(manifest["rank"])
+        D = int(manifest["n_shards"])
+
+        def side(name, rps, slot):
+            full = np.zeros((D * rps, rank), dtype=np.float32)
+            for pos in range(D):
+                f = np.load(os.path.join(
+                    path, f"{name}_shard_{pos:05d}.npz"),
+                    allow_pickle=False)
+                full[pos * rps:(pos + 1) * rps] = f["factors"]
+            return full[slot]
+
+        U = side("user", int(manifest["rows_per_shard_user"]),
+                 slots["user_slot"])
+        V = side("item", int(manifest["rows_per_shard_item"]),
+                 slots["item_slot"])
+        return manifest, slots["user_ids"], U, slots["item_ids"], V
     u = np.load(os.path.join(path, "user_factors.npz"), allow_pickle=False)
     i = np.load(os.path.join(path, "item_factors.npz"), allow_pickle=False)
     return manifest, u["ids"], u["factors"], i["ids"], i["factors"]

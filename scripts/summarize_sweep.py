@@ -1,0 +1,72 @@
+"""Summarize sweep_logs/ into a BASELINE-ready table.
+
+Each sweep step (scripts/sweep_tpu.sh) writes ``<name>.out`` whose last
+line is bench.py's JSON contract (or ablate/kernel_lab free text).  This
+parses every ``.out``, extracts the JSON line when present, and prints a
+compact table: value, unit, vs_baseline, seconds/iter, resolved solve
+path, error — so updating BASELINE.md from a finished sweep is a read,
+not an archaeology session.
+
+Usage: python scripts/summarize_sweep.py [sweep_logs_dir]
+"""
+
+import glob
+import json
+import os
+import sys
+
+
+def last_json_line(path):
+    try:
+        with open(path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        if ln.startswith("{"):
+            try:
+                return json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main():
+    d = sys.argv[1] if len(sys.argv) > 1 else "sweep_logs"
+    outs = sorted(glob.glob(os.path.join(d, "*.out")))
+    if not outs:
+        print(f"no .out files under {d!r} — sweep has not run")
+        return
+    rows = []
+    for path in outs:
+        name = os.path.basename(path)[:-4]
+        j = last_json_line(path)
+        if j is None:
+            tail = ""
+            try:
+                with open(path) as f:
+                    lines = [ln.strip() for ln in f if ln.strip()]
+                tail = lines[-1][:60] if lines else "(empty)"
+            except OSError:
+                tail = "(unreadable)"
+            rows.append((name, "-", "-", "-", "-", tail))
+            continue
+        cfgd = j.get("config") or {}
+        rows.append((
+            name,
+            "ERR" if j.get("error") else f"{j.get('value')}",
+            j.get("unit", "-"),
+            f"{j.get('vs_baseline')}" if j.get("vs_baseline") else "-",
+            f"{cfgd.get('seconds_per_iter', '-')}",
+            (j.get("error") or cfgd.get("resolved_solve_path", ""))[:60],
+        ))
+    w = [max(len(r[k]) for r in rows + [("step", "value", "unit",
+                                         "vs_base", "s/iter", "note")])
+         for k in range(6)]
+    hdr = ("step", "value", "unit", "vs_base", "s/iter", "note")
+    for r in [hdr] + rows:
+        print("  ".join(str(x).ljust(w[k]) for k, x in enumerate(r)))
+
+
+if __name__ == "__main__":
+    main()

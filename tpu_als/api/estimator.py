@@ -306,19 +306,33 @@ class ALS(_ALSParams):
                 interval = self.getCheckpointInterval()
                 ckpt_on = (self.checkpointDir is not None
                            and interval >= 1)
+                # with sharded checkpoints every peer's checkpointDir is
+                # load-bearing (each writes its own shard files); a
+                # divergent path would install a checkpoint silently
+                # missing shards — include a digest of the resolved dir
+                ckdir_digest = 0
+                if self.checkpointSharded and self.checkpointDir:
+                    import hashlib
+                    import os as _os
+
+                    h = hashlib.blake2b(
+                        _os.path.abspath(self.checkpointDir).encode(),
+                        digest_size=8).digest()
+                    ckdir_digest = int(np.frombuffer(h, dtype=np.int64)[0])
                 gate = np.asarray(mhu.process_allgather(np.array(
                     [int(self.dataMode == "per_host"),
                      int(self.fitCallback is not None),
                      self.fitCallbackInterval,
                      int(ckpt_on), interval,
-                     int(self.checkpointSharded),
+                     int(self.checkpointSharded), ckdir_digest,
                      self.getMaxIter()], dtype=np.int64)))
                 if not (gate == gate[0]).all():
                     raise ValueError(
                         "processes disagree on multi-process fit config "
                         "(dataMode, fitCallback present, "
                         "fitCallbackInterval, checkpointing, "
-                        "checkpointInterval, checkpointSharded, maxIter): "
+                        "checkpointInterval, checkpointSharded, "
+                        "checkpointDir digest, maxIter): "
                         f"{gate.tolist()} — pass the SAME knobs on every "
                         "process (peers may use an inert callback; only "
                         "process 0's is invoked)")

@@ -56,7 +56,8 @@ def main():
             name,
             "ERR" if j.get("error") else f"{j.get('value')}",
             j.get("unit", "-"),
-            f"{j.get('vs_baseline')}" if j.get("vs_baseline") else "-",
+            ("-" if j.get("vs_baseline") is None
+             else f"{j.get('vs_baseline')}"),
             f"{cfgd.get('seconds_per_iter', '-')}",
             (j.get("error") or cfgd.get("resolved_solve_path", ""))[:60],
         ))
@@ -69,4 +70,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BrokenPipeError:  # piped into head — not an error
+        pass

@@ -108,6 +108,24 @@ def test_original_ids_roundtrip(rng):
     assert set(uf["id"].tolist()) == {55, 100, 2000}
 
 
+def test_transform_chunked_equals_single_call(rng, monkeypatch):
+    """Frames above the scoring chunk stream in fixed-shape blocks (one
+    jit specialization, padded tail); predictions must equal the
+    single-call path bit-for-bit, cold rows included."""
+    frame = small_frame(rng)
+    model = ALS(rank=3, maxIter=3, seed=0).fit(frame)
+    users = np.concatenate([np.asarray(frame["user"]),
+                            np.array([10 ** 7])])  # one cold row
+    items = np.concatenate([np.asarray(frame["item"]),
+                            np.array([0])])
+    big = ColumnarFrame({"user": users, "item": items})
+    whole = np.asarray(model.transform(big)["prediction"])
+    monkeypatch.setattr(type(model), "_TRANSFORM_CHUNK", 7)
+    chunked = np.asarray(model.transform(big)["prediction"])
+    np.testing.assert_array_equal(chunked, whole)
+    assert np.isnan(chunked[-1])  # cold row survives chunking as NaN
+
+
 def test_recommend_for_all_users(rng):
     frame = small_frame(rng)
     model = ALS(rank=3, maxIter=4, seed=2).fit(frame)

@@ -428,6 +428,16 @@ def test_sharded_checkpoint_roundtrip(rng, tmp_path):
     assert manifest2["iteration"] == 3
     np.testing.assert_array_equal(U2, U)
 
+    # crash window of atomic_install (old renamed aside, new not yet
+    # installed): the sharded format must honor the same .old fallback
+    # contract as the replicated one
+    import os
+
+    os.rename(path, path + ".old")
+    manifest3, _, U3, _, _ = load_factors(path)
+    assert manifest3["sharded"] and manifest3["iteration"] == 3
+    np.testing.assert_array_equal(U3, U)
+
 
 @pytest.mark.parametrize("mode", ["fit_ckpt", "fit_ckpt_sharded"])
 def test_two_process_checkpoint_resume(tmp_path, mode):

@@ -311,7 +311,7 @@ class ALS(_ALSParams):
                 # divergent path would install a checkpoint silently
                 # missing shards — include a digest of the resolved dir
                 ckdir_digest = 0
-                if self.checkpointSharded and self.checkpointDir:
+                if self.checkpointSharded and ckpt_on and self.checkpointDir:
                     import hashlib
                     import os as _os
 
@@ -683,17 +683,37 @@ class ALSModel:
             "features": _to_object_rows(self._V),
         })
 
+    # scoring chunk for transform: bounds the per-call gather at
+    # ~chunk × rank device elements regardless of frame size, with ONE
+    # jit specialization (the tail chunk pads with invalid ids)
+    _TRANSFORM_CHUNK = 1 << 20
+
     # -- prediction ----------------------------------------------------
     def transform(self, dataset):
         frame = as_frame(dataset)
         userCol, itemCol = self._get("userCol"), self._get("itemCol")
         u = self._user_map.to_dense(frame[userCol])
         i = self._item_map.to_dense(frame[itemCol])
-        preds = np.asarray(_predict_kernel(
-            jnp.asarray(self._U), jnp.asarray(self._V),
-            jnp.asarray(u), jnp.asarray(i),
-            jnp.asarray(u >= 0), jnp.asarray(i >= 0),
-        ), dtype=np.float32)
+        Uj, Vj = jnp.asarray(self._U), jnp.asarray(self._V)
+        B = self._TRANSFORM_CHUNK
+        if len(u) <= B:
+            preds = np.asarray(_predict_kernel(
+                Uj, Vj, jnp.asarray(u), jnp.asarray(i),
+                jnp.asarray(u >= 0), jnp.asarray(i >= 0),
+            ), dtype=np.float32)
+        else:
+            preds = np.empty(len(u), dtype=np.float32)
+            for s in range(0, len(u), B):
+                ub = u[s:s + B]
+                ib = i[s:s + B]
+                n = len(ub)
+                if n < B:  # pad the tail: one compiled shape for all
+                    ub = np.pad(ub, (0, B - n), constant_values=-1)
+                    ib = np.pad(ib, (0, B - n), constant_values=-1)
+                preds[s:s + n] = np.asarray(_predict_kernel(
+                    Uj, Vj, jnp.asarray(ub), jnp.asarray(ib),
+                    jnp.asarray(ub >= 0), jnp.asarray(ib >= 0),
+                ), dtype=np.float32)[:n]
         out = frame.withColumn(self._get("predictionCol"), preds)
         if self._get("coldStartStrategy") == "drop":
             out = out.filter(~np.isnan(preds))

@@ -72,5 +72,8 @@ def main():
 if __name__ == "__main__":
     try:
         main()
-    except BrokenPipeError:  # piped into head — not an error
-        pass
+    except BrokenPipeError:
+        # piped into head — not an error; point stdout at devnull so the
+        # interpreter's shutdown flush doesn't re-raise (Python docs'
+        # SIGPIPE note), keeping exit status 0 for `set -e` sweep scripts
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())

@@ -108,6 +108,47 @@ def test_original_ids_roundtrip(rng):
     assert set(uf["id"].tolist()) == {55, 100, 2000}
 
 
+def test_api_surface_conformance():
+    """The full §2.D method/param surface exists by name — the parity
+    contract SURVEY.md freezes (reference: pyspark.ml.recommendation +
+    pyspark.mllib.recommendation method tables)."""
+    from tpu_als.api import legacy
+
+    est = ALS()
+    for p in ("rank", "maxIter", "regParam", "numUserBlocks",
+              "numItemBlocks", "implicitPrefs", "alpha", "userCol",
+              "itemCol", "ratingCol", "predictionCol", "nonnegative",
+              "checkpointInterval", "intermediateStorageLevel",
+              "finalStorageLevel", "coldStartStrategy", "seed",
+              "blockSize", "solver"):
+        assert est.hasParam(p), p
+        cap = p[0].upper() + p[1:]
+        assert callable(getattr(est, f"get{cap}")), p
+        assert callable(getattr(est, f"set{cap}")), p
+    for m in ("fit", "setParams", "copy", "extractParamMap", "save",
+              "load", "write"):
+        assert callable(getattr(est, m)), m
+
+    from tpu_als.api.estimator import ALSModel
+
+    for m in ("transform", "predict", "recommendForAllUsers",
+              "recommendForAllItems", "recommendForUserSubset",
+              "recommendForItemSubset", "save", "load", "write"):
+        assert callable(getattr(ALSModel, m)), m
+    for prop in ("userFactors", "itemFactors"):
+        assert isinstance(getattr(ALSModel, prop), property), prop
+    # `rank` is a per-instance attribute; covered by the fit/save tests
+
+    for m in ("train", "trainImplicit"):
+        assert callable(getattr(legacy.ALS, m)), m
+    for m in ("predict", "predictAll", "recommendProducts",
+              "recommendUsers", "recommendProductsForUsers",
+              "recommendUsersForProducts", "userFeatures",
+              "productFeatures", "save", "load"):
+        assert callable(getattr(legacy.MatrixFactorizationModel, m)), m
+    assert legacy.Rating is not None
+
+
 def test_transform_chunked_equals_single_call(rng, monkeypatch):
     """Frames above the scoring chunk stream in fixed-shape blocks (one
     jit specialization, padded tail); predictions must equal the

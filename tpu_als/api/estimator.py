@@ -363,6 +363,12 @@ class ALS(_ALSParams):
             u_idx, user_map = remap_ids(u_raw)
             i_idx, item_map = remap_ids(i_raw)
         cfg = self._config()
+        # traffic observability is per-fit state (single-process mesh
+        # path only — the multi-process builders live inside
+        # train_multihost); cleared so a later fit on another path can't
+        # report a stale number
+        self.lastFitCommBytes = None
+        self.lastFitStrategy = None
 
         init, start_iter = None, 0
         if self.resumeFrom is not None:
@@ -510,6 +516,19 @@ class ALS(_ALSParams):
             else:
                 ush = shard_csr(upart, ipart, u_idx, i_idx, r)
                 ish = shard_csr(ipart, upart, i_idx, u_idx, r)
+            from tpu_als.parallel.trainer import comm_bytes_per_iter
+
+            # observability (SURVEY §5.5 "gather bytes"): per-device
+            # collective traffic of the chosen strategy, readable after
+            # fit (the CLI prints it)
+            self.lastFitCommBytes = comm_bytes_per_iter(
+                strategy, upart, ipart, cfg.rank,
+                user_container=ush, item_container=ish,
+                implicit=cfg.implicit_prefs)
+            # `strategy` here is the EFFECTIVE one (a degenerate a2a plan
+            # falls back to all_gather above) — report that, not the
+            # request
+            self.lastFitStrategy = strategy
             sharded_cb = None
             if callback is not None:
                 def sharded_cb(iteration, U, V):  # slot space -> entity space

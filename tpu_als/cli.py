@@ -88,6 +88,10 @@ def cmd_train(args):
               file=sys.stderr)
     else:
         model = als.fit(train)
+    if getattr(als, "lastFitCommBytes", None):
+        print(f"collective traffic: {als.lastFitCommBytes / 1e6:.3g} "
+              f"MB/device/iteration ({als.lastFitStrategy})",
+              file=sys.stderr)
     if len(test):
         rmse = RegressionEvaluator(labelCol="rating").evaluate(
             model.transform(test))

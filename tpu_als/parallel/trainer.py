@@ -26,6 +26,7 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpu_als.core.als import AlsConfig, init_factors, local_half_step
+from tpu_als.core.ratings import trainer_chunk
 from tpu_als.ops.solve import compute_yty
 from tpu_als.parallel.mesh import AXIS
 
@@ -205,6 +206,60 @@ def make_a2a_step(mesh, user_a2a, item_a2a, cfg: AlsConfig):
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+def comm_bytes_per_iter(strategy, user_part, item_part, rank,
+                        user_container=None, item_container=None,
+                        implicit=False):
+    """Per-device collective traffic for ONE full ALS iteration, in bytes
+    — the "gather bytes" line of the observability spec (SURVEY.md §5.5).
+
+    Model (f32 factors; per half-step the solved side receives the
+    opposite side's rows):
+
+    - ``all_gather``: the full opposite table minus the resident shard,
+      ``(D−1)·rows_per_shard·r·4``.
+    - ``ring``: ``D·rows_per_shard·r·4`` per tile pass (every tile runs
+      ALL ``D`` ppermute rotations so the shard ends home — no
+      resident-shard discount), times the row-tile count read from the
+      built ``RingCsr`` containers when given, else assumed 1.
+    - ``all_to_all``: only the requested rows move, ``(D−1)/D · D·R·r·4``
+      received (+ the same sent); needs the built ``A2aCsr`` plans for R.
+    - implicit adds one ``psum(YtY)`` per half-step: ``2·(D−1)/D·r²·4``
+      with a bidirectional-ring cost model.
+    """
+    D = user_part.n_shards
+    fb = 4 * rank
+
+    def tiles(container):
+        if container is None or not getattr(container, "buckets", None):
+            return 1
+        n = 0
+        for b in container.buckets:
+            S, nb, w = b.cols.shape[-3:]
+            chunk = trainer_chunk(nb, w, rank, container.chunk_elems)
+            n += nb // chunk
+        return max(1, n)
+
+    if strategy == "all_gather":
+        half_u = (D - 1) * item_part.rows_per_shard * fb   # gathers V
+        half_v = (D - 1) * user_part.rows_per_shard * fb   # gathers U
+    elif strategy == "ring":
+        half_u = D * item_part.rows_per_shard * fb * tiles(user_container)
+        half_v = D * user_part.rows_per_shard * fb * tiles(item_container)
+    elif strategy == "all_to_all":
+        if user_container is None or item_container is None:
+            raise ValueError("all_to_all traffic needs the built A2aCsr "
+                             "plans (request budgets R)")
+        # recv + send, excluding the self-shard slice
+        half_u = 2 * (D - 1) * user_container.request_budget * fb
+        half_v = 2 * (D - 1) * item_container.request_budget * fb
+    else:
+        raise ValueError(f"unknown strategy {strategy!r}")
+    total = half_u + half_v
+    if implicit:
+        total += 2 * 2 * (D - 1) * rank * rank * 4 // D
+    return int(total)
 
 
 def stacked_counts(part, row_idx, vals=None, positive_only=False):

@@ -1,7 +1,13 @@
 #!/bin/bash
-# One-shot TPU measurement sweep for round 2 (run when the tunnel is up).
+# One-shot TPU measurement sweep (run when the tunnel is up).
 # Results land in sweep_logs/; each step is independently timeout-bounded
 # so one hang cannot eat the sweep.
+#
+# ORDERED BY CAPTURE VALUE: the tunnel has been flaky for two rounds, so
+# if it dies mid-sweep the most important numbers must already be on
+# disk — the cg2 headline candidate, the exact-path headline, quality
+# parity of the inexact solve, and the rank-256 proxy come first; tuning
+# A/Bs and the slower application benchmarks follow.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p sweep_logs
@@ -13,35 +19,30 @@ run() {  # run <name> <timeout> <cmd...>
   echo "rc=$? $(tail -c 300 "sweep_logs/$name.out" | tr '\n' ' ')"
 }
 
-# 1. solve-kernel panel sweep (sets DEFAULT_PANEL if a non-8 wins)
-run kernel_lab 580 python scripts/kernel_lab.py --panels 4 8 16
-
-# 2. headline A/Bs: f32 vs bf16 gather/einsum, width ladder 2.0 vs 1.5,
-#    and the warm-started-CG inexact solve (2 and 3 steps)
-run headline_f32     580 python bench.py --iters 5
-run headline_bf16    580 python bench.py --iters 5 --compute-dtype bfloat16
-run headline_wg15    580 python bench.py --iters 5 --width-growth 1.5
-run headline_bf16_wg15 580 python bench.py --iters 5 --compute-dtype bfloat16 --width-growth 1.5
+# 1. the two headline candidates + quality parity of the inexact solve
 run headline_cg2     580 python bench.py --iters 5 --cg-iters 2
+run headline_f32     580 python bench.py --iters 5
+run rmse_cg2 580 python bench.py --mode rmse --iters-rmse 12 --cg-iters 2
+
+# 2. rank-256 single-core proxy (BASELINE row 3 / config 3 evidence:
+#    pallas_solve at the production rank, s/iter, peak HBM)
+run rank256_proxy 900 python scripts/rank256_proxy.py
+
+# 3. solve-kernel panel sweep (sets DEFAULT_PANEL if a non-8 wins) and
+#    the remaining headline A/Bs
+run kernel_lab 580 python scripts/kernel_lab.py --panels 4 8 16
 run headline_cg3     580 python bench.py --iters 5 --cg-iters 3
 run headline_cg2_dense 580 python bench.py --iters 5 --cg-iters 2 --cg-mode dense
 run headline_cg2_bf16 580 python bench.py --iters 5 --cg-iters 2 --compute-dtype bfloat16
-# quality parity of the inexact solve at the headline rank
-run rmse_cg2 580 python bench.py --mode rmse --iters-rmse 12 --cg-iters 2
+run headline_bf16    580 python bench.py --iters 5 --compute-dtype bfloat16
+run headline_wg15    580 python bench.py --iters 5 --width-growth 1.5
+run headline_bf16_wg15 580 python bench.py --iters 5 --compute-dtype bfloat16 --width-growth 1.5
 
-# 3. quality: held-out RMSE with whatever headline config won (f32 default
-#    here; rerun with the winner's flags before updating BASELINE.md)
+# 4. exact-path quality + full-scale stage attribution of the CG solve
 run rmse 580 python bench.py --mode rmse --iters-rmse 12
-
-# 3b. rank-256 single-core proxy (BASELINE row 3 / config 3 evidence:
-#     pallas_solve at the production rank, s/iter, peak HBM)
-run rank256_proxy 900 python scripts/rank256_proxy.py
-
-# 3c. full-scale stage attribution of the CG solve (what the cg2 headline
-#     win is made of)
 run ablate_full_cg2 900 python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2
 
-# 4. fold-in p50 + two-tower filtered recall (5 + 20 epochs)
+# 5. fold-in p50 + two-tower filtered recall (5 + 20 epochs)
 run foldin 580 python bench.py --mode foldin
 run twotower_5ep 580 python bench.py --mode twotower --tt-epochs 5
 run twotower_20ep 900 python bench.py --mode twotower

@@ -9,6 +9,12 @@ SURVEY.md §2.B5) with one **batched** einsum + Cholesky over every row of a
 shard at once, which is the shape the TPU MXU wants: a handful of large
 contractions instead of millions of rank-2 BLAS calls.
 
+The solver family, exact → inexact: batched Cholesky (:func:`solve_spd`,
+kernel-accelerated via tpu_als.ops.pallas_*), fixed-sweep NNLS
+(:func:`solve_nnls`), and warm-started Jacobi-CG for inexact ALS —
+:func:`solve_cg` on the built tensor, :func:`solve_cg_matfree` applying
+the operator straight through the gathered factor rows.
+
 Shapes use the padded-CSR convention from :mod:`tpu_als.core.ratings`:
 
   ``Vg``   [n, w, r]  gathered opposite-side factor rows per entity

@@ -422,11 +422,25 @@ def test_sharded_checkpoint_roundtrip(rng, tmp_path):
     np.testing.assert_allclose(V, np.asarray(Vs)[ipart.slot], rtol=0,
                                atol=0)
     # overwrite path: a second save must swap atomically, old removed
+    # (this save also carries the serving-column params the model-load
+    # check below needs)
     save_checkpoint_sharded(path, Us, Vs, upart, ipart, user_map,
-                            item_map, mesh, iteration=3)
+                            item_map, mesh,
+                            params={"userCol": "user", "itemCol": "item",
+                                    "predictionCol": "prediction",
+                                    "coldStartStrategy": "nan"},
+                            iteration=3)
     manifest2, _, U2, _, _ = load_factors(path)
     assert manifest2["iteration"] == 3
     np.testing.assert_array_equal(U2, U)
+
+    # a sharded checkpoint directory IS a loadable model (one format
+    # serves resume and persistence, SURVEY §5.4)
+    from tpu_als.api.estimator import ALSModel
+
+    model = ALSModel.load(path)
+    preds = model.transform({"user": u[:50], "item": i[:50]})["prediction"]
+    assert np.isfinite(np.asarray(preds)).all()
 
     # crash window of atomic_install (old renamed aside, new not yet
     # installed): the sharded format must honor the same .old fallback

@@ -440,7 +440,12 @@ def test_sharded_checkpoint_roundtrip(rng, tmp_path):
 
     model = ALSModel.load(path)
     preds = model.transform({"user": u[:50], "item": i[:50]})["prediction"]
-    assert np.isfinite(np.asarray(preds)).all()
+    # exact wiring check, not just finiteness: each prediction must be
+    # the dot of the right user/item factor rows
+    want = (np.asarray(Us)[upart.slot][u[:50]]
+            * np.asarray(Vs)[ipart.slot][i[:50]]).sum(axis=1)
+    np.testing.assert_allclose(np.asarray(preds), want, rtol=1e-5,
+                               atol=1e-6)
 
     # crash window of atomic_install (old renamed aside, new not yet
     # installed): the sharded format must honor the same .old fallback

@@ -115,6 +115,30 @@ def test_filtered_recall_matches_plain_when_no_overlap(rng):
     assert r_plain == r_filt, (r_plain, r_filt)
 
 
+def test_weights_and_callback(rng):
+    """`weights` gate the per-row softmax loss (a zero-weight pair adds
+    no positive gradient, though its item still serves as an in-batch
+    negative for other rows) and `callback` observes every epoch."""
+    u, i, _, _ = _interactions(rng)
+    cfg = TwoTowerConfig(embed_dim=8, hidden=(16,), out_dim=8, epochs=3,
+                         batch_size=256, seed=3)
+    seen = []
+    params = train_two_tower(
+        u, i, 60, 40, cfg,
+        callback=lambda ep, loss, p: seen.append((ep, loss)))
+    assert [ep for ep, _ in seen] == [1, 2, 3]
+    assert all(np.isfinite(l) for _, l in seen)
+
+    # training with HALF the pairs zero-weighted must differ from
+    # uniform weights (the gate is live), and still train finitely
+    w = np.ones(len(u), np.float32)
+    w[::2] = 0.0
+    pw = train_two_tower(u, i, 60, 40, cfg, weights=w)
+    assert not np.allclose(np.asarray(pw["user_embed"]),
+                           np.asarray(params["user_embed"]))
+    assert np.isfinite(np.asarray(pw["user_embed"])).all()
+
+
 def test_serving_bias_steers_topk_toward_biased_items(rng):
     """An item_bias large on one item must pull it into every top-k (and a
     zero bias must change nothing) — the serving-time popularity-prior

@@ -20,9 +20,9 @@ run() {  # run <name> <timeout> <cmd...>
 }
 
 # 1. the two headline candidates + quality parity of the inexact solve
-run headline_cg2     580 python bench.py --iters 5 --cg-iters 2
-run headline_f32     580 python bench.py --iters 5
-run rmse_cg2 580 python bench.py --mode rmse --iters-rmse 12 --cg-iters 2
+run headline_cg2     580 python bench.py --no-auto-config --iters 5 --cg-iters 2
+run headline_f32     580 python bench.py --no-auto-config --iters 5
+run rmse_cg2 580 python bench.py --no-auto-config --mode rmse --iters-rmse 12 --cg-iters 2
 
 # 2. rank-256 single-core proxy (BASELINE row 3 / config 3 evidence:
 #    pallas_solve at the production rank, s/iter, peak HBM)
@@ -31,20 +31,20 @@ run rank256_proxy 900 python scripts/rank256_proxy.py
 # 3. solve-kernel panel sweep (sets DEFAULT_PANEL if a non-8 wins) and
 #    the remaining headline A/Bs
 run kernel_lab 580 python scripts/kernel_lab.py --panels 4 8 16
-run headline_cg3     580 python bench.py --iters 5 --cg-iters 3
-run headline_cg2_dense 580 python bench.py --iters 5 --cg-iters 2 --cg-mode dense
-run headline_cg2_bf16 580 python bench.py --iters 5 --cg-iters 2 --compute-dtype bfloat16
-run headline_bf16    580 python bench.py --iters 5 --compute-dtype bfloat16
-run headline_wg15    580 python bench.py --iters 5 --width-growth 1.5
-run headline_bf16_wg15 580 python bench.py --iters 5 --compute-dtype bfloat16 --width-growth 1.5
+run headline_cg3     580 python bench.py --no-auto-config --iters 5 --cg-iters 3
+run headline_cg2_dense 580 python bench.py --no-auto-config --iters 5 --cg-iters 2 --cg-mode dense
+run headline_cg2_bf16 580 python bench.py --no-auto-config --iters 5 --cg-iters 2 --compute-dtype bfloat16
+run headline_bf16    580 python bench.py --no-auto-config --iters 5 --compute-dtype bfloat16
+run headline_wg15    580 python bench.py --no-auto-config --iters 5 --width-growth 1.5
+run headline_bf16_wg15 580 python bench.py --no-auto-config --iters 5 --compute-dtype bfloat16 --width-growth 1.5
 
 # 4. exact-path quality + full-scale stage attribution of the CG solve
-run rmse 580 python bench.py --mode rmse --iters-rmse 12
+run rmse 580 python bench.py --no-auto-config --mode rmse --iters-rmse 12
 run ablate_full_cg2 900 python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2
 
 # 5. fold-in p50 + two-tower filtered recall (5 + 20 epochs)
-run foldin 580 python bench.py --mode foldin
-run twotower_5ep 580 python bench.py --mode twotower --tt-epochs 5
-run twotower_20ep 900 python bench.py --mode twotower
+run foldin 580 python bench.py --no-auto-config --mode foldin
+run twotower_5ep 580 python bench.py --no-auto-config --mode twotower --tt-epochs 5
+run twotower_20ep 900 python bench.py --no-auto-config --mode twotower
 
 echo "=== sweep done ($(date +%H:%M:%S)) ==="

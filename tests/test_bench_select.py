@@ -1,0 +1,70 @@
+"""bench.py's sweep-evidence auto-selection: the driver's end-of-round
+capture must pick the fastest VALIDATED configuration the opportunistic
+sweep measured, and never an unvalidated one."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def _write(d, name, payload):
+    with open(os.path.join(d, name + ".out"), "w") as f:
+        f.write("some stderr-ish line\n")
+        f.write(json.dumps(payload) + "\n")
+
+
+def test_no_evidence_keeps_defaults(tmp_path):
+    assert bench.best_measured_flags(str(tmp_path)) is None
+
+
+def test_fastest_validated_wins(tmp_path):
+    d = str(tmp_path)
+    _write(d, "headline_f32", {"value": 0.75, "unit": "iters/sec"})
+    _write(d, "headline_cg2", {"value": 2.4, "unit": "iters/sec"})
+    _write(d, "headline_bf16", {"value": 0.9, "unit": "iters/sec"})
+    _write(d, "rmse_cg2", {"value": 0.44, "unit": "rmse_stars"})
+    assert bench.best_measured_flags(d) == {"cg_iters": 2}
+
+
+def test_cg_winner_requires_quality_evidence(tmp_path):
+    d = str(tmp_path)
+    _write(d, "headline_f32", {"value": 0.75})
+    _write(d, "headline_cg2", {"value": 2.4})
+    # no rmse_cg2 at all -> keep defaults
+    assert bench.best_measured_flags(d) is None
+    # quality evidence exists but fails the gate -> keep defaults
+    _write(d, "rmse_cg2", {"value": 0.9})
+    assert bench.best_measured_flags(d) is None
+    # passing quality unlocks the cg winner
+    _write(d, "rmse_cg2", {"value": 0.43})
+    assert bench.best_measured_flags(d) == {"cg_iters": 2}
+
+
+def test_error_steps_are_ignored(tmp_path):
+    d = str(tmp_path)
+    _write(d, "headline_cg2", {"value": None, "error": "tunnel died"})
+    _write(d, "headline_f32", {"value": 0.7})
+    assert bench.best_measured_flags(d) == {}
+
+
+def test_quality_neutral_winner_needs_no_gate(tmp_path):
+    # wg15 changes padding only (masked rows) — numerics-identical, so
+    # it is selectable without extra quality evidence
+    d = str(tmp_path)
+    _write(d, "headline_wg15", {"value": 1.1})
+    assert bench.best_measured_flags(d) == {"width_growth": 1.5}
+
+
+def test_configs_without_quality_evidence_never_selected(tmp_path):
+    # bf16 variants / cg3 / cg2_dense have no matching rmse step in the
+    # sweep — a speed win there must NOT auto-select
+    d = str(tmp_path)
+    _write(d, "headline_bf16_wg15", {"value": 9.9})
+    _write(d, "headline_cg2_bf16", {"value": 9.9})
+    _write(d, "headline_cg3", {"value": 9.9})
+    _write(d, "headline_f32", {"value": 0.7})
+    assert bench.best_measured_flags(d) == {}

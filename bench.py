@@ -118,14 +118,19 @@ _SWEEP_FLAGS = {
 # 2); 0.50 rejects anything that regressed quality materially.
 _RMSE_GATE = 0.50
 
-# configs eligible for auto-selection: only those whose QUALITY evidence
-# the sweep actually produces.  f32 exact is the reference config;
-# wg15 changes padding only (masked rows — numerics-identical);
-# cg2 (f32, matfree) is gated by the sweep's rmse_cg2 step.  bf16
-# variants, cg3, and cg2_dense have no matching quality step, so a speed
-# win there never auto-selects (run them explicitly after adding the
-# quality evidence).
-_AUTO_SELECTABLE = {"headline_f32", "headline_wg15", "headline_cg2"}
+# configs eligible for auto-selection, mapped to the sweep QUALITY step
+# that must validate them (None = quality-neutral: f32 exact is the
+# reference config, and the width ladder changes padding only — masked
+# rows, numerics-identical).  Anything not listed (cg3, cg2_dense) has
+# no matching quality step and never auto-selects.
+_AUTO_SELECTABLE = {
+    "headline_f32": None,
+    "headline_wg15": None,
+    "headline_cg2": "rmse_cg2",
+    "headline_bf16": "rmse_bf16",
+    "headline_bf16_wg15": "rmse_bf16",
+    "headline_cg2_bf16": "rmse_cg2_bf16",
+}
 
 
 def _last_json(path):
@@ -151,11 +156,14 @@ def best_measured_flags(sweep_dir="sweep_logs"):
     default flags; when the opportunistic sweep (scripts/sweep_tpu.sh)
     already measured a faster configuration on THIS chip, defaulting to
     the conservative exact path would throw that evidence away.
-    Selection is evidence-bound: a candidate counts only if its sweep
-    step produced a value, and a cg (inexact-solve) winner additionally
-    requires the sweep's cg quality step to exist and beat the RMSE
-    gate.  Explicit user flags always win — callers only consult this
-    when every relevant flag is at its default.
+    Selection is evidence-bound per config (_AUTO_SELECTABLE): a
+    candidate counts only if its sweep step produced a value, and any
+    numerics-changing winner (cg and/or bf16) additionally requires ITS
+    matching rmse step to exist and beat the gate — a fastest-but-
+    unvalidated winner keeps the defaults rather than silently demoting
+    to a slower validated config.  Explicit user flags always win —
+    callers only consult this when every relevant flag is at its
+    default.
     """
     import os
 
@@ -168,11 +176,13 @@ def best_measured_flags(sweep_dir="sweep_logs"):
     if best_name is None:
         return None
     flags = dict(_SWEEP_FLAGS[best_name])
-    if flags.get("cg_iters"):
-        q = _last_json(os.path.join(sweep_dir, "rmse_cg2.out"))
+    quality_step = _AUTO_SELECTABLE[best_name]
+    if quality_step is not None:
+        q = _last_json(os.path.join(sweep_dir, quality_step + ".out"))
         if not (q and q.get("value") and q["value"] <= _RMSE_GATE):
-            log(f"sweep winner {best_name} lacks cg quality evidence "
-                f"(rmse_cg2 missing or > {_RMSE_GATE}); keeping defaults")
+            log(f"sweep winner {best_name} lacks quality evidence "
+                f"({quality_step} missing or > {_RMSE_GATE}); keeping "
+                "defaults")
             return None
     log(f"auto-selected sweep-validated config {best_name} "
         f"({best_val} iters/sec measured): {flags}")

@@ -33,12 +33,17 @@ run rank256_proxy 900 python scripts/rank256_proxy.py
 run kernel_lab 580 python scripts/kernel_lab.py --panels 4 8 16
 run headline_cg3     580 python bench.py --no-auto-config --iters 5 --cg-iters 3
 run headline_cg2_dense 580 python bench.py --no-auto-config --iters 5 --cg-iters 2 --cg-mode dense
+# each bf16 headline candidate is IMMEDIATELY followed by its quality
+# step: a candidate that becomes eligible without its validation would
+# void auto-selection entirely if the tunnel died in between
 run headline_cg2_bf16 580 python bench.py --no-auto-config --iters 5 --cg-iters 2 --compute-dtype bfloat16
+run rmse_cg2_bf16 580 python bench.py --no-auto-config --mode rmse --iters-rmse 12 --cg-iters 2 --compute-dtype bfloat16
 run headline_bf16    580 python bench.py --no-auto-config --iters 5 --compute-dtype bfloat16
+run rmse_bf16 580 python bench.py --no-auto-config --mode rmse --iters-rmse 12 --compute-dtype bfloat16
 run headline_wg15    580 python bench.py --no-auto-config --iters 5 --width-growth 1.5
 run headline_bf16_wg15 580 python bench.py --no-auto-config --iters 5 --compute-dtype bfloat16 --width-growth 1.5
 
-# 4. exact-path quality + full-scale stage attribution of the CG solve
+# 4. exact-path quality + full-scale CG stage attribution
 run rmse 580 python bench.py --no-auto-config --mode rmse --iters-rmse 12
 run ablate_full_cg2 900 python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2
 

@@ -60,11 +60,27 @@ def test_quality_neutral_winner_needs_no_gate(tmp_path):
 
 
 def test_configs_without_quality_evidence_never_selected(tmp_path):
-    # bf16 variants / cg3 / cg2_dense have no matching rmse step in the
-    # sweep — a speed win there must NOT auto-select
+    # a speed win without its matching quality step must NOT auto-select;
+    # cg3/cg2_dense have no step at all and are never eligible
     d = str(tmp_path)
     _write(d, "headline_bf16_wg15", {"value": 9.9})
-    _write(d, "headline_cg2_bf16", {"value": 9.9})
+    _write(d, "headline_cg2_bf16", {"value": 9.8})
     _write(d, "headline_cg3", {"value": 9.9})
     _write(d, "headline_f32", {"value": 0.7})
-    assert bench.best_measured_flags(d) == {}
+    # the fastest eligible config lacks its quality step -> defaults
+    # (no silent demotion to a slower validated one)
+    assert bench.best_measured_flags(d) is None
+
+
+def test_per_config_quality_steps_unlock_their_winner(tmp_path):
+    d = str(tmp_path)
+    _write(d, "headline_cg2_bf16", {"value": 9.8})
+    _write(d, "headline_cg2", {"value": 2.4})
+    _write(d, "rmse_cg2", {"value": 0.43})
+    # the faster cg2_bf16 lacks ITS quality step -> whole selection
+    # falls back to defaults (the winner is unvalidated, and silently
+    # demoting to a slower validated config would misattribute)
+    assert bench.best_measured_flags(d) is None
+    _write(d, "rmse_cg2_bf16", {"value": 0.45})
+    assert bench.best_measured_flags(d) == {
+        "cg_iters": 2, "compute_dtype": "bfloat16"}

@@ -126,6 +126,27 @@ if __name__ == "__main__":
             print("gate worker caught divergence", flush=True)
         else:
             raise AssertionError("divergent fit config was not rejected")
+    elif os.environ.get("MH_MODE") == "gate_diverge_strategy":
+        # divergence in gatherStrategy specifically: the knob that decides
+        # WHICH collectives the compiled step issues (ring pairs ppermute
+        # against all_gather = hang).  No callback/checkpoint knobs set,
+        # so only the strategy/cg fields of the gate can catch it
+        # (advisor r3, medium).
+        from tpu_als import ALS
+        from tpu_als.io.movielens import synthetic_movielens
+        from tpu_als.parallel.mesh import make_mesh
+
+        pid = jax.process_index()
+        frame = synthetic_movielens(60, 30, 800, seed=3)
+        try:
+            ALS(rank=3, maxIter=2, seed=0, mesh=make_mesh(),
+                gatherStrategy="ring" if pid else "all_gather",
+                ).fit(frame)
+        except ValueError as e:
+            assert "gatherStrategy" in str(e), e
+            print("gate worker caught divergence", flush=True)
+        else:
+            raise AssertionError("divergent gatherStrategy not rejected")
     elif os.environ.get("MH_MODE") == "fit_perhost":
         # per-host disjoint files: each process writes + loads ONLY its
         # half of the dataset (row parity split), fits with

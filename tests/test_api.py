@@ -194,6 +194,22 @@ def test_recommend_subset(rng):
     assert len(recs2) == 1
 
 
+def test_recommend_itemcol_named_rating_raises_clearly(rng):
+    # itemCol='rating' would need two struct fields named 'rating' in the
+    # recommendations dtype; np.dtype raises a bare "duplicate field
+    # name" — the guard must surface the actual conflict (advisor r3)
+    import pytest
+
+    frame = small_frame(rng)
+    ren = ColumnarFrame({"user": np.asarray(frame["user"]),
+                         "rating": np.asarray(frame["item"]),
+                         "score": np.asarray(frame["rating"])})
+    model = ALS(rank=3, maxIter=2, seed=0, itemCol="rating",
+                ratingCol="score").fit(ren)
+    with pytest.raises(ValueError, match="itemCol='rating' collides"):
+        model.recommendForAllUsers(3)
+
+
 def test_model_save_load_roundtrip(rng, tmp_path):
     frame = small_frame(rng)
     model = ALS(rank=3, maxIter=3, seed=4).fit(frame)

@@ -342,6 +342,35 @@ def test_two_process_divergent_config_fails_fast(tmp_path):
         assert "gate worker caught divergence" in o, o[-1500:]
 
 
+def test_two_process_divergent_gather_strategy_fails_fast(tmp_path):
+    """gatherStrategy is the knob that picks WHICH collectives the step
+    compiles (ring=ppermute vs all_gather) — a cross-process divergence
+    with no observer knobs set must still hit the gate (advisor r3)."""
+    import os
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_cli_worker.py")
+    outs = _spawn_two_procs(worker, {"MH_OUT": str(tmp_path / "gs"),
+                                     "MH_MODE": "gate_diverge_strategy"},
+                            timeout=180)
+    for o in outs:
+        assert "gate worker caught divergence" in o, o[-1500:]
+
+
+def test_duplicated_split_detection_is_pairwise():
+    from tpu_als.parallel.multihost import _split_signatures_duplicated
+
+    # all distinct -> fine
+    assert not _split_signatures_duplicated([[10, 1], [10, 2], [12, 3]])
+    # ALL equal (the P=2 duplicated-load case) -> rejected
+    assert _split_signatures_duplicated([[10, 1], [10, 1]])
+    # P>2: only TWO of the rows collide — must still be rejected
+    # (the old all-equal check passed this, advisor r3)
+    assert _split_signatures_duplicated([[10, 1], [10, 1], [12, 3]])
+    # several empty splits share the empty digest legitimately
+    assert not _split_signatures_duplicated([[0, 5], [0, 5], [10, 1]])
+
+
 def test_ring_local_slice_matches_full_grid(rng):
     from tpu_als.parallel.comm import shard_csr_grid
 

@@ -319,20 +319,33 @@ class ALS(_ALSParams):
                         _os.path.abspath(self.checkpointDir).encode(),
                         digest_size=8).digest()
                     ckdir_digest = int(np.frombuffer(h, dtype=np.int64)[0])
+                # gatherStrategy decides WHICH collectives the compiled
+                # step issues (ring=ppermute, a2a=all_to_all, default=
+                # all_gather) and cgIters/cgMode decide the solver — a
+                # cross-process divergence in any of them pairs
+                # mismatched collectives or trains shards with different
+                # numerics, so they gate alongside the observer knobs
+                # (advisor r3, medium)
+                strat_code = ("all_gather", "ring",
+                              "all_to_all").index(self.gatherStrategy)
                 gate = np.asarray(mhu.process_allgather(np.array(
                     [int(self.dataMode == "per_host"),
                      int(self.fitCallback is not None),
                      self.fitCallbackInterval,
                      int(ckpt_on), interval,
                      int(self.checkpointSharded), ckdir_digest,
-                     self.getMaxIter()], dtype=np.int64)))
+                     self.getMaxIter(),
+                     strat_code, self.cgIters,
+                     ("matfree", "dense").index(self.cgMode)],
+                    dtype=np.int64)))
                 if not (gate == gate[0]).all():
                     raise ValueError(
                         "processes disagree on multi-process fit config "
                         "(dataMode, fitCallback present, "
                         "fitCallbackInterval, checkpointing, "
                         "checkpointInterval, checkpointSharded, "
-                        "checkpointDir digest, maxIter): "
+                        "checkpointDir digest, maxIter, gatherStrategy, "
+                        "cgIters, cgMode): "
                         f"{gate.tolist()} — pass the SAME knobs on every "
                         "process (peers may use an inert callback; only "
                         "process 0's is invoked)")
@@ -775,6 +788,17 @@ class ALSModel:
         path collapsed into one jitted scan — SURVEY.md §3.3)."""
         other = self._V if users else self._U
         other_ids = self._item_map.ids if users else self._user_map.ids
+        other_col = self._get("itemCol") if users else self._get("userCol")
+        if other_col == "rating":
+            # the struct dtype below would need two fields named 'rating'
+            # (np.dtype raises a bare "duplicate field name") — surface
+            # the actual conflict, and do it BEFORE the scoring loop so
+            # a serving-scale call fails instantly (advisor r3)
+            raise ValueError(
+                f"{'itemCol' if users else 'userCol'}='rating' collides "
+                "with the fixed 'rating' score field of the "
+                "recommendations struct (reference schema); rename the "
+                "column before calling recommendFor*")
         k = min(k, other.shape[0])
         block = max(1, int(self._get("blockSize")))
         valid = jnp.ones(other.shape[0], dtype=bool)
@@ -795,7 +819,6 @@ class ALSModel:
         # so consumers iterate exactly as they did over the old per-row
         # list-of-tuples, without O(n·k) Python tuple construction on the
         # serving path (162k users × k=10 was ~1.6M tuples per call).
-        other_col = self._get("itemCol") if users else self._get("userCol")
         recs = np.empty(ids_out.shape,
                         dtype=[(other_col, ids_out.dtype),
                                ("rating", np.float32)])

@@ -94,6 +94,17 @@ def _triples_digest(u, i, r):
     return int(np.frombuffer(h, dtype=np.int64)[0])
 
 
+def _split_signatures_duplicated(sig):
+    """True when any TWO non-empty per-process (len, digest) rows match —
+    the duplicated-load mistake.  Pairwise, not all-equal: with P > 2
+    processes, two hosts reading the same file must still be rejected
+    even when the others differ (advisor r3).  Empty splits are excluded
+    (several hosts legitimately holding no data share the empty digest)."""
+    sig = np.asarray(sig)
+    nonempty = sig[sig[:, 0] > 0]
+    return len(nonempty) != len(np.unique(nonempty, axis=0))
+
+
 def _ragged_allgather(arr, fill=0):
     """Concatenate every process's 1-D array (ragged lengths allowed).
 
@@ -216,12 +227,12 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
         # ~2^-64)
         sig = np.asarray(mhu.process_allgather(np.array(
             [len(u), _triples_digest(u, i, r)], dtype=np.int64)))
-        if len(u) and (sig == sig[0]).all():
+        if _split_signatures_duplicated(sig):
             raise ValueError(
-                "replicated=False but every process passed IDENTICAL "
-                "rating triples — each host must pass its OWN disjoint "
-                "split (per-host input files), or pass replicated=True "
-                "for a shared load")
+                "replicated=False but two or more processes passed "
+                "IDENTICAL rating triples — each host must pass its OWN "
+                "disjoint split (per-host input files), or pass "
+                "replicated=True for a shared load")
         u = _ragged_allgather(u)
         i = _ragged_allgather(i)
         r = _ragged_allgather(r)

@@ -1,0 +1,133 @@
+"""The collective-traffic model (trainer.comm_bytes_per_iter — the CLI's
+MB/device/iter line) validated against the bytes the TRACED STEP actually
+moves, counted from its jaxpr (parallel.comm_audit).  A step change that
+adds/removes/resizes a collective now fails here instead of silently
+diverging from the reported number (VERDICT r3 weak #7)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_als.core.als import AlsConfig, init_factors
+from tpu_als.parallel.comm_audit import collective_bytes
+from tpu_als.parallel.data import partition_balanced, shard_csr
+from tpu_als.parallel.mesh import AXIS, make_mesh
+from tpu_als.parallel.trainer import (
+    comm_bytes_per_iter,
+    make_a2a_step,
+    make_ring_step,
+    make_sharded_step,
+    stacked_counts,
+)
+
+D = 8
+
+
+def _problem(rng, nU=60, nI=40, nnz=900):
+    u = rng.integers(0, nU, nnz)
+    i = rng.integers(0, nI, nnz)
+    r = np.abs(rng.normal(size=nnz)).astype(np.float32) + 0.1
+    upart = partition_balanced(np.bincount(u, minlength=nU), D)
+    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
+    return u, i, r, upart, ipart
+
+
+def _factors(mesh, upart, ipart, rank):
+    leading = NamedSharding(mesh, P(AXIS))
+    key = jax.random.PRNGKey(0)
+    ku, kv = jax.random.split(key)
+    U = jax.device_put(
+        jnp.zeros((upart.padded_rows, rank), jnp.float32), leading)
+    V = jax.device_put(
+        jnp.zeros((ipart.padded_rows, rank), jnp.float32), leading)
+    return U, V, leading
+
+
+def test_all_gather_model_matches_traced_bytes(rng):
+    u, i, r, upart, ipart = _problem(rng)
+    rank = 8
+    cfg = AlsConfig(rank=rank, max_iter=1, reg_param=0.1,
+                    implicit_prefs=True, alpha=4.0, seed=0)
+    ush = shard_csr(upart, ipart, u, i, r, min_width=4)
+    ish = shard_csr(ipart, upart, i, u, r, min_width=4)
+    mesh = make_mesh(D)
+    U, V, leading = _factors(mesh, upart, ipart, rank)
+    ub = jax.device_put(ush.device_buckets(), leading)
+    ib = jax.device_put(ish.device_buckets(), leading)
+    step = make_sharded_step(mesh, ush, ish, cfg)
+    traced, breakdown = collective_bytes(step, U, V, ub, ib, axis_size=D)
+    model = comm_bytes_per_iter("all_gather", upart, ipart, rank,
+                                user_container=ush, item_container=ish,
+                                implicit=True)
+    assert breakdown.get("all_gather") and breakdown.get("psum")
+    assert traced == model, (traced, model, breakdown)
+
+
+def test_ring_model_matches_traced_bytes_with_tiling(rng):
+    from tpu_als.parallel.comm import shard_csr_grid
+
+    u, i, r, upart, ipart = _problem(rng)
+    rank = 8
+    cfg = AlsConfig(rank=rank, max_iter=1, reg_param=0.1,
+                    implicit_prefs=True, alpha=4.0, seed=0)
+    # a small chunk budget forces ntiles > 1 so the audit must scale
+    # the in-loop ppermutes by the scan trip count
+    chunk = 512
+    ugrid = shard_csr_grid(upart, ipart, u, i, r, min_width=4,
+                           chunk_elems=chunk)
+    igrid = shard_csr_grid(ipart, upart, i, u, r, min_width=4,
+                           chunk_elems=chunk)
+    mesh = make_mesh(D)
+    U, V, leading = _factors(mesh, upart, ipart, rank)
+    ub = jax.device_put(ugrid.device_buckets(), leading)
+    ib = jax.device_put(igrid.device_buckets(), leading)
+    uc = jax.device_put(
+        jnp.asarray(stacked_counts(upart, u, r, positive_only=True)),
+        leading)
+    ic = jax.device_put(
+        jnp.asarray(stacked_counts(ipart, i, r, positive_only=True)),
+        leading)
+    step = make_ring_step(mesh, ugrid, igrid, cfg)
+    traced, breakdown = collective_bytes(step, U, V, ub, ib, uc, ic,
+                                         axis_size=D)
+    model = comm_bytes_per_iter("ring", upart, ipart, rank,
+                                user_container=ugrid, item_container=igrid,
+                                implicit=True)
+    assert breakdown.get("ppermute") and breakdown.get("psum")
+    assert traced == model, (traced, model, breakdown)
+
+
+def test_a2a_model_matches_traced_bytes():
+    from tpu_als.parallel.a2a import build_a2a
+
+    # banded-sparse layout so the exchange plan is non-degenerate
+    rng = np.random.default_rng(5)
+    nU, nI = 24 * D, 48 * D
+    nnz = 2 * nU
+    u = rng.integers(0, nU, nnz)
+    i = rng.integers(0, nI, nnz)
+    r = np.abs(rng.normal(size=nnz)).astype(np.float32) + 0.1
+    upart = partition_balanced(np.bincount(u, minlength=nU), D)
+    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
+    ua = build_a2a(upart, ipart, u, i, r, min_width=4)
+    ia = build_a2a(ipart, upart, i, u, r, min_width=4)
+    assert not ua.degenerate and not ia.degenerate
+    rank = 8
+    cfg = AlsConfig(rank=rank, max_iter=1, reg_param=0.1,
+                    implicit_prefs=True, alpha=4.0, seed=0)
+    mesh = make_mesh(D)
+    U, V, leading = _factors(mesh, upart, ipart, rank)
+    ub = jax.device_put(ua.device_buckets(), leading)
+    ib = jax.device_put(ia.device_buckets(), leading)
+    us = jax.device_put(jnp.asarray(ua.send_idx), leading)
+    is_ = jax.device_put(jnp.asarray(ia.send_idx), leading)
+    step = make_a2a_step(mesh, ua, ia, cfg)
+    traced, breakdown = collective_bytes(step, U, V, ub, ib, us, is_,
+                                         axis_size=D)
+    model = comm_bytes_per_iter("all_to_all", upart, ipart, rank,
+                                user_container=ua, item_container=ia,
+                                implicit=True)
+    assert breakdown.get("all_to_all") and breakdown.get("psum")
+    assert traced == model, (traced, model, breakdown)

@@ -41,3 +41,59 @@ def test_mixed_none_slice_index_sorts_first():
 def test_make_mesh_runs_on_cpu_devices():
     mesh = make_mesh(4)
     assert mesh.devices.size == 4
+
+
+def test_simulated_two_slice_mesh_orders_and_bounds():
+    # CPU devices carry no slice_index; the simulated assignment drives
+    # the SAME slice-major code path a pod deployment takes, pinning
+    # device-order regrouping + the single midpoint DCN boundary
+    import jax
+
+    from tpu_als.parallel.mesh import simulated_slice_of
+
+    pool = jax.devices()[:8]
+    slice_of = simulated_slice_of(2, pool)
+    assert [slice_of(d) for d in sorted(pool, key=lambda d: d.id)] == \
+        [0, 0, 0, 0, 1, 1, 1, 1]
+    interleaved = [pool[k // 2 + 4 * (k % 2)] for k in range(8)]
+    mesh = make_mesh(devices=interleaved, slice_of=slice_of)
+    assert [slice_of(d) for d in mesh.devices.flat] == [0] * 4 + [1] * 4
+    assert slice_boundaries(interleaved, slice_of) == [4]
+
+
+def test_two_slice_training_matches_flat_mesh(rng):
+    """Training over a mesh whose device order was regrouped through the
+    slice-major path must equal the flat default mesh bit-for-layout:
+    mesh position, not physical device identity, carries the semantics
+    (SURVEY §5.8 'DCN across slices' — simulated; VERDICT r3 #5)."""
+    import jax
+    import numpy as np
+
+    from tpu_als.core.als import AlsConfig
+    from tpu_als.parallel.data import partition_balanced, shard_csr
+    from tpu_als.parallel.mesh import simulated_slice_of
+    from tpu_als.parallel.trainer import train_sharded
+
+    nU, nI, nnz, D = 40, 30, 500, 8
+    u = rng.integers(0, nU, nnz)
+    i = rng.integers(0, nI, nnz)
+    r = np.abs(rng.normal(size=nnz)).astype(np.float32) + 0.1
+    upart = partition_balanced(np.bincount(u, minlength=nU), D)
+    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
+    ush = shard_csr(upart, ipart, u, i, r, min_width=4)
+    ish = shard_csr(ipart, upart, i, u, r, min_width=4)
+    cfg = AlsConfig(rank=4, max_iter=2, reg_param=0.05,
+                    implicit_prefs=True, alpha=2.0, seed=0)
+
+    flat = make_mesh(D)
+    U0, V0 = train_sharded(flat, upart, ipart, ush, ish, cfg)
+
+    pool = jax.devices()[:D]
+    interleaved = [pool[k // 2 + (D // 2) * (k % 2)] for k in range(D)]
+    mesh2 = make_mesh(devices=interleaved,
+                      slice_of=simulated_slice_of(2, pool))
+    U1, V1 = train_sharded(mesh2, upart, ipart, ush, ish, cfg)
+    np.testing.assert_allclose(np.asarray(U1), np.asarray(U0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(V1), np.asarray(V0),
+                               rtol=1e-5, atol=1e-5)

@@ -295,60 +295,12 @@ class ALS(_ALSParams):
 
             if jax.process_count() > 1:
                 # the FIRST collective of every multi-process fit, on
-                # every configuration: agree on the knobs that decide
-                # which collectives follow (dataMode picks the id-map
-                # path, the observer knobs gate mp_cb's gathers).  A
-                # divergence would otherwise pair MISMATCHED collectives
-                # across processes — a distributed hang or a cryptic
-                # gloo shape error instead of this ValueError.
-                from jax.experimental import multihost_utils as mhu
+                # every configuration: a knob divergence must raise here
+                # instead of pairing MISMATCHED collectives later (a
+                # distributed hang or a cryptic gloo shape error)
+                from tpu_als.api.fitting import check_multiprocess_gate
 
-                interval = self.getCheckpointInterval()
-                ckpt_on = (self.checkpointDir is not None
-                           and interval >= 1)
-                # with sharded checkpoints every peer's checkpointDir is
-                # load-bearing (each writes its own shard files); a
-                # divergent path would install a checkpoint silently
-                # missing shards — include a digest of the resolved dir
-                ckdir_digest = 0
-                if self.checkpointSharded and ckpt_on and self.checkpointDir:
-                    import hashlib
-                    import os as _os
-
-                    h = hashlib.blake2b(
-                        _os.path.abspath(self.checkpointDir).encode(),
-                        digest_size=8).digest()
-                    ckdir_digest = int(np.frombuffer(h, dtype=np.int64)[0])
-                # gatherStrategy decides WHICH collectives the compiled
-                # step issues (ring=ppermute, a2a=all_to_all, default=
-                # all_gather) and cgIters/cgMode decide the solver — a
-                # cross-process divergence in any of them pairs
-                # mismatched collectives or trains shards with different
-                # numerics, so they gate alongside the observer knobs
-                # (advisor r3, medium)
-                strat_code = ("all_gather", "ring",
-                              "all_to_all").index(self.gatherStrategy)
-                gate = np.asarray(mhu.process_allgather(np.array(
-                    [int(self.dataMode == "per_host"),
-                     int(self.fitCallback is not None),
-                     self.fitCallbackInterval,
-                     int(ckpt_on), interval,
-                     int(self.checkpointSharded), ckdir_digest,
-                     self.getMaxIter(),
-                     strat_code, self.cgIters,
-                     ("matfree", "dense").index(self.cgMode)],
-                    dtype=np.int64)))
-                if not (gate == gate[0]).all():
-                    raise ValueError(
-                        "processes disagree on multi-process fit config "
-                        "(dataMode, fitCallback present, "
-                        "fitCallbackInterval, checkpointing, "
-                        "checkpointInterval, checkpointSharded, "
-                        "checkpointDir digest, maxIter, gatherStrategy, "
-                        "cgIters, cgMode): "
-                        f"{gate.tolist()} — pass the SAME knobs on every "
-                        "process (peers may use an inert callback; only "
-                        "process 0's is invoked)")
+                check_multiprocess_gate(self)
         if self.dataMode == "per_host":
             # every process holds a DIFFERENT split, so the entity space
             # must be agreed before anything derives from it (id maps →
@@ -411,150 +363,17 @@ class ALS(_ALSParams):
             init = (c_U, c_V)
             start_iter = int(manifest.get("iteration") or 0)
 
-        callback = self._checkpoint_callback(user_map, item_map)
         if self.mesh is not None:
             import jax
 
-            from tpu_als.parallel.data import partition_balanced, shard_csr
-            from tpu_als.parallel.trainer import stacked_counts, train_sharded
+            from tpu_als.api.fitting import fit_multiprocess, fit_sharded
 
-            if jax.process_count() > 1:
-                # multi-process fit: processes pass the SAME dataset
-                # (dataMode='replicated') or each its own disjoint split
-                # (dataMode='per_host'; id maps agreed via
-                # global_id_union above, triples redistributed inside
-                # train_multihost); blocking is per-host, training
-                # crosses hosts via collectives, and the fitted factors
-                # are re-replicated for the (driver-side) model object.
-                # Same init/partitions/layout as the single-process mesh
-                # path -> identical factors (pinned by the two-process
-                # tests).  All three gather strategies + checkpoint/resume
-                # (gathers are collective, writes process-0-only; resume
-                # reads the shared-FS checkpoint on every host) +
-                # fitCallback (collective entity-space gather every
-                # fitCallbackInterval iterations, invoked on process 0 —
-                # the gather is the cost, the interval amortizes it).
-                from tpu_als.parallel.multihost import (
-                    gather_entity_factors,
-                    train_multihost,
-                )
-
-                # observer/dataMode agreement was checked by the gate at
-                # the top of fit — the FIRST collective on every path —
-                # so mp_cb's collectives below fire in lockstep
-                mp_cb = None
-                last_gather = {}  # iteration -> (Ue, Ve); reused below so
-                # a final-iteration gather isn't repeated after training
-                # (the most expensive end-of-training collective)
-                if callback is not None:
-                    def mp_cb(iteration, Us, Vs, up, ip):
-                        due_cb, due_ck = self._due(iteration)
-                        if due_ck and self.checkpointSharded:
-                            # factor bytes never cross hosts: each
-                            # process writes its own shards (barriers
-                            # inside); the gather below then happens
-                            # only when the callback needs it
-                            import os
-
-                            from tpu_als.parallel.multihost import (
-                                save_checkpoint_sharded,
-                            )
-
-                            save_checkpoint_sharded(
-                                os.path.join(self.checkpointDir,
-                                             "als_checkpoint"),
-                                Us, Vs, up, ip, user_map, item_map,
-                                self.mesh, params=self._ckpt_params(),
-                                iteration=iteration)
-                            due_ck = False
-                        if not (due_cb or due_ck):
-                            return
-                        # the gathers are collective: EVERY process runs
-                        # them; only process 0 observes the result
-                        Ue = gather_entity_factors(Us, up, self.mesh)
-                        Ve = gather_entity_factors(Vs, ip, self.mesh)
-                        last_gather.clear()
-                        last_gather[iteration] = (Ue, Ve)
-                        if jax.process_index() == 0:
-                            # same primitives the single-process callback
-                            # composes, gated by the shared _due rule
-                            if due_cb and self.fitCallback is not None:
-                                self.fitCallback(iteration, Ue, Ve)
-                            if due_ck:
-                                self._save_checkpoint(
-                                    user_map, item_map, iteration, Ue, Ve)
-
-                Us, Vs, upart, ipart = train_multihost(
-                    u_idx, i_idx, r, len(user_map), len(item_map), cfg,
-                    mesh=self.mesh,
-                    replicated=self.dataMode == "replicated",
-                    strategy=self.gatherStrategy,
-                    init=init, start_iter=start_iter, callback=mp_cb)
-                if cfg.max_iter in last_gather:
-                    U, V = last_gather[cfg.max_iter]
-                else:
-                    U = gather_entity_factors(Us, upart, self.mesh)
-                    V = gather_entity_factors(Vs, ipart, self.mesh)
-                return self._make_model(user_map, item_map, U, V)
-            D = self.mesh.devices.size
-            upart = partition_balanced(
-                np.bincount(u_idx, minlength=len(user_map)), D)
-            ipart = partition_balanced(
-                np.bincount(i_idx, minlength=len(item_map)), D)
-            strategy = self.gatherStrategy
-            ring_counts = None
-            if strategy == "ring":
-                from tpu_als.parallel.comm import shard_csr_grid
-
-                ush = shard_csr_grid(upart, ipart, u_idx, i_idx, r)
-                ish = shard_csr_grid(ipart, upart, i_idx, u_idx, r)
-                pos = cfg.implicit_prefs
-                ring_counts = (
-                    stacked_counts(upart, u_idx, r, positive_only=pos),
-                    stacked_counts(ipart, i_idx, r, positive_only=pos))
-            elif strategy == "all_to_all":
-                from tpu_als.parallel.a2a import build_a2a
-
-                ush = build_a2a(upart, ipart, u_idx, i_idx, r,
-                                on_degenerate="stub")
-                ish = build_a2a(ipart, upart, i_idx, u_idx, r,
-                                on_degenerate="stub")
-                if ush.degenerate or ish.degenerate:
-                    # one hot (src, dst) pair inflated the uniform request
-                    # budget to >= all_gather traffic — use the strategy
-                    # that actually bounds the bytes (build_a2a warned)
-                    strategy = "all_gather"
-                    ush = shard_csr(upart, ipart, u_idx, i_idx, r)
-                    ish = shard_csr(ipart, upart, i_idx, u_idx, r)
-            else:
-                ush = shard_csr(upart, ipart, u_idx, i_idx, r)
-                ish = shard_csr(ipart, upart, i_idx, u_idx, r)
-            from tpu_als.parallel.trainer import comm_bytes_per_iter
-
-            # observability (SURVEY §5.5 "gather bytes"): per-device
-            # collective traffic of the chosen strategy, readable after
-            # fit (the CLI prints it)
-            self.lastFitCommBytes = comm_bytes_per_iter(
-                strategy, upart, ipart, cfg.rank,
-                user_container=ush, item_container=ish,
-                implicit=cfg.implicit_prefs)
-            # `strategy` here is the EFFECTIVE one (a degenerate a2a plan
-            # falls back to all_gather above) — report that, not the
-            # request
-            self.lastFitStrategy = strategy
-            sharded_cb = None
-            if callback is not None:
-                def sharded_cb(iteration, U, V):  # slot space -> entity space
-                    callback(iteration,
-                             np.asarray(U)[upart.slot],
-                             np.asarray(V)[ipart.slot])
-            Us, Vs = train_sharded(self.mesh, upart, ipart, ush, ish, cfg,
-                                   callback=sharded_cb, init=init,
-                                   start_iter=start_iter, strategy=strategy,
-                                   ring_counts=ring_counts)
-            U = np.asarray(Us)[upart.slot]
-            V = np.asarray(Vs)[ipart.slot]
+            mode_fit = (fit_multiprocess if jax.process_count() > 1
+                        else fit_sharded)
+            U, V = mode_fit(self, u_idx, i_idx, r, user_map, item_map,
+                            cfg, init, start_iter)
         else:
+            callback = self._checkpoint_callback(user_map, item_map)
             ucsr = build_csr_buckets(u_idx, i_idx, r, len(user_map))
             icsr = build_csr_buckets(i_idx, u_idx, r, len(item_map))
             U, V = _train(ucsr, icsr, cfg, callback=callback, init=init,

@@ -1,0 +1,227 @@
+"""Execution-mode orchestration behind ``ALS.fit``.
+
+``fit`` (api/estimator.py) validates params, extracts columns, resolves id
+maps and resume state, then dispatches here.  One function per execution
+mode (SURVEY.md §2.E lanes):
+
+- :func:`check_multiprocess_gate` — the FIRST collective of every
+  multi-process fit: agree on every knob that decides which collectives
+  follow, so a divergence raises instead of pairing mismatched
+  collectives (a distributed hang).
+- :func:`fit_multiprocess` — N processes × local devices, gloo/ICI
+  collectives, replicated or per-host data (``parallel.multihost``).
+- :func:`fit_sharded` — single process over a device mesh
+  (``parallel.trainer``), all three gather strategies with the
+  degenerate-a2a fallback.
+
+Extracted from ``ALS.fit`` when it reached ~280 lines across four modes
+(VERDICT r3 weak #8); behavior-preserving, pinned by the existing fit
+equivalence tests (tests/test_sharded.py, tests/test_multihost.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def check_multiprocess_gate(est):
+    """Allgather + compare the fit knobs every process must share.
+
+    gatherStrategy decides WHICH collectives the compiled step issues
+    (ring=ppermute, a2a=all_to_all, default=all_gather) and cgIters/cgMode
+    decide the solver — a cross-process divergence in any of them pairs
+    mismatched collectives or trains shards with different numerics.
+    dataMode picks the id-map path; the observer knobs gate the
+    fitCallback gathers.  With sharded checkpoints every peer's
+    checkpointDir is load-bearing (each writes its own shard files), so a
+    digest of the resolved dir rides along.
+    """
+    from jax.experimental import multihost_utils as mhu
+
+    interval = est.getCheckpointInterval()
+    ckpt_on = est.checkpointDir is not None and interval >= 1
+    ckdir_digest = 0
+    if est.checkpointSharded and ckpt_on and est.checkpointDir:
+        import hashlib
+        import os
+
+        h = hashlib.blake2b(
+            os.path.abspath(est.checkpointDir).encode(),
+            digest_size=8).digest()
+        ckdir_digest = int(np.frombuffer(h, dtype=np.int64)[0])
+    strat_code = ("all_gather", "ring",
+                  "all_to_all").index(est.gatherStrategy)
+    gate = np.asarray(mhu.process_allgather(np.array(
+        [int(est.dataMode == "per_host"),
+         int(est.fitCallback is not None),
+         est.fitCallbackInterval,
+         int(ckpt_on), interval,
+         int(est.checkpointSharded), ckdir_digest,
+         est.getMaxIter(),
+         strat_code, est.cgIters,
+         ("matfree", "dense").index(est.cgMode)],
+        dtype=np.int64)))
+    if not (gate == gate[0]).all():
+        raise ValueError(
+            "processes disagree on multi-process fit config "
+            "(dataMode, fitCallback present, fitCallbackInterval, "
+            "checkpointing, checkpointInterval, checkpointSharded, "
+            "checkpointDir digest, maxIter, gatherStrategy, cgIters, "
+            f"cgMode): {gate.tolist()} — pass the SAME knobs on every "
+            "process (peers may use an inert callback; only process 0's "
+            "is invoked)")
+
+
+def fit_multiprocess(est, u_idx, i_idx, r, user_map, item_map, cfg,
+                     init, start_iter):
+    """Multi-process fit: processes pass the SAME dataset
+    (dataMode='replicated') or each its own disjoint split ('per_host';
+    id maps agreed via global_id_union, triples redistributed inside
+    train_multihost); blocking is per-host, training crosses hosts via
+    collectives, and the fitted factors are re-replicated for the
+    (driver-side) model object.  Same init/partitions/layout as the
+    single-process mesh path -> identical factors (pinned by the
+    two-process tests).  Checkpoint gathers are collective, writes
+    process-0-only; fitCallback gathers entity-space factors every
+    fitCallbackInterval iterations and is invoked on process 0 (the
+    gather is the cost, the interval amortizes it).
+
+    Returns entity-space ``(U, V)``.
+    """
+    import jax
+
+    from tpu_als.parallel.multihost import (
+        gather_entity_factors,
+        train_multihost,
+    )
+
+    callback = est._checkpoint_callback(user_map, item_map)
+    # observer/dataMode agreement was checked by the gate at the top of
+    # fit — the FIRST collective on every path — so mp_cb's collectives
+    # below fire in lockstep
+    mp_cb = None
+    last_gather = {}  # iteration -> (Ue, Ve); reused below so a
+    # final-iteration gather isn't repeated after training (the most
+    # expensive end-of-training collective)
+    if callback is not None:
+        def mp_cb(iteration, Us, Vs, up, ip):
+            due_cb, due_ck = est._due(iteration)
+            if due_ck and est.checkpointSharded:
+                # factor bytes never cross hosts: each process writes
+                # its own shards (barriers inside); the gather below
+                # then happens only when the callback needs it
+                import os
+
+                from tpu_als.parallel.multihost import (
+                    save_checkpoint_sharded,
+                )
+
+                save_checkpoint_sharded(
+                    os.path.join(est.checkpointDir, "als_checkpoint"),
+                    Us, Vs, up, ip, user_map, item_map,
+                    est.mesh, params=est._ckpt_params(),
+                    iteration=iteration)
+                due_ck = False
+            if not (due_cb or due_ck):
+                return
+            # the gathers are collective: EVERY process runs them; only
+            # process 0 observes the result
+            Ue = gather_entity_factors(Us, up, est.mesh)
+            Ve = gather_entity_factors(Vs, ip, est.mesh)
+            last_gather.clear()
+            last_gather[iteration] = (Ue, Ve)
+            if jax.process_index() == 0:
+                # same primitives the single-process callback composes,
+                # gated by the shared _due rule
+                if due_cb and est.fitCallback is not None:
+                    est.fitCallback(iteration, Ue, Ve)
+                if due_ck:
+                    est._save_checkpoint(
+                        user_map, item_map, iteration, Ue, Ve)
+
+    Us, Vs, upart, ipart = train_multihost(
+        u_idx, i_idx, r, len(user_map), len(item_map), cfg,
+        mesh=est.mesh,
+        replicated=est.dataMode == "replicated",
+        strategy=est.gatherStrategy,
+        init=init, start_iter=start_iter, callback=mp_cb)
+    if cfg.max_iter in last_gather:
+        return last_gather[cfg.max_iter]
+    U = gather_entity_factors(Us, upart, est.mesh)
+    V = gather_entity_factors(Vs, ipart, est.mesh)
+    return U, V
+
+
+def fit_sharded(est, u_idx, i_idx, r, user_map, item_map, cfg,
+                init, start_iter):
+    """Single-process fit over a device mesh: balanced entity partitions,
+    per-strategy rating containers (with the degenerate-a2a -> all_gather
+    fallback), traffic model bookkeeping, then ``train_sharded``.
+
+    Returns entity-space ``(U, V)``.
+    """
+    from tpu_als.parallel.data import partition_balanced, shard_csr
+    from tpu_als.parallel.trainer import (
+        comm_bytes_per_iter,
+        stacked_counts,
+        train_sharded,
+    )
+
+    callback = est._checkpoint_callback(user_map, item_map)
+    D = est.mesh.devices.size
+    upart = partition_balanced(
+        np.bincount(u_idx, minlength=len(user_map)), D)
+    ipart = partition_balanced(
+        np.bincount(i_idx, minlength=len(item_map)), D)
+    strategy = est.gatherStrategy
+    ring_counts = None
+    if strategy == "ring":
+        from tpu_als.parallel.comm import shard_csr_grid
+
+        ush = shard_csr_grid(upart, ipart, u_idx, i_idx, r)
+        ish = shard_csr_grid(ipart, upart, i_idx, u_idx, r)
+        pos = cfg.implicit_prefs
+        ring_counts = (
+            stacked_counts(upart, u_idx, r, positive_only=pos),
+            stacked_counts(ipart, i_idx, r, positive_only=pos))
+    elif strategy == "all_to_all":
+        from tpu_als.parallel.a2a import build_a2a
+
+        ush = build_a2a(upart, ipart, u_idx, i_idx, r,
+                        on_degenerate="stub")
+        ish = build_a2a(ipart, upart, i_idx, u_idx, r,
+                        on_degenerate="stub")
+        if ush.degenerate or ish.degenerate:
+            # one hot (src, dst) pair inflated the uniform request
+            # budget to >= all_gather traffic — use the strategy that
+            # actually bounds the bytes (build_a2a warned)
+            strategy = "all_gather"
+            ush = shard_csr(upart, ipart, u_idx, i_idx, r)
+            ish = shard_csr(ipart, upart, i_idx, u_idx, r)
+    else:
+        ush = shard_csr(upart, ipart, u_idx, i_idx, r)
+        ish = shard_csr(ipart, upart, i_idx, u_idx, r)
+
+    # observability (SURVEY §5.5 "gather bytes"): per-device collective
+    # traffic of the chosen strategy, readable after fit (the CLI prints
+    # it).  `strategy` is the EFFECTIVE one (a degenerate a2a plan fell
+    # back to all_gather above) — report that, not the request.
+    est.lastFitCommBytes = comm_bytes_per_iter(
+        strategy, upart, ipart, cfg.rank,
+        user_container=ush, item_container=ish,
+        implicit=cfg.implicit_prefs)
+    est.lastFitStrategy = strategy
+
+    sharded_cb = None
+    if callback is not None:
+        def sharded_cb(iteration, U, V):  # slot space -> entity space
+            callback(iteration,
+                     np.asarray(U)[upart.slot],
+                     np.asarray(V)[ipart.slot])
+    Us, Vs = train_sharded(est.mesh, upart, ipart, ush, ish, cfg,
+                           callback=sharded_cb, init=init,
+                           start_iter=start_iter, strategy=strategy,
+                           ring_counts=ring_counts)
+    U = np.asarray(Us)[upart.slot]
+    V = np.asarray(Vs)[ipart.slot]
+    return U, V

@@ -28,40 +28,68 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
 AXIS = "d"
 
 
-def order_devices_slice_major(devices):
+def _default_slice_of(device):
+    """The platform's slice assignment: ``device.slice_index`` on
+    multi-slice TPU deployments, None elsewhere (single slice, CPU)."""
+    return getattr(device, "slice_index", None)
+
+
+def simulated_slice_of(n_slices, all_devices=None):
+    """A ``slice_of`` callable that partitions ``all_devices`` (default:
+    ``jax.devices()``) into ``n_slices`` equal contiguous-by-id groups.
+
+    CPU devices carry no ``slice_index``, so the multi-slice code path —
+    slice-major ordering, boundary accounting, collectives whose device
+    order crosses a slice boundary — could otherwise never be exercised
+    without pod hardware.  Tests and the driver dryrun pass this to
+    :func:`make_mesh` to pin that path on the forced-host-device CPU
+    backend (SURVEY.md §5.8 "DCN across slices").
+    """
+    devices = sorted(all_devices or jax.devices(), key=lambda d: d.id)
+    per = max(1, (len(devices) + n_slices - 1) // n_slices)
+    assignment = {d.id: k // per for k, d in enumerate(devices)}
+    return lambda d: assignment[d.id]
+
+
+def order_devices_slice_major(devices, slice_of=None):
     """Sort devices so same-slice devices are contiguous.
 
-    Uses ``device.slice_index`` where the platform exposes it (multi-slice
+    ``slice_of`` maps a device to its slice index; the default reads
+    ``device.slice_index`` where the platform exposes it (multi-slice
     TPU deployments; single-slice and CPU devices don't have it and keep
-    their given order).  The sort is stable on slice_index alone, so a
-    caller-chosen intra-slice order (e.g. a custom ring) is preserved.
+    their given order).  The sort is stable on the slice index alone, so
+    a caller-chosen intra-slice order (e.g. a custom ring) is preserved.
     """
+    slice_of = slice_of or _default_slice_of
     devices = list(devices)
-    if any(getattr(d, "slice_index", None) is not None for d in devices):
-        devices.sort(key=lambda d: getattr(d, "slice_index", 0) or 0)
+    if any(slice_of(d) is not None for d in devices):
+        devices.sort(key=lambda d: slice_of(d) or 0)
     return devices
 
 
-def make_mesh(n_devices=None, devices=None, axis=AXIS):
+def make_mesh(n_devices=None, devices=None, axis=AXIS, slice_of=None):
     """1-D mesh over ``n_devices`` (default: all) devices, slice-major
     ordered.  Ordering happens BEFORE truncation, so asking for one slice's
     worth of devices on a multi-slice deployment yields ICI-connected
-    devices of the first slice, not an interleaved sample crossing DCN."""
+    devices of the first slice, not an interleaved sample crossing DCN.
+    ``slice_of`` overrides the platform slice assignment (see
+    :func:`simulated_slice_of`)."""
     if devices is None:
-        devices = order_devices_slice_major(jax.devices())
+        devices = order_devices_slice_major(jax.devices(), slice_of)
         if n_devices is not None:
             devices = devices[:n_devices]
     else:
-        devices = order_devices_slice_major(devices)
+        devices = order_devices_slice_major(devices, slice_of)
     return Mesh(np.asarray(devices), (axis,))
 
 
-def slice_boundaries(devices):
+def slice_boundaries(devices, slice_of=None):
     """Positions in the 1-D (slice-major) order where a DCN hop occurs —
     observability helper for the ring strategy's cost model: bytes moved
     over DCN per iteration = boundary_count × shard_bytes."""
-    devices = order_devices_slice_major(devices)
-    slices = [getattr(d, "slice_index", 0) or 0 for d in devices]
+    slice_of = slice_of or _default_slice_of
+    devices = order_devices_slice_major(devices, slice_of)
+    slices = [slice_of(d) or 0 for d in devices]
     return [k for k in range(1, len(slices)) if slices[k] != slices[k - 1]]
 
 

@@ -1,0 +1,223 @@
+"""Adversarial ingest robustness (SURVEY.md §2.A1; VERDICT r3 #8): the
+native fastcsv parser against a pure-Python oracle on hostile inputs —
+agreement byte-for-byte where the input is legal, a CLEAN error where it
+is not (never a silently zero-filled or nan row entering training) — plus
+hostile layouts through the native bucketizer vs the numpy blocking path.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_als.io.fastcsv import load_ratings_csv
+
+
+def _oracle(text, delim=",", skip_header=1):
+    """Python-int/float parse — exact for full-int64 ids (the numpy
+    float64 fallback is NOT, above 2^53)."""
+    rows = []
+    for k, ln in enumerate(text.split("\n")):
+        if k < skip_header:
+            continue
+        ln = ln.rstrip("\r").rstrip(" ")
+        if not ln:
+            continue
+        u, i, r, t = ln.split(delim)
+        rows.append((int(u), int(i), float(r), int(t)))
+    u = np.array([r[0] for r in rows], np.int64)
+    i = np.array([r[1] for r in rows], np.int64)
+    r_ = np.array([r[2] for r in rows], np.float32)
+    t = np.array([r[3] for r in rows], np.int64)
+    return u, i, r_, t
+
+
+def _check_agreement(tmp_path, text, delim=",", skip_header=1):
+    p = tmp_path / "ratings.csv"
+    p.write_bytes(text.encode())
+    got = load_ratings_csv(str(p), delim=delim, skip_header=skip_header)
+    want = _oracle(text, delim, skip_header)
+    for g, w, name in zip(got, want, ("user", "item", "rating", "ts")):
+        np.testing.assert_array_equal(g, w, err_msg=name)
+    return got
+
+
+HEADER = "userId,movieId,rating,timestamp\n"
+
+
+def test_crlf_line_endings(tmp_path):
+    text = HEADER.replace("\n", "\r\n") + \
+        "1,10,3.5,100\r\n2,20,4.0,200\r\n3,30,0.5,300\r\n"
+    u, i, r, t = _check_agreement(tmp_path, text)
+    assert len(u) == 3 and r[1] == np.float32(4.0)
+
+
+def test_missing_final_newline(tmp_path):
+    text = HEADER + "1,10,3.5,100\n2,20,4.0,200"
+    u, _, _, t = _check_agreement(tmp_path, text)
+    assert len(u) == 2 and t[-1] == 200
+
+
+def test_scientific_notation_and_negative_ratings(tmp_path):
+    text = HEADER + "1,10,4.5e-1,100\n2,20,-1.25E2,200\n3,30,.5,300\n"
+    _, _, r, _ = _check_agreement(tmp_path, text)
+    np.testing.assert_array_equal(
+        r, np.array([0.45, -125.0, 0.5], np.float32))
+
+
+def test_full_int64_ids_exact(tmp_path):
+    # ids above 2^53: the numpy float64 fallback rounds these; the
+    # native parser must carry them exactly
+    big = (1 << 53) + 1
+    text = HEADER + f"{big},10,3.0,100\n{big + 2},{big + 4},4.0,{big}\n"
+    u, i, _, t = _check_agreement(tmp_path, text)
+    assert u[0] == big and u[1] == big + 2
+    assert i[1] == big + 4 and t[1] == big
+    # and the float64 path would NOT have preserved them
+    assert int(np.float64(big)) != big
+
+
+def test_blank_lines_skipped(tmp_path):
+    text = HEADER + "1,10,3.5,100\n\n2,20,4.0,200\n\r\n\n3,30,1.0,300\n\n"
+    u, _, _, _ = _check_agreement(tmp_path, text)
+    assert len(u) == 3
+
+
+def test_trailing_spaces_tolerated(tmp_path):
+    text = HEADER + "1,10,3.5,100  \n2,20,4.0,200\n"
+    u, _, _, _ = _check_agreement(tmp_path, text)
+    assert len(u) == 2
+
+
+def test_tab_delimited_u_data_with_crlf(tmp_path):
+    text = "1\t10\t3\t100\r\n2\t20\t4\t200\r\n"
+    p = tmp_path / "u.data"
+    p.write_bytes(text.encode())
+    from tpu_als.io.fastcsv import load_u_data
+
+    u, i, r, t = load_u_data(str(p))
+    np.testing.assert_array_equal(u, [1, 2])
+    np.testing.assert_array_equal(r, np.array([3, 4], np.float32))
+
+
+def test_quoted_fields_raise_cleanly(tmp_path):
+    p = tmp_path / "q.csv"
+    p.write_text(HEADER + '"1","10","3.5","100"\n')
+    with pytest.raises(ValueError, match="malformed ratings line"):
+        load_ratings_csv(str(p))
+
+
+def test_truncated_line_raises_cleanly(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(HEADER + "1,10,3.5,100\n2,20\n3,30,1.0,300\n")
+    with pytest.raises(ValueError, match="malformed ratings line"):
+        load_ratings_csv(str(p))
+
+
+def test_extra_columns_raise_cleanly(tmp_path):
+    p = tmp_path / "x.csv"
+    p.write_text(HEADER + "1,10,3.5,100,999\n")
+    with pytest.raises(ValueError, match="malformed ratings line"):
+        load_ratings_csv(str(p))
+
+
+def test_non_numeric_field_raises_cleanly(tmp_path):
+    p = tmp_path / "n.csv"
+    p.write_text(HEADER + "1,ten,3.5,100\n")
+    with pytest.raises(ValueError, match="malformed ratings line"):
+        load_ratings_csv(str(p))
+
+
+def test_wrong_delimiter_raises_cleanly(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text(HEADER + "1;10;3.5;100\n")
+    with pytest.raises(ValueError, match="malformed ratings line"):
+        load_ratings_csv(str(p))
+
+
+def test_empty_file_and_header_only(tmp_path):
+    p = tmp_path / "e.csv"
+    p.write_text("")
+    u, i, r, t = load_ratings_csv(str(p))
+    assert len(u) == len(i) == len(r) == len(t) == 0
+    p.write_text(HEADER)
+    u, _, _, _ = load_ratings_csv(str(p))
+    assert len(u) == 0
+
+
+def test_page_multiple_sized_file(tmp_path):
+    # exactly PAGESIZE bytes with no trailing newline: the heap-copy
+    # path must engage (an mmap would end at the page boundary mid-field)
+    import mmap as _mmap
+
+    row = "7,8,1.5,9\n"
+    n_pad = _mmap.PAGESIZE - len(HEADER) - len(row) + 1
+    assert n_pad > 0
+    filler_count = n_pad // len(row)
+    rem = n_pad - filler_count * len(row)
+    text = (HEADER + row * filler_count
+            + "1" * rem + ",2,3.5,4\n")[:-1]  # strip final newline
+    text = text + "9" * (_mmap.PAGESIZE - len(text))
+    assert len(text) == _mmap.PAGESIZE
+    p = tmp_path / "page.csv"
+    p.write_bytes(text.encode())
+    got = load_ratings_csv(str(p))
+    want = _oracle(text)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_malformed_content_does_not_fall_back_to_numpy(tmp_path):
+    # io.movielens falls back to genfromtxt on OSError (build problems);
+    # malformed CONTENT must propagate as ValueError instead — the
+    # fallback would silently parse quoted rows as nan
+    from tpu_als.io.movielens import load_movielens_csv
+
+    p = tmp_path / "bad.csv"
+    p.write_text(HEADER + '"1","10","3.5","100"\n')
+    with pytest.raises(ValueError, match="malformed ratings line"):
+        load_movielens_csv(str(p))
+
+
+# ---- hostile layouts through the native bucketizer ------------------
+
+
+def test_bucketizer_single_mega_row(rng):
+    # one entity holds EVERY rating (the pathological power-law tail):
+    # native and numpy blocking must agree bit-for-bit
+    from tpu_als.core.ratings import build_csr_buckets
+    from tpu_als.io import fastbucket
+
+    if not fastbucket.available():
+        pytest.skip("native bucketizer unavailable")
+    nnz = 4096
+    rows = np.zeros(nnz, np.int64)
+    cols = rng.integers(0, 50, nnz)
+    vals = rng.normal(size=nnz).astype(np.float32)
+    a = build_csr_buckets(rows, cols, vals, 3, native=False)
+    b = build_csr_buckets(rows, cols, vals, 3, native=True)
+    assert a.nnz == b.nnz
+    for ba, bb in zip(a.buckets, b.buckets):
+        np.testing.assert_array_equal(ba.rows, bb.rows)
+        np.testing.assert_array_equal(ba.cols, bb.cols)
+        np.testing.assert_array_equal(ba.vals, bb.vals)
+        np.testing.assert_array_equal(ba.mask, bb.mask)
+
+
+def test_bucketizer_boundary_ids(rng):
+    # ids exactly at num_rows-1 and 0, many empty entities between:
+    # native == numpy, and only the two rated entities appear
+    from tpu_als.core.ratings import build_csr_buckets
+    from tpu_als.io import fastbucket
+
+    if not fastbucket.available():
+        pytest.skip("native bucketizer unavailable")
+    num_rows = 1000
+    rows = np.array([0, num_rows - 1, 0, num_rows - 1], np.int64)
+    cols = np.array([1, 2, 3, 4], np.int64)
+    vals = np.ones(4, np.float32)
+    a = build_csr_buckets(rows, cols, vals, num_rows, native=False)
+    b = build_csr_buckets(rows, cols, vals, num_rows, native=True)
+    for x, y in zip(a.buckets, b.buckets):
+        np.testing.assert_array_equal(x.rows, y.rows)
+        np.testing.assert_array_equal(x.cols, y.cols)
+    flat_rows = np.concatenate([bk.rows for bk in b.buckets])
+    assert set(flat_rows[flat_rows < num_rows]) == {0, num_rows - 1}

@@ -209,6 +209,18 @@ def cmd_recommend(args):
     from tpu_als.utils.frame import ColumnarFrame
 
     model = ALSModel.load(args.model)
+    if getattr(args, "foldin_data", None):
+        # the full serving flow in one command (SURVEY.md §3.5): fold the
+        # new ratings into the loaded model's user factors (item factors
+        # fixed), then recommend — new users in the fold-in data become
+        # recommendable without a refit
+        from tpu_als.stream.microbatch import FoldInServer
+
+        batch = _load_data(args.foldin_data)
+        srv = FoldInServer(model)
+        touched = srv.update(batch)
+        print(f"folded in {len(batch)} ratings touching "
+              f"{len(touched)} users", file=sys.stderr)
     if args.users:
         ids = np.array([int(x) for x in args.users.split(",")])
         recs = model.recommendForUserSubset(
@@ -342,6 +354,10 @@ def main(argv=None):
     r.add_argument("--k", type=int, default=10)
     r.add_argument("--limit", type=int, default=20,
                    help="max users to print (0 = all)")
+    r.add_argument("--foldin-data", default=None,
+                   help="ratings (csv:path / udata:path) to fold into the "
+                        "user factors before recommending — serves new "
+                        "ratings/users without a refit")
     r.set_defaults(fn=cmd_recommend)
 
     g = sub.add_parser("tune", help="cross-validated grid search")

@@ -49,19 +49,34 @@ def _load():
 
 
 def load_ratings_csv(path, delim=",", skip_header=1, n_threads=None):
-    """Parse a ratings file into (users, items, ratings, timestamps)."""
+    """Parse a ratings file into (users, items, ratings, timestamps).
+
+    Strict: a malformed data line (quoted fields, missing/extra columns,
+    trailing junk — see native/fastcsv.cc) raises ``ValueError`` rather
+    than letting a zero-filled row enter training.  ``ValueError`` is
+    deliberately NOT an ``OSError``: callers with a numpy fallback
+    (io.movielens) fall back on build/load problems, never on malformed
+    content (the fallback would silently parse such rows as nan).
+    """
     lib = _load()
     if n_threads is None:
         n_threads = min(16, os.cpu_count() or 1)
-    if os.path.getsize(path) == 0:
+    size = os.path.getsize(path)
+    if size == 0:
         return (np.empty(0, np.int64), np.empty(0, np.int64),
                 np.empty(0, np.float32), np.empty(0, np.int64))
+    use_mmap = size % mmap.PAGESIZE != 0
     with open(path, "rb") as f:
         # ACCESS_COPY: buffer-protocol-writable (ctypes.from_buffer needs
-        # that) but copy-on-write — we never write, so reads are zero-copy
-        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
+        # that) but copy-on-write — we never write, so reads are zero-copy.
+        # Exception: a file of exactly page-multiple size with no final
+        # newline would let strtoll touch the unmapped next page (the
+        # parser reads a field up to its terminator); for that rare shape
+        # read a heap copy with one byte of slack instead.
+        mm = (mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
+              if use_mmap else bytearray(f.read() + b"\n"))
         try:
-            length = len(mm)
+            length = size if use_mmap else size + 1
             buf = (ctypes.c_char * length).from_buffer(mm)
             n = lib.fastcsv_count(buf, length, skip_header)
             users = np.empty(n, dtype=np.int64)
@@ -77,7 +92,13 @@ def load_ratings_csv(path, delim=",", skip_header=1, n_threads=None):
             )
         finally:
             del buf  # release the exported buffer before closing the mmap
-            mm.close()
+            if use_mmap:
+                mm.close()
+    if wrote == -2:
+        raise ValueError(
+            f"malformed ratings line in {path}: every data line must be "
+            f"int{delim}int{delim}float{delim}int (no quotes, no extra "
+            "columns); empty lines are allowed")
     if wrote != n:
         raise IOError(f"fastcsv parsed {wrote} rows, expected {n} ({path})")
     return users, items, ratings, ts

@@ -9,8 +9,19 @@
 // than python csv at ML-25M scale.  Bound via ctypes (no pybind11 in this
 // image).
 //
+// Strictness contract (adversarial-ingest hardening, VERDICT r3 #8): every
+// data line must be exactly `int<delim>int<delim>float<delim>int` with an
+// optional trailing `\r` / spaces; empty lines (and `\r`-only lines) are
+// skipped.  Anything else — quoted fields, missing fields, trailing junk,
+// extra columns — makes fastcsv_parse return -2 so the Python wrapper can
+// raise a clean error instead of a zero-filled row entering training.
+// CRLF endings, a missing final newline, scientific-notation floats, and
+// full-int64 ids are all accepted (the ids notably exceed the float64
+// mantissa the numpy fallback rides through).
+//
 // Build: g++ -O3 -march=native -shared -fPIC -pthread fastcsv.cc -o libfastcsv.so
 
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -26,45 +37,64 @@ struct Span {
   int64_t out_offset;  // first output row index for this span
 };
 
-// count newlines in [b, e)
+// [b, eol) of one line with the trailing '\r' stripped; empty -> skip
+inline const char* strip_eol(const char* b, const char* eol) {
+  if (eol > b && eol[-1] == '\r') --eol;
+  return eol;
+}
+
+// count NON-EMPTY lines in [b, e)
 int64_t count_lines(const char* b, const char* e) {
   int64_t n = 0;
   while (b < e) {
     const char* p = static_cast<const char*>(memchr(b, '\n', e - b));
-    if (!p) {
-      n += (e > b);  // last line without trailing newline
-      break;
-    }
-    ++n;
+    const char* eol = p ? p : e;
+    if (strip_eol(b, eol) > b) ++n;
+    if (!p) break;
     b = p + 1;
   }
   return n;
 }
 
-// parse one line "user<delim>item<delim>rating<delim>ts"; returns chars used
-inline const char* parse_line(const char* p, const char* end, char delim,
-                              int64_t* u, int64_t* i, float* r, int64_t* t) {
+// strict parse of one line body [p, eol): exactly 4 delimited fields.
+// strtoll/strtof stop at the terminating '\n'/delim, and every field is
+// bounds-checked against eol, so they never consume past the line.
+inline bool parse_fields(const char* p, const char* eol, char delim,
+                         int64_t* u, int64_t* i, float* r, int64_t* t) {
   char* q;
   *u = strtoll(p, &q, 10);
-  p = (*q == delim) ? q + 1 : q;
+  if (q == p || q >= eol || *q != delim) return false;
+  p = q + 1;
   *i = strtoll(p, &q, 10);
-  p = (*q == delim) ? q + 1 : q;
+  if (q == p || q >= eol || *q != delim) return false;
+  p = q + 1;
   *r = strtof(p, &q);
-  p = (*q == delim) ? q + 1 : q;
+  if (q == p || q >= eol || *q != delim) return false;
+  p = q + 1;
   *t = strtoll(p, &q, 10);
-  p = q;
-  const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
-  return nl ? nl + 1 : end;
+  if (q == p || q > eol) return false;
+  for (p = q; p < eol && *p == ' '; ++p) {}
+  return p == eol;
 }
 
 void parse_span(Span span, char delim, int64_t* users, int64_t* items,
-                float* ratings, int64_t* ts) {
+                float* ratings, int64_t* ts, std::atomic<bool>* bad) {
   const char* p = span.begin;
   int64_t row = span.out_offset;
   while (p < span.end) {
-    p = parse_line(p, span.end, delim, &users[row], &items[row],
-                   &ratings[row], &ts[row]);
-    ++row;
+    if (bad->load(std::memory_order_relaxed)) return;
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', span.end - p));
+    const char* eol = strip_eol(p, nl ? nl : span.end);
+    if (eol > p) {
+      if (!parse_fields(p, eol, delim, &users[row], &items[row],
+                        &ratings[row], &ts[row])) {
+        bad->store(true, std::memory_order_relaxed);
+        return;
+      }
+      ++row;
+    }
+    p = nl ? nl + 1 : span.end;
   }
 }
 
@@ -85,7 +115,7 @@ int64_t fastcsv_count(const char* buf, int64_t len, int skip_header) {
 }
 
 // Parse into preallocated arrays of length >= fastcsv_count(...).
-// Returns rows written, or -1 on error.
+// Returns rows written, -1 on a header error, -2 on a malformed data line.
 int64_t fastcsv_parse(const char* buf, int64_t len, char delim,
                       int skip_header, int n_threads, int64_t* users,
                       int64_t* items, float* ratings, int64_t* ts) {
@@ -125,13 +155,15 @@ int64_t fastcsv_parse(const char* buf, int64_t len, char delim,
     spans[k].out_offset = off;
     off += counts[k];
   }
+  std::atomic<bool> bad{false};
   {
     std::vector<std::thread> th;
     for (auto& s : spans)
       th.emplace_back([&, s] { parse_span(s, delim, users, items,
-                                          ratings, ts); });
+                                          ratings, ts, &bad); });
     for (auto& t : th) t.join();
   }
+  if (bad.load()) return -2;
   return off;
 }
 

@@ -50,6 +50,11 @@ def collective_bytes(fn, *args, axis_size):
     """
     closed = jax.make_jaxpr(fn)(*args)
     breakdown = {}
+    # one name set for both the byte counter and the while-loop guard —
+    # a primitive recognized by one but not the other would let a
+    # collective hide inside a while body uncounted
+    COLLECTIVES = ("all_gather", "ppermute", "psum", "psum2",
+                   "psum_invariant", "all_to_all")
 
     def add(name, nbytes):
         breakdown[name] = breakdown.get(name, 0) + int(nbytes)
@@ -92,8 +97,7 @@ def collective_bytes(fn, *args, axis_size):
 
         def probe(jp):
             for eqn in jp.eqns:
-                if eqn.primitive.name in ("all_gather", "ppermute", "psum",
-                                          "all_to_all"):
+                if eqn.primitive.name in COLLECTIVES:
                     found.append(eqn.primitive.name)
                 for p in ("jaxpr", "call_jaxpr", "body_jaxpr",
                           "cond_jaxpr"):

@@ -6,9 +6,11 @@ synthetic problem sized to ONE v5e core at the production rank (nnz and
 entity counts scaled to 1/32 of the full set, rank kept at 256).  What it
 establishes on real hardware:
 
-- the rank-256 solve path (``pallas_solve`` — the lanes kernel caps at
-  rank 128, so config 3 rides the blocked kernel): probe outcome and
-  resolved dispatch are printed;
+- the rank-256 solve path (the flat lanes kernel caps at rank 128, so
+  config 3 rides ``pallas_lanes_blocked`` — the out-of-core lanes
+  factorization — with ``pallas_solve`` as the probe fallback): probe
+  outcomes, the resolved dispatch, AND a direct solve-kernel A/B
+  (xla vs pallas vs lanes_blocked) are printed;
 - seconds/iteration for the full half-step pipeline at rank 256;
 - peak HBM via ``device.memory_stats()`` — the model the CPU-mesh tests
   (tests/test_rank256.py) verify shape-by-shape, priced on chip.
@@ -40,6 +42,9 @@ def main():
                     help="shrink users/items/nnz together (quick checks)")
     ap.add_argument("--platform", default="default",
                     choices=["default", "cpu"])
+    ap.add_argument("--solve-ab", type=int, default=8192,
+                    help="SPD systems for the rank-256 solve-kernel A/B "
+                         "(xla vs pallas vs lanes_blocked); 0 disables")
     args = ap.parse_args()
 
     metric = f"als_iters_per_sec_rank{args.rank}_single_core_proxy"
@@ -90,6 +95,39 @@ def main():
     backends = resolve_solve_path(cfg, cfg.rank)
     log(f"resolved rank-{args.rank} backends: {backends}")
 
+    # solve-kernel A/B at the production rank: xla vs pallas (blocked
+    # first-gen) vs lanes_blocked (out-of-core lanes) on one batch of
+    # SPD systems — records which kernel should own rank 256 on THIS
+    # chip (the auto order is a projection until this measures it)
+    solve_ab = {}
+    if args.solve_ab > 0:
+        import jax.numpy as jnp
+
+        from tpu_als.ops.solve import solve_spd
+
+        rng = np.random.default_rng(0)
+        nsys = args.solve_ab
+        M = rng.normal(size=(nsys, args.rank, args.rank)).astype(
+            np.float32) / np.sqrt(args.rank)
+        A = jnp.asarray(M @ np.swapaxes(M, 1, 2)
+                        + 0.5 * np.eye(args.rank, dtype=np.float32)[None])
+        bb = jnp.asarray(
+            rng.normal(size=(nsys, args.rank)).astype(np.float32))
+        cnt = jnp.ones((nsys,), jnp.float32)
+        for be in ("xla", "pallas", "lanes_blocked"):
+            try:
+                x = solve_spd(A, bb, cnt, backend=be)
+                x.block_until_ready()  # compile + 1 run
+                t0 = time.time()
+                for _ in range(3):
+                    x = solve_spd(A, bb, cnt, backend=be)
+                x.block_until_ready()
+                solve_ab[be] = round((time.time() - t0) / 3, 4)
+                log(f"solve A/B {be}: {solve_ab[be]}s for {nsys} systems")
+            except Exception as e:
+                solve_ab[be] = f"failed: {type(e).__name__}"
+                log(f"solve A/B {be} failed: {e}")
+
     key = jax.random.PRNGKey(0)
     ku, kv = jax.random.split(key)
     U = init_factors(ku, nU, cfg.rank)
@@ -134,6 +172,7 @@ def main():
             "peak_hbm_gb": round(peak / 1e9, 3) if peak else None,
             "tflops_per_iter_analytic": round(flops / 1e12, 3),
             "achieved_tflops": round(flops * ips / 1e12, 3),
+            "solve_ab_seconds": solve_ab,
             "device": str(jax.devices()[0]),
             **backends,
         },

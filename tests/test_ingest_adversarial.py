@@ -133,6 +133,30 @@ def test_wrong_delimiter_raises_cleanly(tmp_path):
         load_ratings_csv(str(p))
 
 
+def test_nan_inf_ratings_raise_cleanly(tmp_path):
+    # strtof accepts 'nan'/'inf' spellings — the parser must not let a
+    # non-finite rating poison the factor accumulation (code-review r4)
+    for bad in ("nan", "inf", "-inf", "1e40"):
+        p = tmp_path / "f.csv"
+        p.write_text(HEADER + f"1,10,{bad},100\n")
+        with pytest.raises(ValueError, match="malformed ratings line"):
+            load_ratings_csv(str(p))
+
+
+def test_int64_overflow_raises_cleanly(tmp_path):
+    # an id beyond int64 would clamp to INT64_MAX and merge distinct
+    # entities — must be a clean error, not silent corruption
+    p = tmp_path / "o.csv"
+    p.write_text(HEADER + "99999999999999999999999,10,3.5,100\n")
+    with pytest.raises(ValueError, match="malformed ratings line"):
+        load_ratings_csv(str(p))
+    # float underflow in the rating is LEGAL (errno ERANGE from strtof
+    # must not leak into the timestamp's overflow check)
+    p.write_text(HEADER + "1,10,1e-50,100\n")
+    u, _, r, _ = load_ratings_csv(str(p))
+    assert len(u) == 1 and abs(float(r[0])) < 1e-30
+
+
 def test_empty_file_and_header_only(tmp_path):
     p = tmp_path / "e.csv"
     p.write_text("")

@@ -1,0 +1,81 @@
+"""Blocked out-of-core lanes Cholesky (ranks > 128 — the rank-256
+config-3 solve path, VERDICT r3 #4) vs dense references, in interpret
+mode on the CPU test mesh; the same kernel compiles for real on TPU and
+is A/B-timed against pallas_solve by scripts/rank256_proxy.py."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tpu_als.ops.pallas_lanes_blocked import (
+    LANES,
+    chol_lanes_blocked,
+    spd_solve_lanes_blocked,
+    supported_rank,
+)
+
+
+def _spd_problem(rng, N, r):
+    M = rng.normal(size=(N, r, r)).astype(np.float32) / np.sqrt(r)
+    A = M @ M.transpose(0, 2, 1) + 0.5 * np.eye(r, dtype=np.float32)
+    b = rng.normal(size=(N, r)).astype(np.float32)
+    return jnp.asarray(A), jnp.asarray(b)
+
+
+@pytest.mark.parametrize("N,r", [
+    (6, 256),          # two 128-blocks, one lane group (batch-padded)
+    (LANES + 2, 256),  # two lane groups
+    (5, 200),          # rank pads 200 -> 256, identity-padded tail
+    (4, 384),          # three blocks: exercises the m<k streamed loops
+])
+def test_factor_matches_numpy_cholesky(rng, N, r):
+    A, _ = _spd_problem(rng, N, r)
+    L = np.asarray(chol_lanes_blocked(A, interpret=True))
+    Lref = np.linalg.cholesky(np.asarray(A, np.float64))
+    denom = np.abs(Lref).max()
+    assert np.abs(L - Lref).max() / denom < 1e-4
+    # strictly lower-triangular output (upper blocks zeroed)
+    assert np.triu(L, 1).max() == 0.0
+
+
+@pytest.mark.parametrize("N,r", [(6, 256), (LANES + 2, 256), (5, 200)])
+def test_solve_matches_dense(rng, N, r):
+    A, b = _spd_problem(rng, N, r)
+    x = np.asarray(spd_solve_lanes_blocked(A, b, interpret=True))
+    ref = np.linalg.solve(np.asarray(A, np.float64),
+                          np.asarray(b, np.float64)[..., None])[..., 0]
+    denom = max(1.0, np.abs(ref).max())
+    assert np.abs(x - ref).max() / denom < 1e-3
+
+
+def test_supported_rank_partition():
+    # the flat lanes kernel owns <= 128; blocked owns everything above —
+    # together they cover every rank with no overlap
+    from tpu_als.ops.pallas_lanes import supported_rank as flat_ok
+
+    for r in (8, 64, 128, 129, 200, 256, 384, 512):
+        assert supported_rank(r) != flat_ok(r), r
+
+
+def test_solve_spd_dispatch_accepts_lanes_blocked(rng):
+    # forced-backend path exists; off-TPU the kernel itself cannot run,
+    # so only the backend-name validation is checked here (the real
+    # dispatch is exercised on chip by rank256_proxy)
+    from tpu_als.ops.solve import solve_spd
+
+    A, b = _spd_problem(rng, 4, 16)
+    with pytest.raises(ValueError, match="unknown solve backend"):
+        solve_spd(A, b, jnp.ones(4), backend="nope")
+
+
+def test_cold_rows_solve_to_zero(rng):
+    # solve_spd contract at rank 256: count == 0 rows -> x == 0 exactly
+    # (A replaced by I, b stays 0) — through the blocked kernel's
+    # factor+substitution path in interpret mode
+    N, r = 4, 256
+    A, _ = _spd_problem(rng, N, r)
+    b = jnp.zeros((N, r), jnp.float32)
+    eye = jnp.eye(r, dtype=jnp.float32)
+    Ar = jnp.where(jnp.zeros((N, 1, 1)) > 0, A, eye) + 1e-6 * eye
+    x = np.asarray(spd_solve_lanes_blocked(Ar, b, interpret=True))
+    assert np.abs(x).max() == 0.0

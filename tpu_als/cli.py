@@ -355,9 +355,9 @@ def main(argv=None):
     r.add_argument("--limit", type=int, default=20,
                    help="max users to print (0 = all)")
     r.add_argument("--foldin-data", default=None,
-                   help="ratings (csv:path / udata:path) to fold into the "
-                        "user factors before recommending — serves new "
-                        "ratings/users without a refit")
+                   help="ratings (csv:path / ml-100k:path) to fold into "
+                        "the user factors before recommending — serves "
+                        "new ratings/users without a refit")
     r.set_defaults(fn=cmd_recommend)
 
     g = sub.add_parser("tune", help="cross-validated grid search")

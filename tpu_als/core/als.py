@@ -81,8 +81,10 @@ def resolve_solve_path(cfg: AlsConfig, rank, matfree_capable=True):
     Returns a dict with ``resolved_solve_path`` ∈ {'einsum+nnls',
     'fused_pallas', 'matfree_cg{n}_warmstart' (inexact ALS, no NE einsum;
     n = cfg.cg_iters), 'einsum+cg{n}_warmstart' (inexact ALS on the
-    einsum-built A), 'einsum+pallas_lanes', 'einsum+pallas_cholesky',
-    'einsum+xla_cholesky'} plus the raw probe outcomes.
+    einsum-built A), 'einsum+pallas_lanes',
+    'einsum+pallas_lanes_blocked' (out-of-core lanes, ranks > 128),
+    'einsum+pallas_cholesky', 'einsum+xla_cholesky'} plus the raw probe
+    outcomes.
 
     ``matfree_capable=False``: the caller's half-step cannot apply A
     matrix-free (the ring strategy — its A is accumulated across
@@ -100,7 +102,7 @@ def resolve_solve_path(cfg: AlsConfig, rank, matfree_capable=True):
     # (round 2 ablation, ML-25M/25 rank 128) fused = 3.93 s/iter vs
     # einsum+pallas_cholesky = 0.114 s/iter — the VMEM-resident solve on
     # the einsum-built A wins; 'fused' stays available explicitly.
-    fused_ok = solve_ok = lanes_ok = None
+    fused_ok = solve_ok = lanes_ok = blocked_ok = None
     if cfg.nonnegative:
         path = "einsum+nnls"
     elif cfg.solve_backend == "fused":
@@ -119,16 +121,22 @@ def resolve_solve_path(cfg: AlsConfig, rank, matfree_capable=True):
         # IS the prewarm contract; the re-reads below are cache hits
         path = {
             "lanes": "einsum+pallas_lanes",
+            "lanes_blocked": "einsum+pallas_lanes_blocked",
             "pallas": "einsum+pallas_cholesky",
             "xla": "einsum+xla_cholesky",
         }[auto_solve_backend(rank)]
+        from tpu_als.ops import pallas_lanes_blocked
+
         lanes_ok = bool(tpu and pallas_lanes.available(rank))
-        solve_ok = (None if lanes_ok
+        blocked_ok = (None if lanes_ok
+                      else bool(tpu and pallas_lanes_blocked.available(rank)))
+        solve_ok = (None if (lanes_ok or blocked_ok)
                     else bool(tpu and pallas_solve.available(rank)))
     return {
         "solve_backend_requested": cfg.solve_backend,
         "fused_kernel_probe": fused_ok,
         "pallas_lanes_probe": lanes_ok,
+        "pallas_lanes_blocked_probe": blocked_ok,
         "pallas_solve_probe": solve_ok,
         "resolved_solve_path": path,
         "on_tpu": tpu,

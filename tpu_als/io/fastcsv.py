@@ -73,8 +73,12 @@ def load_ratings_csv(path, delim=",", skip_header=1, n_threads=None):
         # newline would let strtoll touch the unmapped next page (the
         # parser reads a field up to its terminator); for that rare shape
         # read a heap copy with one byte of slack instead.
-        mm = (mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
-              if use_mmap else bytearray(f.read() + b"\n"))
+        if use_mmap:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
+        else:  # one allocation, filled in place (no 2x transient peak)
+            mm = bytearray(size + 1)
+            f.readinto(memoryview(mm)[:size])
+            mm[size] = 0x0A
         try:
             length = size if use_mmap else size + 1
             buf = (ctypes.c_char * length).from_buffer(mm)

@@ -22,6 +22,8 @@
 // Build: g++ -O3 -march=native -shared -fPIC -pthread fastcsv.cc -o libfastcsv.so
 
 #include <atomic>
+#include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -59,20 +61,27 @@ int64_t count_lines(const char* b, const char* e) {
 // strict parse of one line body [p, eol): exactly 4 delimited fields.
 // strtoll/strtof stop at the terminating '\n'/delim, and every field is
 // bounds-checked against eol, so they never consume past the line.
+// errno (thread-local) catches int64 overflow — an overflowing id would
+// otherwise clamp to INT64_MAX and silently merge distinct entities —
+// and std::isfinite rejects nan/inf ratings, which strtof accepts as
+// valid spellings but which would poison the factor accumulation.
 inline bool parse_fields(const char* p, const char* eol, char delim,
                          int64_t* u, int64_t* i, float* r, int64_t* t) {
   char* q;
+  errno = 0;
   *u = strtoll(p, &q, 10);
-  if (q == p || q >= eol || *q != delim) return false;
+  if (q == p || errno == ERANGE || q >= eol || *q != delim) return false;
   p = q + 1;
   *i = strtoll(p, &q, 10);
-  if (q == p || q >= eol || *q != delim) return false;
+  if (q == p || errno == ERANGE || q >= eol || *q != delim) return false;
   p = q + 1;
   *r = strtof(p, &q);
-  if (q == p || q >= eol || *q != delim) return false;
+  if (q == p || !std::isfinite(*r) || q >= eol || *q != delim)
+    return false;
   p = q + 1;
+  errno = 0;  // strtof sets ERANGE on float underflow (a legal rating)
   *t = strtoll(p, &q, 10);
-  if (q == p || q > eol) return false;
+  if (q == p || errno == ERANGE || q > eol) return false;
   for (p = q; p < eol && *p == ' '; ++p) {}
   return p == eol;
 }

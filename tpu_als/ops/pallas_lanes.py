@@ -222,8 +222,9 @@ def supported_rank(rank):
     """VMEM feasibility: the [r, r, LANES] scratch must fit alongside the
     b/x blocks — r_pad = 128 uses 8 MiB of the 16 MiB scoped limit; the
     next multiple of 8 over 128 is already pushing 10+ MiB with DMA
-    staging, so the blocked kernel (tpu_als.ops.pallas_solve) owns ranks
-    above 128."""
+    staging.  Ranks above 128 are owned by the out-of-core blocked
+    variant of this layout (tpu_als.ops.pallas_lanes_blocked), with
+    tpu_als.ops.pallas_solve as the probe fallback."""
     r_pad = -(-rank // 8) * 8
     return r_pad <= 128
 

@@ -113,17 +113,22 @@ def auto_solve_backend(rank):
     ``prewarm_solve``, and ``resolve_solve_path`` (core/als.py), so the
     prewarmed probes are exactly the ones the dispatch consults.
 
-    Returns 'lanes' | 'pallas' | 'xla'.  Each Pallas kernel engages only
-    after its compile-and-validate probe passes on the local Mosaic
-    (probes are cached per process).
+    Returns 'lanes' | 'lanes_blocked' | 'pallas' | 'xla'.  Each Pallas
+    kernel engages only after its compile-and-validate probe passes on
+    the local Mosaic (probes are cached per process).  'lanes' owns
+    ranks <= 128 (whole working set VMEM-resident); 'lanes_blocked' owns
+    ranks above (same layout, 128-blocks streamed out-of-core —
+    tpu_als.ops.pallas_lanes_blocked; rank-256 config-3 path).
     """
-    from tpu_als.ops import pallas_lanes, pallas_solve
+    from tpu_als.ops import pallas_lanes, pallas_lanes_blocked, pallas_solve
     from tpu_als.utils.platform import on_tpu
 
     if not on_tpu():
         return "xla"
     if pallas_lanes.available(rank):
         return "lanes"
+    if pallas_lanes_blocked.available(rank):
+        return "lanes_blocked"
     if pallas_solve.available(rank):
         return "pallas"
     return "xla"
@@ -157,12 +162,15 @@ def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
     Pallas kernel (tpu_als.ops.pallas_lanes — the serial Cholesky
     recurrence vectorized across 128 matrices in the lane dimension;
     measured 2.2x the blocked kernel at rank 128 on v5e, rank <= 128
-    only), (2) the VMEM blocked-Cholesky kernel (tpu_als.ops.pallas_solve,
-    any rank), (3) the XLA cholesky/triangular_solve lowering — whose
-    column-sequential HBM passes are the training-loop bottleneck at
-    six-figure batch sizes.  Each kernel engages only when its
-    compile-and-validate probe passes on the local Mosaic version.
-    'lanes' / 'pallas' / 'xla' force a specific path.
+    only), (2) the out-of-core blocked lanes kernel for ranks above 128
+    (tpu_als.ops.pallas_lanes_blocked — same layout, 128-blocks streamed
+    through VMEM, substitutions on XLA), (3) the VMEM blocked-Cholesky
+    kernel (tpu_als.ops.pallas_solve, any rank), (4) the XLA
+    cholesky/triangular_solve lowering — whose column-sequential HBM
+    passes are the training-loop bottleneck at six-figure batch sizes.
+    Each kernel engages only when its compile-and-validate probe passes
+    on the local Mosaic version.  'lanes' / 'lanes_blocked' / 'pallas' /
+    'xla' force a specific path.
     """
     r = A.shape[-1]
     eye = jnp.eye(r, dtype=A.dtype)
@@ -170,9 +178,10 @@ def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
     A = jnp.where(empty, eye, A) + jitter * eye
     if backend == "auto":
         backend = auto_solve_backend(r)
-    if backend not in ("lanes", "pallas", "xla"):
-        raise ValueError(f"unknown solve backend {backend!r} "
-                         "(expected 'auto', 'lanes', 'pallas' or 'xla')")
+    if backend not in ("lanes", "lanes_blocked", "pallas", "xla"):
+        raise ValueError(f"unknown solve backend {backend!r} (expected "
+                         "'auto', 'lanes', 'lanes_blocked', 'pallas' or "
+                         "'xla')")
     if backend == "lanes":
         from tpu_als.ops import pallas_lanes
 
@@ -188,6 +197,10 @@ def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
         panel = (pallas_lanes.selected_panel(r)
                  if pallas_lanes.available(r) else 1)
         return pallas_lanes.spd_solve_lanes(A, b, panel=panel)
+    if backend == "lanes_blocked":
+        from tpu_als.ops.pallas_lanes_blocked import spd_solve_lanes_blocked
+
+        return spd_solve_lanes_blocked(A, b)
     if backend == "pallas":
         from tpu_als.ops.pallas_solve import spd_solve_pallas
 

@@ -7,10 +7,13 @@ fallback); default drives the real TPU.
 """
 
 import argparse
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 ap = argparse.ArgumentParser()
 ap.add_argument("--platform", default="default", choices=["default", "cpu"])
@@ -107,6 +110,37 @@ cfg_bf = AlsConfig(rank=8, max_iter=2, reg_param=0.01,
 Ub, Vb = train(ucsr, icsr, cfg_bf)
 assert np.isfinite(np.asarray(Ub)).all()
 print("nonnegative + bfloat16 ok")
+
+# streaming both directions: a NEW user then a NEW item through the
+# FoldInServer, each servable immediately (round-4 symmetric fold-in)
+from tpu_als.stream.microbatch import FoldInServer
+from tpu_als.utils.frame import ColumnarFrame
+
+srv = FoldInServer(model)
+known_items = model._item_map.ids[:6]
+assert srv.update(ColumnarFrame({
+    "user": np.full(6, 10**7), "item": known_items,
+    "rating": np.full(6, 5.0, np.float32)})).tolist() == [10**7]
+known_users = model._user_map.ids[:6]
+assert srv.update_items(ColumnarFrame({
+    "user": known_users, "item": np.full(6, 10**7 + 1),
+    "rating": np.full(6, 5.0, np.float32)})).tolist() == [10**7 + 1]
+p = model.transform({"user": np.array([10**7]),
+                     "item": np.array([10**7 + 1])})["prediction"]
+assert np.isfinite(p).all()
+print("fold-in server ok (new user + new item served)")
+
+# rank-256 blocked lanes factorization (interpret off-TPU, real on chip)
+from tpu_als.ops.pallas_lanes_blocked import chol_lanes_blocked
+
+M = rng.normal(size=(4, 256, 256)).astype(np.float32) / 16.0
+Aspd = jnp.asarray(M @ M.transpose(0, 2, 1)
+                   + 0.5 * np.eye(256, dtype=np.float32)[None])
+interp = args.platform == "cpu" or jax.devices()[0].platform != "tpu"
+Lb = np.asarray(chol_lanes_blocked(Aspd, interpret=interp))
+Lref = np.linalg.cholesky(np.asarray(Aspd, np.float64))
+assert np.abs(Lb - Lref).max() / np.abs(Lref).max() < 1e-3
+print("rank-256 blocked lanes cholesky ok")
 
 # two-tower filtered recall sanity
 from tpu_als.models.two_tower import (TwoTowerConfig, recall_at_k,

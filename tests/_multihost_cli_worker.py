@@ -126,6 +126,33 @@ if __name__ == "__main__":
             print("gate worker caught divergence", flush=True)
         else:
             raise AssertionError("divergent fit config was not rejected")
+    elif os.environ.get("MH_MODE") == "nan_ratings":
+        # ONE host's data contains a nan rating: the collective finite
+        # check must raise on EVERY host (a one-sided abort would
+        # strand the peer in the next collective — code-review r4)
+        import numpy as np
+
+        from tpu_als import ALS
+        from tpu_als.io.movielens import synthetic_movielens
+        from tpu_als.parallel.mesh import make_mesh
+
+        pid = jax.process_index()
+        frame = synthetic_movielens(60, 30, 800, seed=3)
+        if pid == 1:
+            r = np.asarray(frame["rating"]).copy()
+            r[5] = np.nan
+            from tpu_als.utils.frame import ColumnarFrame
+
+            frame = ColumnarFrame({"user": np.asarray(frame["user"]),
+                                   "item": np.asarray(frame["item"]),
+                                   "rating": r})
+        try:
+            ALS(rank=3, maxIter=2, seed=0, mesh=make_mesh()).fit(frame)
+        except ValueError as e:
+            assert "non-finite" in str(e), e
+            print("nan worker caught bad ratings", flush=True)
+        else:
+            raise AssertionError("nan ratings were not rejected")
     elif os.environ.get("MH_MODE") == "gate_diverge_strategy":
         # divergence in gatherStrategy specifically: the knob that decides
         # WHICH collectives the compiled step issues (ring pairs ppermute

@@ -425,3 +425,19 @@ def test_overwrite_clears_stale_save_of_different_kind(rng, tmp_path):
     with pytest.raises(Exception):
         ALSModel.load(p)
     assert ALS.load(p).getRank() == 5
+
+
+def test_fit_rejects_non_finite_ratings(rng):
+    # a nan/inf rating would silently converge to nan factors through
+    # the normal-equation sums — fit must fail fast with a count
+    import pytest
+
+    frame = small_frame(rng)
+    r = np.asarray(frame["rating"], dtype=np.float32).copy()
+    r[3] = np.nan
+    r[7] = np.inf
+    bad = ColumnarFrame({"user": np.asarray(frame["user"]),
+                         "item": np.asarray(frame["item"]),
+                         "rating": r})
+    with pytest.raises(ValueError, match="2 non-finite"):
+        ALS(rank=3, maxIter=2, seed=0).fit(bad)

@@ -140,3 +140,35 @@ def test_cli_recommend_with_foldin(tmp_path, capsys):
     assert all(np.isfinite(s) for _, s in items)
     scores = [s for _, s in items]
     assert scores == sorted(scores, reverse=True)
+
+
+def test_cli_recommend_with_item_foldin(tmp_path, capsys):
+    """--foldin-items-data: a brand-new ITEM folded against fixed user
+    factors surfaces in a known user's top-k when they are its best
+    match (the symmetric serving direction)."""
+    import numpy as np
+
+    from tpu_als import ALSModel
+
+    model_dir = str(tmp_path / "m")
+    cli_main(["train", "--data", "synthetic:150x60x3000", "--rank", "4",
+              "--max-iter", "4", "--seed", "0", "--output", model_dir])
+    capsys.readouterr()
+
+    model = ALSModel.load(model_dir)
+    raters = model._user_map.ids[:8]
+    new_item = 10 ** 6
+    csv_path = tmp_path / "new_item.csv"
+    lines = ["userId,movieId,rating,timestamp"]
+    for u in raters:
+        lines.append(f"{int(u)},{new_item},5.0,0")
+    csv_path.write_text("\n".join(lines) + "\n")
+
+    cli_main(["recommend", "--model", model_dir,
+              "--foldin-items-data", f"csv:{csv_path}",
+              "--users", str(int(raters[0])), "--k", "60"])
+    out = [json.loads(ln)
+           for ln in capsys.readouterr().out.strip().splitlines()]
+    assert len(out) == 1
+    items = [i for i, _ in out[0]["items"]]
+    assert new_item in items  # the folded item is in the candidate set

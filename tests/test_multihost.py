@@ -357,6 +357,21 @@ def test_two_process_divergent_gather_strategy_fails_fast(tmp_path):
         assert "gate worker caught divergence" in o, o[-1500:]
 
 
+def test_two_process_nan_ratings_raise_on_every_host(tmp_path):
+    """nan ratings on ONE host: the collective finite check must raise
+    on BOTH processes instead of stranding the clean host in the next
+    collective (code-review r4)."""
+    import os
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_cli_worker.py")
+    outs = _spawn_two_procs(worker, {"MH_OUT": str(tmp_path / "nn"),
+                                     "MH_MODE": "nan_ratings"},
+                            timeout=180)
+    for o in outs:
+        assert "nan worker caught bad ratings" in o, o[-1500:]
+
+
 def test_duplicated_split_detection_is_pairwise():
     from tpu_als.parallel.multihost import _split_signatures_duplicated
 

@@ -24,7 +24,6 @@ def _spd_problem(rng, N, r):
 
 @pytest.mark.parametrize("N,r", [
     (6, 256),          # two 128-blocks, one lane group (batch-padded)
-    (LANES + 2, 256),  # two lane groups
     (5, 200),          # rank pads 200 -> 256, identity-padded tail
     (4, 384),          # three blocks: exercises the m<k streamed loops
 ])
@@ -38,7 +37,12 @@ def test_factor_matches_numpy_cholesky(rng, N, r):
     assert np.triu(L, 1).max() == 0.0
 
 
-@pytest.mark.parametrize("N,r", [(6, 256), (LANES + 2, 256), (5, 200)])
+# the two-lane-group case lives here (solve covers the factor too), so
+# multi-group is exercised once instead of in both parametrizations —
+# interpret-mode minutes are the suite's scarce resource.  (5, 200)
+# stays: the identity-padded 200->256 tail must flow through the
+# substitutions end-to-end, not only through the factor.
+@pytest.mark.parametrize("N,r", [(LANES + 2, 256), (5, 200)])
 def test_solve_matches_dense(rng, N, r):
     A, b = _spd_problem(rng, N, r)
     x = np.asarray(spd_solve_lanes_blocked(A, b, interpret=True))

@@ -94,6 +94,88 @@ def test_foldin_server_unknown_items_ignored(rng):
     assert len(touched) == 0
 
 
+def test_foldin_server_new_item(rng):
+    """Symmetric item fold-in: a brand-new item rated 5.0 by a cohort of
+    users must (a) become transformable with finite scores, (b) score
+    higher for its raters than an anti-cohort, and (c) be visible to
+    SUBSEQUENT user fold-ins (the server's cached V refreshes)."""
+    model, frame = _fitted(rng)
+    U = model._U
+    pref = U[:, 1]
+    raters = model._user_map.to_original(np.argsort(-pref)[:8])
+    anti = model._user_map.to_original(np.argsort(pref)[:8])
+    new_item = 888_888
+    batch = ColumnarFrame({
+        "user": raters,
+        "item": np.full(8, new_item),
+        "rating": np.full(8, 5.0, dtype=np.float32),
+    })
+    srv = FoldInServer(model)
+    touched = srv.update_items(batch)
+    assert touched.tolist() == [new_item]
+    hi = model.transform(ColumnarFrame({
+        "user": raters, "item": np.full(8, new_item),
+        "rating": np.zeros(8, np.float32)}))["prediction"]
+    lo = model.transform(ColumnarFrame({
+        "user": anti, "item": np.full(8, new_item),
+        "rating": np.zeros(8, np.float32)}))["prediction"]
+    assert np.isfinite(hi).all() and hi.mean() > lo.mean()
+    # a user folded in AFTER the item sees it (cache refreshed): a new
+    # user who rates ONLY the new item gets a factor along its direction
+    ubatch = ColumnarFrame({
+        "user": np.array([999_999]),
+        "item": np.array([new_item]),
+        "rating": np.array([5.0], np.float32),
+    })
+    assert srv.update(ubatch).tolist() == [999_999]
+    p = model.transform(ColumnarFrame({
+        "user": np.array([999_999]), "item": np.array([new_item]),
+        "rating": np.zeros(1, np.float32)}))["prediction"]
+    assert np.isfinite(p).all() and p[0] > 0
+
+
+def test_foldin_item_matches_item_half_step(rng):
+    """update_items == the item half-step restricted to the touched item
+    (same math oracle the user fold-in tests pin)."""
+    import jax.numpy as jnp
+
+    from tpu_als.core.foldin import fold_in
+
+    model, frame = _fitted(rng)
+    iid = int(model._item_map.ids[3])
+    dense_i = 3
+    # exact expected factor: regress the item's (training) ratings on U
+    u = np.asarray(frame["user"])
+    i = np.asarray(frame["item"])
+    r = np.asarray(frame["rating"])
+    sel = i == iid
+    ud = model._user_map.to_dense(u[sel])
+    w = len(ud)
+    cols = np.zeros((1, w), np.int32); cols[0] = ud
+    vals = np.zeros((1, w), np.float32); vals[0] = r[sel]
+    mask = np.ones((1, w), np.float32)
+    want = np.asarray(fold_in(
+        jnp.asarray(model._U), jnp.asarray(cols), jnp.asarray(vals),
+        jnp.asarray(mask), 0.05))[0]
+
+    srv = FoldInServer(model)
+    srv.update_items(ColumnarFrame({
+        "user": u[sel], "item": i[sel], "rating": r[sel]}))
+    got = model._V[dense_i]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_foldin_item_unknown_users_ignored(rng):
+    model, _ = _fitted(rng)
+    srv = FoldInServer(model)
+    touched = srv.update_items(ColumnarFrame({
+        "user": np.array([10**9, 10**9 + 1]),  # never trained
+        "item": np.array([5, 5]),
+        "rating": np.array([5.0, 5.0], np.float32),
+    }))
+    assert len(touched) == 0
+
+
 def test_synthetic_movielens_shape_and_determinism():
     f1 = synthetic_movielens(200, 100, 5000, seed=3)
     f2 = synthetic_movielens(200, 100, 5000, seed=3)

@@ -37,4 +37,5 @@ from tpu_als.api.tuning import (  # noqa: F401
     TrainValidationSplit,
     TrainValidationSplitModel,
 )
+from tpu_als.stream.microbatch import FoldInServer  # noqa: F401
 from tpu_als.utils.frame import ColumnarFrame  # noqa: F401

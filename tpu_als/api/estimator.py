@@ -289,6 +289,24 @@ class ALS(_ALSParams):
             raise ValueError(f"column {ratingCol!r} not in dataset "
                              f"(columns: {frame.columns}); set ratingCol='' "
                              "for unit ratings")
+        # one nan/inf rating poisons the whole factorization through the
+        # normal-equation sums — fail with a count instead of converging
+        # to nan factors (the strict CSV parser blocks this at ingest;
+        # this guards direct API callers).  In a MULTI-PROCESS fit the
+        # raise must be uniform across hosts — a data-dependent one-host
+        # abort before the first collective leaves the peers hung inside
+        # it — so that path defers to the collective check below.
+        nonfinite = int((~np.isfinite(r)).sum())
+        multiproc = False
+        if self.mesh is not None:
+            import jax
+
+            multiproc = jax.process_count() > 1
+        if nonfinite and not multiproc:
+            raise ValueError(
+                f"ratingCol {ratingCol!r} contains {nonfinite} "
+                "non-finite value(s) (nan/inf); clean the input "
+                "before fit")
 
         if self.mesh is not None:
             import jax
@@ -298,9 +316,17 @@ class ALS(_ALSParams):
                 # every configuration: a knob divergence must raise here
                 # instead of pairing MISMATCHED collectives later (a
                 # distributed hang or a cryptic gloo shape error)
-                from tpu_als.api.fitting import check_multiprocess_gate
+                from tpu_als.api.fitting import (
+                    check_finite_ratings_collective,
+                    check_multiprocess_gate,
+                )
 
                 check_multiprocess_gate(self)
+                # bad data on ANY host must raise on EVERY host (a
+                # one-sided abort would strand the peers in the next
+                # collective) — runs right after the gate, before any
+                # data-derived collective
+                check_finite_ratings_collective(nonfinite, ratingCol)
         if self.dataMode == "per_host":
             # every process holds a DIFFERENT split, so the entity space
             # must be agreed before anything derives from it (id maps →

@@ -72,6 +72,24 @@ def check_multiprocess_gate(est):
             "is invoked)")
 
 
+def check_finite_ratings_collective(local_nonfinite, rating_col):
+    """Raise ON EVERY PROCESS when any host's ratings contain nan/inf.
+
+    The single-process path raises immediately in ``fit``; here the
+    decision must be collective — a one-host abort before the data
+    collectives would strand the peers inside them (code-review r4).
+    """
+    from jax.experimental import multihost_utils as mhu
+
+    counts = np.asarray(mhu.process_allgather(
+        np.array([local_nonfinite], dtype=np.int64)))
+    if counts.sum() > 0:
+        raise ValueError(
+            f"ratingCol {rating_col!r} contains non-finite values "
+            f"(nan/inf) — per-process counts {counts.ravel().tolist()}; "
+            "clean the input before fit")
+
+
 def fit_multiprocess(est, u_idx, i_idx, r, user_map, item_map, cfg,
                      init, start_iter):
     """Multi-process fit: processes pass the SAME dataset

@@ -209,18 +209,25 @@ def cmd_recommend(args):
     from tpu_als.utils.frame import ColumnarFrame
 
     model = ALSModel.load(args.model)
-    if getattr(args, "foldin_data", None):
+    if (getattr(args, "foldin_data", None)
+            or getattr(args, "foldin_items_data", None)):
         # the full serving flow in one command (SURVEY.md §3.5): fold the
-        # new ratings into the loaded model's user factors (item factors
-        # fixed), then recommend — new users in the fold-in data become
+        # new ratings into the loaded model, then recommend — new users
+        # (and, via the symmetric item direction, new items) become
         # recommendable without a refit
         from tpu_als.stream.microbatch import FoldInServer
 
-        batch = _load_data(args.foldin_data)
         srv = FoldInServer(model)
-        touched = srv.update(batch)
-        print(f"folded in {len(batch)} ratings touching "
-              f"{len(touched)} users", file=sys.stderr)
+        if getattr(args, "foldin_items_data", None):
+            batch = _load_data(args.foldin_items_data)
+            touched = srv.update_items(batch)
+            print(f"folded in {len(batch)} ratings touching "
+                  f"{len(touched)} items", file=sys.stderr)
+        if getattr(args, "foldin_data", None):
+            batch = _load_data(args.foldin_data)
+            touched = srv.update(batch)
+            print(f"folded in {len(batch)} ratings touching "
+                  f"{len(touched)} users", file=sys.stderr)
     if args.users:
         ids = np.array([int(x) for x in args.users.split(",")])
         recs = model.recommendForUserSubset(
@@ -358,6 +365,10 @@ def main(argv=None):
                    help="ratings (csv:path / ml-100k:path) to fold into "
                         "the user factors before recommending — serves "
                         "new ratings/users without a refit")
+    r.add_argument("--foldin-items-data", default=None,
+                   help="ratings whose ITEMS are folded in against the "
+                        "fixed user factors (new catalog entries served "
+                        "without a refit); applied before --foldin-data")
     r.set_defaults(fn=cmd_recommend)
 
     g = sub.add_parser("tune", help="cross-validated grid search")

@@ -11,7 +11,10 @@ jit update").  The server wraps a fitted ALSModel; each ``update`` call:
 3. runs the jitted fold-in kernel against the fixed item factors,
 4. writes the new rows into the model (appending brand-new users).
 
-Item factors stay fixed between refits — the standard fold-in contract.
+Item factors stay fixed during USER fold-ins (the standard fold-in
+contract); the symmetric ``update_items`` folds new/updated ITEMS against
+the fixed user factors, so both directions of catalog growth are served
+between refits.
 """
 
 from __future__ import annotations
@@ -35,6 +38,7 @@ class FoldInServer:
         self.model = model
         self.keep_history = keep_history
         self._history = {}  # original user id -> (item_dense[], rating[])
+        self._item_history = {}  # original item id -> (user_dense[], rating[])
         p = model._params
         self._reg = float(p.get("regParam", 0.1))
         self._implicit = bool(p.get("implicitPrefs", False))
@@ -70,72 +74,121 @@ class FoldInServer:
         """Process one micro-batch frame (userCol/itemCol/ratingCol of the
         model).  Returns the original ids of the users whose factors moved.
         """
+        return self._fold_batch(batch, items_side=False)
+
+    def update_items(self, batch):
+        """Symmetric fold-in for ITEMS: solve new/updated item factors
+        against the (fixed) user factors — a brand-new item with a few
+        ratings from known users becomes recommendable without a refit.
+        The reference stack requires a full refit here too (SURVEY §3.5).
+
+        Users unknown to the model are ignored (no factors to regress
+        on — fold them in via ``update`` first).  After the write-back
+        the server's cached serving-side V and YᵀY are refreshed, so
+        subsequent USER fold-ins see the new items.  Returns the
+        original ids of the items whose factors moved.
+        """
+        return self._fold_batch(batch, items_side=True)
+
+    def _fold_batch(self, batch, items_side):
+        """ONE shared mechanics path for both directions — known-side
+        filter, per-entity grouping, history merge, pow2 padding, solve,
+        write-back — parameterized by which side is being solved, so a
+        fix to any of it cannot apply to one direction only."""
         t0 = time.perf_counter()
         frame = as_frame(batch)
-        p = self.model._params
-        u_raw = np.asarray(frame[p["userCol"]])
-        i_raw = np.asarray(frame[p["itemCol"]])
+        m = self.model
+        p = m._params
+        if items_side:
+            solved_raw = np.asarray(frame[p["itemCol"]])
+            fixed_raw = np.asarray(frame[p["userCol"]])
+            fixed_map, history = m._user_map, self._item_history
+        else:
+            solved_raw = np.asarray(frame[p["userCol"]])
+            fixed_raw = np.asarray(frame[p["itemCol"]])
+            fixed_map, history = m._item_map, self._history
         r = np.asarray(frame[p["ratingCol"]], dtype=np.float32)
 
-        # items never seen in training cannot contribute (no factors); the
-        # reference would equally ignore them until a refit
-        i_dense = self.model._item_map.to_dense(i_raw)
-        known = i_dense >= 0
-        u_raw, i_dense, r = u_raw[known], i_dense[known], r[known]
-        if len(u_raw) == 0:
+        # fixed-side entities never seen in training cannot contribute
+        # (no factors to regress on); the reference would equally ignore
+        # them until a refit
+        fixed_dense = fixed_map.to_dense(fixed_raw)
+        known = fixed_dense >= 0
+        solved_raw = solved_raw[known]
+        fixed_dense, r = fixed_dense[known], r[known]
+        if len(solved_raw) == 0:
             return np.array([], dtype=np.int64)
 
-        touched = np.unique(u_raw)
-        per_user = {u: ([], []) for u in touched}
-        for u, i, v in zip(u_raw, i_dense, r):
-            per_user[u][0].append(i)
-            per_user[u][1].append(v)
+        touched = np.unique(solved_raw)
+        per = {e: ([], []) for e in touched}
+        for e, f, v in zip(solved_raw, fixed_dense, r):
+            per[e][0].append(f)
+            per[e][1].append(v)
         if self.keep_history:
-            for u in touched:
-                hist = self._history.get(u)
+            for e in touched:
+                hist = history.get(e)
                 if hist is not None:
-                    per_user[u] = (hist[0] + per_user[u][0],
-                                   hist[1] + per_user[u][1])
-                self._history[u] = per_user[u]
+                    per[e] = (hist[0] + per[e][0], hist[1] + per[e][1])
+                history[e] = per[e]
 
         # pad rows and width to powers of two -> bounded jit-cache entries
         n = len(touched)
         n_pad = _next_pow2(n)
-        w = _next_pow2(max(len(v[0]) for v in per_user.values()))
+        w = _next_pow2(max(len(v[0]) for v in per.values()))
         cols = np.zeros((n_pad, w), dtype=np.int32)
         vals = np.zeros((n_pad, w), dtype=np.float32)
         mask = np.zeros((n_pad, w), dtype=np.float32)
-        for row, u in enumerate(touched):
-            ii, vv = per_user[u]
-            cols[row, :len(ii)] = ii
-            vals[row, :len(ii)] = vv
-            mask[row, :len(ii)] = 1.0
+        for row, e in enumerate(touched):
+            ff, vv = per[e]
+            cols[row, :len(ff)] = ff
+            vals[row, :len(ff)] = vv
+            mask[row, :len(ff)] = 1.0
 
+        if items_side:
+            # the fixed side here is U, which user fold-ins may have
+            # grown — read it live (one transfer per item batch; item
+            # batches are the rare direction, so this stays off the
+            # user hot path)
+            F = jnp.asarray(m._U)
+            YtY = compute_yty(F) if self._implicit else None
+        else:
+            F, YtY = self._V, self._YtY
         x = np.asarray(fold_in(
-            self._V, jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(mask),
+            F, jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(mask),
             self._reg, implicit_prefs=self._implicit, alpha=self._alpha,
-            nonnegative=self._nonnegative, YtY=self._YtY,
+            nonnegative=self._nonnegative, YtY=YtY,
         ))[:n]
 
-        self._write_back(touched, x)
-        self.stats.append((len(u_raw), n, time.perf_counter() - t0))
+        self._write_back(touched, x, items_side)
+        if items_side:
+            # refresh the serving-side cache the USER fold-in path reads
+            self._V = jnp.asarray(m._V)
+            if self._implicit:
+                self._YtY = compute_yty(self._V)
+        self.stats.append((len(solved_raw), n, time.perf_counter() - t0))
         return touched
 
-    def _write_back(self, touched_raw_ids, new_rows):
+    def _write_back(self, touched_raw_ids, new_rows, items_side=False):
         m = self.model
-        if not m._U.flags.writeable:  # np view of a jax array is read-only
-            m._U = m._U.copy()
-        dense = m._user_map.to_dense(touched_raw_ids)
+        map_attr = "_item_map" if items_side else "_user_map"
+        fac_attr = "_V" if items_side else "_U"
+        fac = getattr(m, fac_attr)
+        if not fac.flags.writeable:  # np view of a jax array is read-only
+            fac = fac.copy()
+            setattr(m, fac_attr, fac)
+        emap = getattr(m, map_attr)
+        dense = emap.to_dense(touched_raw_ids)
         new_mask = dense < 0
-        if new_mask.any():  # brand-new users: extend the map and the factors
+        if new_mask.any():  # brand-new entities: extend map and factors
             new_ids = touched_raw_ids[new_mask]
-            m._user_map = IdMap(
-                ids=np.concatenate([m._user_map.ids, new_ids]))
-            m._U = np.concatenate(
-                [m._U, np.zeros((len(new_ids), m._U.shape[1]),
-                                dtype=m._U.dtype)])
-            dense = m._user_map.to_dense(touched_raw_ids)
-        m._U[dense] = new_rows
+            emap = IdMap(ids=np.concatenate([emap.ids, new_ids]))
+            setattr(m, map_attr, emap)
+            fac = np.concatenate(
+                [fac, np.zeros((len(new_ids), fac.shape[1]),
+                               dtype=fac.dtype)])
+            setattr(m, fac_attr, fac)
+            dense = emap.to_dense(touched_raw_ids)
+        fac[dense] = new_rows
 
     def latency(self, q=0.5, skip_warmup=False):
         """Latency quantile over processed batches.  ``skip_warmup`` drops

@@ -441,3 +441,22 @@ def test_fit_rejects_non_finite_ratings(rng):
                          "rating": r})
     with pytest.raises(ValueError, match="2 non-finite"):
         ALS(rank=3, maxIter=2, seed=0).fit(bad)
+
+
+def test_full_int64_ids_roundtrip_through_fit(rng):
+    # the strict CSV parser carries ids beyond 2^53 exactly; the model
+    # pipeline (remap -> fit -> factors -> recommend) must too
+    base = (1 << 53) + 11
+    u = np.array([base, base, base + 7, base + 7, base + 9] * 4,
+                 dtype=np.int64)
+    i = np.array([1, 2, 1, 3, 2] * 4, dtype=np.int64)
+    r = rng.uniform(1, 5, len(u)).astype(np.float32)
+    model = ALS(rank=2, maxIter=3, regParam=0.01, seed=0).fit(
+        ColumnarFrame({"user": u, "item": i, "rating": r}))
+    assert set(model.userFactors["id"].tolist()) == {base, base + 7,
+                                                     base + 9}
+    out = model.transform(ColumnarFrame({"user": u[:3], "item": i[:3]}))
+    assert np.isfinite(out["prediction"]).all()
+    recs = model.recommendForUserSubset(
+        ColumnarFrame({"user": np.array([base], dtype=np.int64)}), 2)
+    assert int(recs["user"][0]) == base

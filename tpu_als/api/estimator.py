@@ -263,11 +263,13 @@ class ALS(_ALSParams):
             cg_mode=self.cgMode,
         )
 
-    def fit(self, dataset, params=None):
-        if params:
-            return self.copy(params).fit(dataset)
-        self._validate()
-        frame = as_frame(dataset)
+    def _extract_columns(self, frame):
+        """(u_raw, i_raw, r, nonfinite_count) with the reference schema
+        checks: integer ids, ratingCol='' meaning unit ratings.  The
+        nan/inf count is RETURNED, not raised on: in a multi-process fit
+        a data-dependent one-host abort before the first collective
+        would strand the peers inside it, so fit raises single-process
+        and defers to the collective check otherwise."""
         userCol, itemCol = self.getUserCol(), self.getItemCol()
         ratingCol = self.getRatingCol()
         for c in (userCol, itemCol):
@@ -279,7 +281,6 @@ class ALS(_ALSParams):
                     f"ALS only supports integer ids; column {c!r} has dtype "
                     f"{frame[c].dtype} (the reference API has the same "
                     "integer-range restriction)")
-        u_raw, i_raw = frame[userCol], frame[itemCol]
         if ratingCol == "":
             # reference semantic: empty ratingCol means unit ratings
             r = np.ones(len(frame), dtype=np.float32)
@@ -290,13 +291,17 @@ class ALS(_ALSParams):
                              f"(columns: {frame.columns}); set ratingCol='' "
                              "for unit ratings")
         # one nan/inf rating poisons the whole factorization through the
-        # normal-equation sums — fail with a count instead of converging
-        # to nan factors (the strict CSV parser blocks this at ingest;
-        # this guards direct API callers).  In a MULTI-PROCESS fit the
-        # raise must be uniform across hosts — a data-dependent one-host
-        # abort before the first collective leaves the peers hung inside
-        # it — so that path defers to the collective check below.
-        nonfinite = int((~np.isfinite(r)).sum())
+        # normal-equation sums (the strict CSV parser blocks this at
+        # ingest; this guards direct API callers)
+        return frame[userCol], frame[itemCol], r, int((~np.isfinite(r)).sum())
+
+    def fit(self, dataset, params=None):
+        if params:
+            return self.copy(params).fit(dataset)
+        self._validate()
+        frame = as_frame(dataset)
+        ratingCol = self.getRatingCol()
+        u_raw, i_raw, r, nonfinite = self._extract_columns(frame)
         multiproc = False
         if self.mesh is not None:
             import jax

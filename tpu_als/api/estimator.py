@@ -313,25 +313,22 @@ class ALS(_ALSParams):
                 "non-finite value(s) (nan/inf); clean the input "
                 "before fit")
 
-        if self.mesh is not None:
-            import jax
+        if multiproc:
+            # the FIRST collective of every multi-process fit, on every
+            # configuration: a knob divergence must raise here instead
+            # of pairing MISMATCHED collectives later (a distributed
+            # hang or a cryptic gloo shape error)
+            from tpu_als.api.fitting import (
+                check_finite_ratings_collective,
+                check_multiprocess_gate,
+            )
 
-            if jax.process_count() > 1:
-                # the FIRST collective of every multi-process fit, on
-                # every configuration: a knob divergence must raise here
-                # instead of pairing MISMATCHED collectives later (a
-                # distributed hang or a cryptic gloo shape error)
-                from tpu_als.api.fitting import (
-                    check_finite_ratings_collective,
-                    check_multiprocess_gate,
-                )
-
-                check_multiprocess_gate(self)
-                # bad data on ANY host must raise on EVERY host (a
-                # one-sided abort would strand the peers in the next
-                # collective) — runs right after the gate, before any
-                # data-derived collective
-                check_finite_ratings_collective(nonfinite, ratingCol)
+            check_multiprocess_gate(self)
+            # bad data on ANY host must raise on EVERY host (a
+            # one-sided abort would strand the peers in the next
+            # collective) — runs right after the gate, before any
+            # data-derived collective
+            check_finite_ratings_collective(nonfinite, ratingCol)
         if self.dataMode == "per_host":
             # every process holds a DIFFERENT split, so the entity space
             # must be agreed before anything derives from it (id maps →

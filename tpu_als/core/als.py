@@ -15,7 +15,8 @@ path (tpu_als.parallel.trainer) wraps it in ``shard_map`` with an
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import functools
+from dataclasses import dataclass, replace as _dc_replace
 
 import jax
 import jax.numpy as jnp
@@ -152,7 +153,7 @@ def init_factors(key, num_rows, rank, dtype=jnp.float32):
 
 
 def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
-                    chunk_elems=1 << 19, prev=None):
+                    chunk_elems=1 << 19, prev=None, reg=None):
     """Solve all rows of one side given the full opposite factor matrix.
 
     V_full [N_opposite, r]; buckets: list[Bucket] (device arrays); returns
@@ -165,7 +166,16 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     ``prev`` [num_rows, r]: the solved side's CURRENT factors — the warm
     start for the inexact-ALS CG path (``cfg.cg_iters > 0``); ignored by
     the exact solvers.
+
+    ``reg``: overrides ``cfg.reg_param``, and may be a TRACED scalar —
+    the single-device step passes it dynamically so configs differing
+    only in regParam share one compiled executable (a CrossValidator
+    regParam grid then compiles once per rank instead of once per cell).
+    The fused-kernel branch keeps the static ``cfg.reg_param`` (its
+    Pallas lowering requires a static reg; it is ablation-only).
     """
+    if reg is None:
+        reg = cfg.reg_param
     r = V_full.shape[-1]
     cdt = jnp.dtype(cfg.compute_dtype)
     # cast ONCE before the gathers: the gather reads padded_nnz × r elements
@@ -213,7 +223,7 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
                 # [chunk, r, r] tensor ever exists
                 with jax.named_scope("cg_matfree"):
                     return solve_cg_matfree(
-                        Vg, v, m, cfg.reg_param,
+                        Vg, v, m, reg,
                         implicit=cfg.implicit_prefs, alpha=cfg.alpha,
                         YtY=YtY, x0=x0, iters=cfg.cg_iters)
             if fused:
@@ -232,12 +242,12 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
             with jax.named_scope("normal_eq"):
                 if cfg.implicit_prefs:
                     A, rhs, count = normal_eq_implicit(
-                        Vg, v.astype(cdt), m.astype(cdt), cfg.reg_param,
+                        Vg, v.astype(cdt), m.astype(cdt), reg,
                         cfg.alpha, YtY.astype(jnp.float32),
                     )
                 else:
                     A, rhs, count = normal_eq_explicit(
-                        Vg, v.astype(cdt), m.astype(cdt), cfg.reg_param
+                        Vg, v.astype(cdt), m.astype(cdt), reg
                     )
             A = A.astype(jnp.float32)
             rhs = rhs.astype(jnp.float32)
@@ -261,6 +271,32 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     return out
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "num_users", "num_items",
+                     "user_chunk_elems", "item_chunk_elems"),
+    donate_argnums=(0, 1))
+def _step_jit(U, V, ub, ib, reg, *, cfg, num_users, num_items,
+              user_chunk_elems, item_chunk_elems):
+    """THE jitted full ALS iteration — module-level, so its jit cache is
+    keyed on (static config, array shapes) and SHARED across fits.
+    ``reg`` is a traced scalar: two estimators differing only in regParam
+    reuse one compiled executable (see make_step)."""
+    if cfg.implicit_prefs:
+        YtY_u = compute_yty(U)
+        V = local_half_step(U, ib, num_items, cfg, YtY_u,
+                            item_chunk_elems, prev=V, reg=reg)
+        YtY_v = compute_yty(V)
+        U = local_half_step(V, ub, num_users, cfg, YtY_v,
+                            user_chunk_elems, prev=U, reg=reg)
+    else:
+        V = local_half_step(U, ib, num_items, cfg,
+                            chunk_elems=item_chunk_elems, prev=V, reg=reg)
+        U = local_half_step(V, ub, num_users, cfg,
+                            chunk_elems=user_chunk_elems, prev=U, reg=reg)
+    return U, V
+
+
 def make_step(user_buckets, item_buckets, num_users, num_items, cfg: AlsConfig,
               user_chunk_elems=1 << 19, item_chunk_elems=1 << 19):
     """Build the jitted full ALS iteration (item half-step then user
@@ -271,31 +307,30 @@ def make_step(user_buckets, item_buckets, num_users, num_items, cfg: AlsConfig,
     constant, which at ML-25M scale means shipping ~1 GB of rating data
     inside the compile payload (and re-compiling whenever the data changes).
     As arguments they stay on device and the compiled step is reusable.
+
+    regParam enters the compiled step as a TRACED scalar and is stripped
+    from the static cache key (along with max_iter/seed, which the step
+    body never reads), so a tuning grid over regParam at fixed rank/data
+    compiles ONCE instead of once per grid cell — the recompile tax on a
+    CrossValidator was ~30s × cells on a v5e.  The fused-kernel config
+    keeps reg static (its Pallas lowering requires it; ablation-only).
     """
     # probe the solve kernels EAGERLY: a probe firing inside the jit trace
     # below cannot run (and the jit cache would pin the fallback path for
     # the step's lifetime) — see ops.solve.prewarm_solve
-    resolve_solve_path(cfg, cfg.rank)
-
-    def step_impl(U, V, ub, ib):
-        if cfg.implicit_prefs:
-            YtY_u = compute_yty(U)
-            V = local_half_step(U, ib, num_items, cfg, YtY_u,
-                                item_chunk_elems, prev=V)
-            YtY_v = compute_yty(V)
-            U = local_half_step(V, ub, num_users, cfg, YtY_v,
-                                user_chunk_elems, prev=U)
-        else:
-            V = local_half_step(U, ib, num_items, cfg,
-                                chunk_elems=item_chunk_elems, prev=V)
-            U = local_half_step(V, ub, num_users, cfg,
-                                chunk_elems=user_chunk_elems, prev=U)
-        return U, V
-
-    jitted = jax.jit(step_impl, donate_argnums=(0, 1))
+    resolved = resolve_solve_path(cfg, cfg.rank)
+    if resolved["resolved_solve_path"] == "fused_pallas":
+        cfg_key = _dc_replace(cfg, max_iter=0, seed=0)
+    else:
+        cfg_key = _dc_replace(cfg, reg_param=0.0, max_iter=0, seed=0)
+    reg = jnp.float32(cfg.reg_param)
 
     def step(U, V):
-        return jitted(U, V, user_buckets, item_buckets)
+        return _step_jit(U, V, user_buckets, item_buckets, reg,
+                         cfg=cfg_key, num_users=num_users,
+                         num_items=num_items,
+                         user_chunk_elems=user_chunk_elems,
+                         item_chunk_elems=item_chunk_elems)
 
     return step
 

@@ -193,3 +193,49 @@ def test_reg_grid_shares_one_compiled_step(rng):
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(Ub), np.asarray(U_direct),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_alpha_grid_shares_one_compiled_step(rng):
+    """alpha (implicit confidence) is traced like regParam: an
+    alpha-only config change adds no jit cache entry and still changes
+    the numerics."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_als.core import als
+    from tpu_als.core.als import AlsConfig, init_factors, make_step
+    from tpu_als.core.ratings import build_csr_buckets
+
+    nU, nI, nnz = 30, 20, 300
+    u = rng.integers(0, nU, nnz)
+    i = rng.integers(0, nI, nnz)
+    r = (np.abs(rng.normal(size=nnz)) + 0.1).astype(np.float32)
+    ucsr = build_csr_buckets(u, i, r, nU, min_width=4)
+    icsr = build_csr_buckets(i, u, r, nI, min_width=4)
+    ub = jax.device_put(ucsr.device_buckets())
+    ib = jax.device_put(icsr.device_buckets())
+    key = jax.random.PRNGKey(0)
+    ku, kv = jax.random.split(key)
+    U0 = init_factors(ku, nU, 4)
+    V0 = init_factors(kv, nI, 4)
+
+    cfg_a = AlsConfig(rank=4, implicit_prefs=True, alpha=1.0, seed=0)
+    Ua, _ = make_step(ub, ib, nU, nI, cfg_a, ucsr.chunk_elems,
+                      icsr.chunk_elems)(jnp.array(U0), jnp.array(V0))
+    size_after = als._step_jit._cache_size()
+    cfg_b = AlsConfig(rank=4, implicit_prefs=True, alpha=40.0, seed=0)
+    Ub, _ = make_step(ub, ib, nU, nI, cfg_b, ucsr.chunk_elems,
+                      icsr.chunk_elems)(jnp.array(U0), jnp.array(V0))
+    assert als._step_jit._cache_size() == size_after
+    assert not np.allclose(np.asarray(Ua), np.asarray(Ub))
+    # oracle: equals the direct half-step math at alpha=40
+    YtY_u = als.compute_yty(jnp.array(U0))
+    V_direct = als.local_half_step(
+        jnp.array(U0), ib, nI, cfg_b, YtY_u,
+        chunk_elems=icsr.chunk_elems, prev=jnp.array(V0))
+    YtY_v = als.compute_yty(V_direct)
+    U_direct = als.local_half_step(
+        V_direct, ub, nU, cfg_b, YtY_v,
+        chunk_elems=ucsr.chunk_elems, prev=jnp.array(U0))
+    np.testing.assert_allclose(np.asarray(Ub), np.asarray(U_direct),
+                               rtol=1e-5, atol=1e-6)

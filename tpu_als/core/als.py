@@ -153,7 +153,7 @@ def init_factors(key, num_rows, rank, dtype=jnp.float32):
 
 
 def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
-                    chunk_elems=1 << 19, prev=None, reg=None):
+                    chunk_elems=1 << 19, prev=None, reg=None, alpha=None):
     """Solve all rows of one side given the full opposite factor matrix.
 
     V_full [N_opposite, r]; buckets: list[Bucket] (device arrays); returns
@@ -176,6 +176,8 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     """
     if reg is None:
         reg = cfg.reg_param
+    if alpha is None:
+        alpha = cfg.alpha
     r = V_full.shape[-1]
     cdt = jnp.dtype(cfg.compute_dtype)
     # cast ONCE before the gathers: the gather reads padded_nnz × r elements
@@ -224,7 +226,7 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
                 with jax.named_scope("cg_matfree"):
                     return solve_cg_matfree(
                         Vg, v, m, reg,
-                        implicit=cfg.implicit_prefs, alpha=cfg.alpha,
+                        implicit=cfg.implicit_prefs, alpha=alpha,
                         YtY=YtY, x0=x0, iters=cfg.cg_iters)
             if fused:
                 from tpu_als.ops.pallas_fused import fused_normal_solve
@@ -243,7 +245,7 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
                 if cfg.implicit_prefs:
                     A, rhs, count = normal_eq_implicit(
                         Vg, v.astype(cdt), m.astype(cdt), reg,
-                        cfg.alpha, YtY.astype(jnp.float32),
+                        alpha, YtY.astype(jnp.float32),
                     )
                 else:
                     A, rhs, count = normal_eq_explicit(
@@ -276,19 +278,19 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     static_argnames=("cfg", "num_users", "num_items",
                      "user_chunk_elems", "item_chunk_elems"),
     donate_argnums=(0, 1))
-def _step_jit(U, V, ub, ib, reg, *, cfg, num_users, num_items,
+def _step_jit(U, V, ub, ib, reg, alpha, *, cfg, num_users, num_items,
               user_chunk_elems, item_chunk_elems):
     """THE jitted full ALS iteration — module-level, so its jit cache is
     keyed on (static config, array shapes) and SHARED across fits.
-    ``reg`` is a traced scalar: two estimators differing only in regParam
-    reuse one compiled executable (see make_step)."""
+    ``reg`` and ``alpha`` are traced scalars: estimators differing only
+    in regParam/alpha reuse one compiled executable (see make_step)."""
     if cfg.implicit_prefs:
         YtY_u = compute_yty(U)
         V = local_half_step(U, ib, num_items, cfg, YtY_u,
-                            item_chunk_elems, prev=V, reg=reg)
+                            item_chunk_elems, prev=V, reg=reg, alpha=alpha)
         YtY_v = compute_yty(V)
         U = local_half_step(V, ub, num_users, cfg, YtY_v,
-                            user_chunk_elems, prev=U, reg=reg)
+                            user_chunk_elems, prev=U, reg=reg, alpha=alpha)
     else:
         V = local_half_step(U, ib, num_items, cfg,
                             chunk_elems=item_chunk_elems, prev=V, reg=reg)
@@ -308,12 +310,13 @@ def make_step(user_buckets, item_buckets, num_users, num_items, cfg: AlsConfig,
     inside the compile payload (and re-compiling whenever the data changes).
     As arguments they stay on device and the compiled step is reusable.
 
-    regParam enters the compiled step as a TRACED scalar and is stripped
-    from the static cache key (along with max_iter/seed, which the step
-    body never reads), so a tuning grid over regParam at fixed rank/data
-    compiles ONCE instead of once per grid cell — the recompile tax on a
-    CrossValidator was ~30s × cells on a v5e.  The fused-kernel config
-    keeps reg static (its Pallas lowering requires it; ablation-only).
+    regParam AND alpha enter the compiled step as TRACED scalars and are
+    stripped from the static cache key (along with max_iter/seed, which
+    the step body never reads), so a tuning grid over regParam/alpha at
+    fixed rank/data compiles ONCE instead of once per grid cell — the
+    recompile tax on a CrossValidator was ~30s × cells on a v5e.  The
+    fused-kernel config keeps both static (its Pallas lowering requires
+    them; ablation-only).
     """
     # probe the solve kernels EAGERLY: a probe firing inside the jit trace
     # below cannot run (and the jit cache would pin the fallback path for
@@ -322,11 +325,13 @@ def make_step(user_buckets, item_buckets, num_users, num_items, cfg: AlsConfig,
     if resolved["resolved_solve_path"] == "fused_pallas":
         cfg_key = _dc_replace(cfg, max_iter=0, seed=0)
     else:
-        cfg_key = _dc_replace(cfg, reg_param=0.0, max_iter=0, seed=0)
+        cfg_key = _dc_replace(cfg, reg_param=0.0, alpha=0.0,
+                              max_iter=0, seed=0)
     reg = jnp.float32(cfg.reg_param)
+    alpha = jnp.float32(cfg.alpha)
 
     def step(U, V):
-        return _step_jit(U, V, user_buckets, item_buckets, reg,
+        return _step_jit(U, V, user_buckets, item_buckets, reg, alpha,
                          cfg=cfg_key, num_users=num_users,
                          num_items=num_items,
                          user_chunk_elems=user_chunk_elems,

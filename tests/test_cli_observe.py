@@ -172,3 +172,12 @@ def test_cli_recommend_with_item_foldin(tmp_path, capsys):
     assert len(out) == 1
     items = [i for i, _ in out[0]["items"]]
     assert new_item in items  # the folded item is in the candidate set
+
+
+def test_cli_tune_alpha_grid(tmp_path, capsys):
+    cli_main(["tune", "--data", "synthetic:100x40x2000",
+              "--ranks", "3", "--reg-params", "0.02", "--implicit",
+              "--alphas", "1.0,20.0", "--max-iter", "3", "--folds", "2"])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert line["grid_size"] == 2
+    assert line["best_alpha"] in (1.0, 20.0)

@@ -254,11 +254,17 @@ def cmd_tune(args):
     als = ALS(maxIter=args.max_iter, implicitPrefs=args.implicit,
               alpha=args.alpha, seed=args.seed, coldStartStrategy="drop",
               cgIters=args.cg_iters)
-    grid = (ParamGridBuilder()
-            .addGrid(als.rank, [int(x) for x in args.ranks.split(",")])
-            .addGrid(als.regParam,
-                     [float(x) for x in args.reg_params.split(",")])
-            .build())
+    gb = (ParamGridBuilder()
+          .addGrid(als.rank, [int(x) for x in args.ranks.split(",")])
+          .addGrid(als.regParam,
+                   [float(x) for x in args.reg_params.split(",")]))
+    if args.alphas:
+        # regParam and alpha are traced through the compiled step
+        # (core/als.py), so widening the grid over them adds fit time
+        # but NO extra compiles at fixed rank
+        gb = gb.addGrid(als.alpha,
+                        [float(x) for x in args.alphas.split(",")])
+    grid = gb.build()
     cv = CrossValidator(
         estimator=als,
         estimatorParamMaps=grid,
@@ -268,12 +274,15 @@ def cmd_tune(args):
     )
     cv_model = cv.fit(frame)
     best = cv_model.bestModel
-    print(json.dumps({
+    out = {
         "best_rank": int(best._params["rank"]),
         "best_regParam": float(best._params["regParam"]),
         "avg_metrics": [round(float(m), 4) for m in cv_model.avgMetrics],
         "grid_size": len(grid),
-    }))
+    }
+    if args.alphas:
+        out["best_alpha"] = float(best._params["alpha"])
+    print(json.dumps(out))
     if args.output:
         cv_model.write().overwrite().save(args.output)
         print(f"best model saved to {args.output}", file=sys.stderr)
@@ -381,6 +390,10 @@ def main(argv=None):
     g.add_argument("--folds", type=int, default=3)
     g.add_argument("--implicit", action="store_true")
     g.add_argument("--alpha", type=float, default=1.0)
+    g.add_argument("--alphas", default=None,
+                   help="comma-separated alpha grid (implicit feedback); "
+                        "alpha is traced, so the wider grid costs no "
+                        "extra compiles")
     g.add_argument("--seed", type=int, default=0)
     g.add_argument("--output", default=None,
                    help="save the best model here")

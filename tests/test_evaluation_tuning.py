@@ -230,3 +230,23 @@ def test_tuned_load_rejects_foreign_model_class(tmp_path):
          "modelClass": "os.path.join"}))
     with pytest.raises(ValueError, match="refusing to load"):
         TrainValidationSplitModel.load(str(p))
+
+
+def test_cv_respects_larger_is_better(rng):
+    """With an isLargerBetter metric (r2), CV must pick the HIGHEST
+    score — an argmin over r2 would select the worst model and this
+    direction bug would be invisible to every rmse-based test."""
+    from tpu_als import ALS, ColumnarFrame, RegressionEvaluator
+    from tpu_als.api.tuning import CrossValidator, ParamGridBuilder
+
+    u, i, r, _, _ = make_ratings(rng, 60, 40, rank=3, density=0.5)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    als = ALS(maxIter=6, regParam=0.01, seed=0, coldStartStrategy="drop")
+    grid = ParamGridBuilder().addGrid(als.rank, [1, 6]).build()
+    ev = RegressionEvaluator(labelCol="rating", metricName="r2")
+    assert ev.isLargerBetter()
+    cv = CrossValidator(estimator=als, estimatorParamMaps=grid,
+                        evaluator=ev, numFolds=2, seed=0)
+    model = cv.fit(frame)
+    assert model.avgMetrics[1] > model.avgMetrics[0]  # rank 6 wins on r2
+    assert model.bestModel._params["rank"] == 6

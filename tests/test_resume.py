@@ -109,3 +109,27 @@ def test_resume_rejects_mismatched_solver_params(dataset, tmp_path):
     with pytest.raises(ValueError, match="regParam"):
         tpu_als.ALS(rank=4, maxIter=4, regParam=0.1,
                     resumeFrom=ckpt).fit(frame)
+
+
+def test_truncated_checkpoint_raises_not_garbage(rng, tmp_path):
+    """A torn factor file (partial write, disk corruption) must raise at
+    load — the npz zip container CRC/structure check is the integrity
+    layer — never return silently-corrupt factors to resume from."""
+    import pytest
+
+    from tpu_als.io.checkpoint import load_factors, save_factors
+
+    path = str(tmp_path / "ck")
+    ids = np.arange(10)
+    F = rng.normal(size=(10, 3)).astype(np.float32)
+    save_factors(path, ids, F, ids, F, params={}, iteration=1)
+    # sanity: loads fine
+    load_factors(path)
+    # truncate one factor file to half its bytes
+    fp = os.path.join(path, "user_factors.npz")
+    raw = open(fp, "rb").read()
+    with open(fp, "wb") as f:
+        f.write(raw[:len(raw) // 2])
+    with pytest.raises(Exception) as ei:
+        load_factors(path)
+    assert not isinstance(ei.value, AssertionError)

@@ -59,11 +59,24 @@ def main():
               f"({n / dt / 1e6:.2f} M solves/s)", flush=True)
         return x
 
-    for p in [1] + list(args.panels):
-        f = functools.partial(spd_solve_lanes, panel=p)
-        bench(f, f"lanes panel={p}")
-        err = np.abs(np.asarray(spd_solve_lanes(Ac, bc, panel=p)) - ref).max()
-        print(f"  panel={p} max err vs xla: {err:.2e}")
+    if r <= 128:
+        for p in [1] + list(args.panels):
+            f = functools.partial(spd_solve_lanes, panel=p)
+            bench(f, f"lanes panel={p}")
+            err = np.abs(np.asarray(spd_solve_lanes(Ac, bc, panel=p))
+                         - ref).max()
+            print(f"  panel={p} max err vs xla: {err:.2e}")
+    else:
+        # ranks past the flat layout: sweep the blocked out-of-core
+        # kernel's panel width (stream/factor panels) the same way
+        from tpu_als.ops.pallas_lanes_blocked import spd_solve_lanes_blocked
+
+        for p in args.panels:
+            f = functools.partial(spd_solve_lanes_blocked, panel=p)
+            bench(f, f"lanes_blocked panel={p}")
+            err = np.abs(np.asarray(
+                spd_solve_lanes_blocked(Ac, bc, panel=p)) - ref).max()
+            print(f"  panel={p} max err vs xla: {err:.2e}")
 
 
 if __name__ == "__main__":

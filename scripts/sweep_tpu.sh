@@ -31,6 +31,7 @@ run rank256_proxy 900 python scripts/rank256_proxy.py
 # 3. solve-kernel panel sweep (sets DEFAULT_PANEL if a non-8 wins) and
 #    the remaining headline A/Bs
 run kernel_lab 580 python scripts/kernel_lab.py --panels 4 8 16
+run kernel_lab_r256 580 python scripts/kernel_lab.py --rank 256 --n 8192 --panels 4 8 16
 run headline_cg3     580 python bench.py --no-auto-config --iters 5 --cg-iters 3
 run headline_cg2_dense 580 python bench.py --no-auto-config --iters 5 --cg-iters 2 --cg-mode dense
 # each bf16 headline candidate is IMMEDIATELY followed by its quality

@@ -52,6 +52,21 @@ def test_solve_matches_dense(rng, N, r):
     assert np.abs(x - ref).max() / denom < 1e-3
 
 
+def test_panel_width_agrees(rng):
+    # panel=4 must reproduce the default panel=8 math (same blocked
+    # factorization, different streaming granularity)
+    A, _ = _spd_problem(rng, 4, 256)
+    L8 = np.asarray(chol_lanes_blocked(A, interpret=True))
+    L4 = np.asarray(chol_lanes_blocked(A, panel=4, interpret=True))
+    np.testing.assert_allclose(L4, L8, rtol=1e-5, atol=1e-6)
+
+
+def test_bad_panel_rejected(rng):
+    A, _ = _spd_problem(rng, 4, 256)
+    with pytest.raises(ValueError, match="must divide"):
+        chol_lanes_blocked(A, panel=7, interpret=True)
+
+
 def test_supported_rank_partition():
     # the flat lanes kernel owns <= 128; blocked owns everything above —
     # together they cover every rank with no overlap

@@ -157,11 +157,19 @@ def _chol_blocked_kernel(A_ref, out_ref, W, Bs, Cs, sem, *, nb, panel):
             dma(W, blk(out_ref, k, i))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def chol_lanes_blocked(A, interpret=False):
+@functools.partial(jax.jit, static_argnames=("panel", "interpret"))
+def chol_lanes_blocked(A, panel=None, interpret=False):
     """Batched lower-Cholesky factor L of SPD ``A`` [N, r, r] f32, via the
     blocked out-of-core lanes kernel.  Caller pre-regularizes A (jitter +
-    identity for empty rows), same contract as the flat kernel."""
+    identity for empty rows), same contract as the flat kernel.
+
+    ``panel``: factor/stream panel width (must divide BLOCK=128; None =
+    PANEL).  Exposed so scripts/kernel_lab.py can tune it on chip the
+    same way the flat kernel's DEFAULT_PANEL was tuned."""
+    if panel is None:
+        panel = PANEL
+    if BLOCK % panel:
+        raise ValueError(f"panel {panel} must divide {BLOCK}")
     N, r = A.shape[0], A.shape[-1]
     nb = -(-r // BLOCK)
     r_pad = nb * BLOCK
@@ -177,7 +185,7 @@ def chol_lanes_blocked(A, interpret=False):
 
     G = n_pad // LANES
     At = jnp.transpose(Ap.reshape(G, LANES, r_pad, r_pad), (0, 3, 2, 1))
-    kernel = functools.partial(_chol_blocked_kernel, nb=nb, panel=PANEL)
+    kernel = functools.partial(_chol_blocked_kernel, nb=nb, panel=panel)
     Lt = pl.pallas_call(
         kernel,
         grid=(G,),
@@ -187,8 +195,8 @@ def chol_lanes_blocked(A, interpret=False):
                                        jnp.float32),
         scratch_shapes=[
             pltpu.VMEM((BLOCK, BLOCK, LANES), jnp.float32),
-            pltpu.VMEM((PANEL, BLOCK, LANES), jnp.float32),
-            pltpu.VMEM((PANEL, BLOCK, LANES), jnp.float32),
+            pltpu.VMEM((panel, BLOCK, LANES), jnp.float32),
+            pltpu.VMEM((panel, BLOCK, LANES), jnp.float32),
             pltpu.SemaphoreType.DMA,
         ],
         input_output_aliases={0: 0},
@@ -205,12 +213,12 @@ def chol_lanes_blocked(A, interpret=False):
     return jnp.tril(L[:N, :r, :r])
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def spd_solve_lanes_blocked(A, b, interpret=False):
+@functools.partial(jax.jit, static_argnames=("panel", "interpret"))
+def spd_solve_lanes_blocked(A, b, panel=None, interpret=False):
     """Batched SPD solve x = A⁻¹b for ranks > 128: blocked lanes
     factorization + XLA batched triangular substitutions (r² work the
     MXU handles; only the r³ factorization needed a kernel)."""
-    L = chol_lanes_blocked(A, interpret=interpret)
+    L = chol_lanes_blocked(A, panel=panel, interpret=interpret)
     y = jax.scipy.linalg.solve_triangular(L, b[..., None], lower=True)
     return jax.scipy.linalg.solve_triangular(L, y, lower=True,
                                              trans=1)[..., 0]

@@ -181,3 +181,21 @@ def test_cli_tune_alpha_grid(tmp_path, capsys):
     line = json.loads(capsys.readouterr().out.strip().splitlines()[0])
     assert line["grid_size"] == 2
     assert line["best_alpha"] in (1.0, 20.0)
+
+
+def test_cli_evaluate_ranking_metrics(tmp_path, capsys):
+    model_dir = str(tmp_path / "m")
+    cli_main(["train", "--data", "synthetic:150x60x4000", "--rank", "6",
+              "--max-iter", "5", "--seed", "0", "--output", model_dir])
+    capsys.readouterr()
+    cli_main(["evaluate", "--model", model_dir,
+              "--data", "synthetic:150x60x4000", "--ranking-k", "5"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    for key in ("rmse", "precision_at_5", "recall_at_5", "map",
+                "ndcg_at_5", "ranking_users"):
+        assert key in out, key
+    assert 0.0 <= out["precision_at_5"] <= 1.0
+    # evaluating ON the training data: a fitted model must rank its own
+    # high-rated items far above the random floor (k/items ~ 0.08)
+    assert out["recall_at_5"] > 0.05
+    assert out["ranking_users"] > 0

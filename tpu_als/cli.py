@@ -201,6 +201,40 @@ def cmd_evaluate(args):
     for metric in ("rmse", "mae", "r2"):
         ev = RegressionEvaluator(labelCol="rating", metricName=metric)
         result[metric] = round(ev.evaluate(out), 4)
+    if args.ranking_k > 0:
+        # retrieval-quality protocol (SURVEY §2.B7): per test user,
+        # ground truth = their test items rated >= --positive-threshold;
+        # predictions = the model's top-k.  Vectorized top-k once for
+        # the evaluated users, then the reference RankingMetrics math.
+        from tpu_als.api.evaluation import RankingMetrics
+        from tpu_als.utils.frame import ColumnarFrame
+
+        k = args.ranking_k
+        p = model._params
+        u = np.asarray(frame[p["userCol"]])
+        i = np.asarray(frame[p["itemCol"]])
+        pos = np.asarray(frame[p["ratingCol"]],
+                         np.float32) >= args.positive_threshold
+        truth = {}
+        for uu, ii in zip(u[pos], i[pos]):
+            truth.setdefault(int(uu), set()).add(int(ii))
+        users = np.array(sorted(truth), dtype=u.dtype)
+        recs = model.recommendForUserSubset(
+            ColumnarFrame({p["userCol"]: users}), k)
+        key = recs.columns[0]
+        pairs = [
+            ([int(iid) for iid, _ in recs["recommendations"][row]],
+             truth[int(recs[key][row])])
+            for row in range(len(recs))
+        ]
+        rm = RankingMetrics(pairs)
+        result.update({
+            f"precision_at_{k}": round(rm.precisionAt(k), 4),
+            f"recall_at_{k}": round(rm.recallAt(k), 4),
+            "map": round(rm.meanAveragePrecision, 4),
+            f"ndcg_at_{k}": round(rm.ndcgAt(k), 4),
+            "ranking_users": len(pairs),
+        })
     print(json.dumps(result))
 
 
@@ -361,6 +395,11 @@ def main(argv=None):
     e = sub.add_parser("evaluate", help="score a dataset with a saved model")
     e.add_argument("--model", required=True)
     e.add_argument("--data", required=True)
+    e.add_argument("--ranking-k", type=int, default=0,
+                   help="> 0: also report precision/recall@k, MAP, and "
+                        "NDCG@k (test items rated >= --positive-threshold "
+                        "are the per-user ground truth)")
+    e.add_argument("--positive-threshold", type=float, default=3.5)
     e.set_defaults(fn=cmd_evaluate)
 
     r = sub.add_parser("recommend", help="top-k recommendations")

@@ -460,3 +460,21 @@ def test_full_int64_ids_roundtrip_through_fit(rng):
     recs = model.recommendForUserSubset(
         ColumnarFrame({"user": np.array([base], dtype=np.int64)}), 2)
     assert int(recs["user"][0]) == base
+
+
+def test_model_param_setters(rng):
+    """Reference ALSModel surface: serving-time knobs are settable on
+    the fitted model (pyspark ALSModel.setPredictionCol etc.)."""
+    import pytest
+
+    frame = small_frame(rng)
+    model = ALS(rank=3, maxIter=3, seed=0).fit(frame)
+    model.setPredictionCol("score").setColdStartStrategy("drop")
+    assert model.getPredictionCol() == "score"
+    out = model.transform(ColumnarFrame({
+        "user": np.array([10**6]), "item": np.array([0])}))
+    assert "score" in out.columns and len(out) == 0  # dropped cold row
+    with pytest.raises(ValueError):
+        model.setColdStartStrategy("bogus")
+    with pytest.raises(TypeError):
+        model._set(rank=5)  # training-time params are not settable

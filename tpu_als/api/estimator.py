@@ -547,6 +547,25 @@ class ALSModel:
     def _get(self, name):
         return self._params[name]
 
+    # the reference ALSModel exposes per-param setters/getters for the
+    # serving-time knobs (pyspark ``ALSModel.setPredictionCol`` etc.);
+    # generated below by _attach_model_accessors — the settable set is
+    # exactly the knobs transform/recommend* consult at call time
+    _MODEL_PARAMS = ("userCol", "itemCol", "predictionCol",
+                     "coldStartStrategy", "blockSize")
+
+    def _set(self, **kwargs):
+        for name, v in kwargs.items():
+            if name not in self._MODEL_PARAMS:
+                raise TypeError(
+                    f"{name!r} is not a settable model param "
+                    f"(settable: {list(self._MODEL_PARAMS)})")
+            if name == "coldStartStrategy" and v not in ("nan", "drop"):
+                raise ValueError(
+                    "coldStartStrategy must be 'nan' or 'drop'")
+            self._params[name] = v
+        return self
+
     @property
     def userFactors(self):
         """Frame(id, features) — entity ids are the original ids."""
@@ -709,6 +728,23 @@ class ALSModel:
         return cls(rank=manifest["rank"], user_map=IdMap(ids=u_ids),
                    item_map=IdMap(ids=i_ids), user_factors=U, item_factors=V,
                    params=manifest["params"])
+
+
+def _attach_model_accessors(cls):
+    for name in cls._MODEL_PARAMS:
+        cap = name[0].upper() + name[1:]
+
+        def getter(self, _n=name):
+            return self._params[_n]
+
+        def setter(self, value, _n=name):
+            return self._set(**{_n: value})
+
+        setattr(cls, f"get{cap}", getter)
+        setattr(cls, f"set{cap}", setter)
+
+
+_attach_model_accessors(ALSModel)
 
 
 def _to_object_rows(mat):

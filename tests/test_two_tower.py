@@ -185,3 +185,39 @@ def test_from_fitted_als_model(rng):
                              als_item_factors=model._V)
     rec = recall_at_k(params, u_dense, i_dense, k=10)
     assert 0.0 <= rec <= 1.0
+
+
+def test_two_tower_save_load_roundtrip(rng, tmp_path):
+    """Config-5 model persistence: save -> load reproduces the exact
+    serving behavior (representations and retrieval top-k)."""
+    import numpy as np
+
+    from tpu_als.models.two_tower import (
+        TwoTowerConfig,
+        load_two_tower,
+        recall_at_k,
+        save_two_tower,
+        train_two_tower,
+        user_repr,
+        item_repr,
+    )
+
+    nU, nI = 60, 30
+    u = rng.integers(0, nU, 800)
+    i = rng.integers(0, nI, 800)
+    cfg = TwoTowerConfig(embed_dim=8, hidden=(16,), out_dim=8, epochs=2,
+                         batch_size=256, seed=0)
+    params = train_two_tower(u, i, nU, nI, cfg)
+    path = str(tmp_path / "tt")
+    save_two_tower(path, params, cfg, nU, nI)
+    p2, cfg2, nU2, nI2 = load_two_tower(path)
+    assert (nU2, nI2) == (nU, nI) and cfg2 == cfg
+    np.testing.assert_array_equal(
+        np.asarray(user_repr(params, np.arange(nU))),
+        np.asarray(user_repr(p2, np.arange(nU))))
+    np.testing.assert_array_equal(
+        np.asarray(item_repr(params, np.arange(nI))),
+        np.asarray(item_repr(p2, np.arange(nI))))
+    r1 = recall_at_k(params, u[:100], i[:100], k=5)
+    r2 = recall_at_k(p2, u[:100], i[:100], k=5)
+    assert r1 == r2

@@ -299,3 +299,70 @@ def recall_at_k(params, eval_u, eval_i, k=10, item_chunk=8192,
             k))[: e - s]
     hits = (topk[inv] == eval_i[:, None]).any(axis=1)
     return float(hits.mean())
+
+
+def save_two_tower(path, params, cfg: TwoTowerConfig, num_users,
+                   num_items):
+    """Persist a trained tower model: config + entity counts as JSON, the
+    params pytree as one npz (leaves in ``tree_flatten`` order).  Same
+    atomic-directory discipline as io.checkpoint (the reference's model
+    persistence analog, SURVEY.md §2.B11, for the config-5 model)."""
+    import json
+    import os
+    from dataclasses import asdict
+
+    from tpu_als.io.checkpoint import atomic_install
+
+    leaves, _ = jax.tree_util.tree_flatten(params)
+    tmp = path + ".tmp"
+    import shutil
+
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    np.savez(os.path.join(tmp, "params.npz"),
+             **{f"leaf_{k}": np.asarray(v) for k, v in enumerate(leaves)})
+    with open(os.path.join(tmp, "two_tower.json"), "w") as f:
+        json.dump({"class": "tpu_als.models.two_tower",
+                   "config": asdict(cfg),
+                   "num_users": int(num_users),
+                   "num_items": int(num_items),
+                   "n_leaves": len(leaves)}, f, indent=2)
+    atomic_install(tmp, path)
+
+
+def load_two_tower(path):
+    """Restore ``(params, cfg, num_users, num_items)`` saved by
+    :func:`save_two_tower`.  The pytree structure is rebuilt from a
+    skeleton ``init_params`` with the saved config, so leaf order is
+    stable by construction; shapes are verified leaf-by-leaf."""
+    import json
+    import os
+
+    with open(os.path.join(path, "two_tower.json")) as f:
+        meta = json.load(f)
+    if meta.get("class") != "tpu_als.models.two_tower":
+        raise ValueError(f"{path} holds a {meta.get('class')!r} save, "
+                         "not a two-tower model")
+    c = dict(meta["config"])
+    c["hidden"] = tuple(c["hidden"])
+    cfg = TwoTowerConfig(**c)
+    num_users, num_items = meta["num_users"], meta["num_items"]
+    skeleton = init_params(jax.random.PRNGKey(0), num_users, num_items,
+                           cfg)
+    leaves, treedef = jax.tree_util.tree_flatten(skeleton)
+    if meta["n_leaves"] != len(leaves):
+        raise ValueError(
+            f"saved model has {meta['n_leaves']} leaves; this build's "
+            f"structure has {len(leaves)} — config/version mismatch")
+    dat = np.load(os.path.join(path, "params.npz"), allow_pickle=False)
+    loaded = []
+    for k, sk in enumerate(leaves):
+        leaf = jnp.asarray(dat[f"leaf_{k}"])
+        if leaf.shape != sk.shape:
+            raise ValueError(
+                f"leaf {k}: saved shape {leaf.shape} != expected "
+                f"{sk.shape} (num_users/num_items/config mismatch)")
+        loaded.append(leaf)
+    return (jax.tree_util.tree_unflatten(treedef, loaded), cfg,
+            num_users, num_items)

@@ -478,3 +478,19 @@ def test_model_param_setters(rng):
         model.setColdStartStrategy("bogus")
     with pytest.raises(TypeError):
         model._set(rank=5)  # training-time params are not settable
+
+
+def test_recommend_arrays_matches_frame_surface(rng):
+    """recommend_arrays (the dense TPU-friendly serving surface) must
+    produce the same ids/scores as recommendForAllUsers' struct column."""
+    frame = small_frame(rng)
+    model = ALS(rank=3, maxIter=4, seed=2).fit(frame)
+    qids, ids, scores = model.recommend_arrays(4)
+    recs = model.recommendForAllUsers(4)
+    np.testing.assert_array_equal(qids, recs[recs.columns[0]])
+    for row in range(len(qids)):
+        got = [(int(i), float(s)) for i, s in
+               zip(ids[row], scores[row])]
+        want = [(int(i), float(s)) for i, s in
+                recs["recommendations"][row]]
+        assert got == want, row

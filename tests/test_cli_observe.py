@@ -199,3 +199,21 @@ def test_cli_evaluate_ranking_metrics(tmp_path, capsys):
     # high-rated items far above the random floor (k/items ~ 0.08)
     assert out["recall_at_5"] > 0.05
     assert out["ranking_users"] > 0
+
+
+def test_cli_tt_train(tmp_path, capsys):
+    out_dir = str(tmp_path / "towers")
+    cli_main(["tt-train", "--data", "synthetic:300x100x8000",
+              "--epochs", "2", "--embed-dim", "8", "--als-rank", "8",
+              "--als-iters", "4", "--output", out_dir])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["warm_start"] is True and line["saved"] == out_dir
+    assert 0.0 <= line["filtered_recall_at_10"] <= 1.0
+
+    from tpu_als.models.two_tower import load_two_tower, user_repr
+
+    params, cfg, nU, nI = load_two_tower(out_dir)
+    import numpy as np
+
+    z = np.asarray(user_repr(params, np.arange(5)))
+    assert z.shape == (5, cfg.out_dim) and np.isfinite(z).all()

@@ -356,6 +356,60 @@ def cmd_foldin_bench(args):
     }))
 
 
+def cmd_tt_train(args):
+    """Train the two-tower retrieval model (BASELINE config 5) from a
+    ratings file: ALS warm start (unless --cold), filtered-recall holdout
+    report, persisted towers."""
+    from tpu_als.core.als import AlsConfig, train as als_train
+    from tpu_als.core.ratings import build_csr_buckets, remap_ids
+    from tpu_als.models.two_tower import (
+        TwoTowerConfig,
+        recall_at_k,
+        save_two_tower,
+        train_two_tower,
+    )
+
+    frame = _load_data(args.data)
+    u_raw = np.asarray(frame["user"])
+    i_raw = np.asarray(frame["item"])
+    r = np.asarray(frame["rating"], dtype=np.float32)
+    u, umap = remap_ids(u_raw)
+    i, imap = remap_ids(i_raw)
+    nU, nI = len(umap), len(imap)
+    pos = r >= args.positive_threshold
+    u, i, r = u[pos], i[pos], r[pos]
+    rng = np.random.default_rng(args.seed)
+    test = rng.random(len(u)) < args.holdout
+    ut, it_ = u[test], i[test]
+    u2, i2 = u[~test], i[~test]
+
+    warm_kw = {}
+    if not args.cold:
+        als_cfg = AlsConfig(rank=args.als_rank, max_iter=args.als_iters,
+                            reg_param=0.005, implicit_prefs=True,
+                            alpha=20.0, seed=args.seed)
+        ucsr = build_csr_buckets(u2, i2, r[~test], nU)
+        icsr = build_csr_buckets(i2, u2, r[~test], nI)
+        U, V = als_train(ucsr, icsr, als_cfg)
+        warm_kw = {"als_user_factors": np.asarray(U),
+                   "als_item_factors": np.asarray(V)}
+        print("ALS warm-start factors trained", file=sys.stderr)
+
+    cfg = TwoTowerConfig(embed_dim=args.embed_dim, out_dim=args.embed_dim,
+                         epochs=args.epochs, seed=args.seed)
+    params = train_two_tower(u2, i2, nU, nI, cfg, **warm_kw)
+    rec = recall_at_k(params, ut, it_, k=args.k, exclude=(u2, i2)) \
+        if len(ut) else float("nan")
+    out = {"filtered_recall_at_%d" % args.k: round(rec, 4),
+           "train_pairs": int(len(u2)), "test_pairs": int(len(ut)),
+           "users": nU, "items": nI, "epochs": cfg.epochs,
+           "warm_start": not args.cold}
+    if args.output:
+        save_two_tower(args.output, params, cfg, nU, nI)
+        out["saved"] = args.output
+    print(json.dumps(out))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="tpu_als")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -440,6 +494,24 @@ def main(argv=None):
                    help="> 0: inexact-ALS CG solve for every grid fit "
                         "(k x numFolds fits amortize the speedup)")
     g.set_defaults(fn=cmd_tune)
+
+    tt = sub.add_parser("tt-train",
+                        help="train + persist the two-tower retrieval "
+                             "model (ALS warm start by default)")
+    tt.add_argument("--data", required=True)
+    tt.add_argument("--output", default=None,
+                    help="save the trained towers here")
+    tt.add_argument("--epochs", type=int, default=5)
+    tt.add_argument("--embed-dim", type=int, default=32)
+    tt.add_argument("--als-rank", type=int, default=32)
+    tt.add_argument("--als-iters", type=int, default=8)
+    tt.add_argument("--cold", action="store_true",
+                    help="skip the ALS warm start")
+    tt.add_argument("--holdout", type=float, default=0.1)
+    tt.add_argument("--positive-threshold", type=float, default=3.5)
+    tt.add_argument("--k", type=int, default=10)
+    tt.add_argument("--seed", type=int, default=0)
+    tt.set_defaults(fn=cmd_tt_train)
 
     f = sub.add_parser("foldin-bench", help="fold-in latency micro-benchmark")
     f.add_argument("--model", required=True)

@@ -494,3 +494,14 @@ def test_recommend_arrays_matches_frame_surface(rng):
         want = [(int(i), float(s)) for i, s in
                 recs["recommendations"][row]]
         assert got == want, row
+
+
+def test_alpha_and_blocksize_validation():
+    import pytest
+
+    tiny = ColumnarFrame({"user": np.array([0]), "item": np.array([0]),
+                          "rating": np.array([1.0], np.float32)})
+    with pytest.raises(ValueError, match="alpha"):
+        ALS(alpha=-1.0).fit(tiny)
+    with pytest.raises(ValueError, match="blockSize"):
+        ALS(blockSize=0).fit(tiny)

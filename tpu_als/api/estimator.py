@@ -88,6 +88,10 @@ class _ALSParams(Params):
                 raise ValueError(f"{lvl}: unknown storage level {get(lvl)!r}")
         if get("checkpointInterval") == 0 or get("checkpointInterval") < -1:
             raise ValueError("checkpointInterval must be >= 1 or -1")
+        if get("alpha") < 0:
+            raise ValueError("alpha must be >= 0")
+        if get("blockSize") < 1:
+            raise ValueError("blockSize must be >= 1")
 
 
 def recover_interrupted_overwrite(path):

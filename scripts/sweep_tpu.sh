@@ -50,7 +50,9 @@ run ablate_full_cg2 900 python scripts/ablate.py --scale 1 --iters 3 --variants 
 
 # 5. fold-in p50 + two-tower filtered recall (5 + 20 epochs)
 run foldin 580 python bench.py --no-auto-config --mode foldin
-run twotower_5ep 580 python bench.py --no-auto-config --mode twotower --tt-epochs 5
-run twotower_20ep 900 python bench.py --no-auto-config --mode twotower
+# the epoch-budget recall curve adds ~15 milestone evals per run —
+# timeouts sized for curve + training at bench scale
+run twotower_5ep 900 python bench.py --no-auto-config --mode twotower --tt-epochs 5
+run twotower_20ep 1500 python bench.py --no-auto-config --mode twotower
 
 echo "=== sweep done ($(date +%H:%M:%S)) ==="

@@ -529,6 +529,20 @@ def run_foldin(args):
         }))
     p50 = srv.latency(0.5, skip_warmup=True)
     p95 = srv.latency(0.95, skip_warmup=True)
+    # the symmetric serving direction: NEW ITEMS folded against the
+    # (much larger) user factor table — quantiles reported alongside
+    n_user_stats = len(srv.stats)
+    ibase = int(model._item_map.ids.max()) + 1
+    for b in range(8):
+        srv.update_items(ColumnarFrame({
+            "user": rng.choice(model._user_map.ids, args.foldin_batch),
+            "item": rng.integers(ibase, ibase + 200, args.foldin_batch),
+            "rating": rng.uniform(0.5, 5.0,
+                                  args.foldin_batch).astype(np.float32),
+        }))
+    item_lat = sorted(s[2] for s in srv.stats[n_user_stats + 1:])
+    item_p50 = (item_lat[len(item_lat) // 2] if item_lat
+                else float("nan"))
     return {
         "value": round(p50, 4),
         "unit": "seconds_p50",
@@ -540,6 +554,7 @@ def run_foldin(args):
             "rank": args.rank, "items": nI, "batch_size": args.foldin_batch,
             "batches": batches, "p95_seconds": round(p95, 4),
             "prewarm_seconds": round(prewarm_s, 1),
+            "item_foldin_p50_seconds": round(item_p50, 4),
             "device": str(jax.devices()[0]),
         },
     }

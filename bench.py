@@ -283,6 +283,43 @@ def error_json(args, metric, unit, err):
     }
 
 
+def synthetic_cached(nU, nI, nnz, seed=0):
+    """(u, i, r) triples of ``synthetic_movielens``, memoized to disk.
+
+    Every sweep step re-synthesizes the full ML-25M-scale dataset (~1-2
+    min); with a tunnel that can die mid-sweep, those minutes decide
+    which steps land.  The cache key is the full parameter tuple; the
+    generator is deterministic per seed, so the cache is exact.  Falls
+    back to direct synthesis on any IO problem.
+    """
+    import os
+
+    import numpy as np
+
+    from tpu_als.io.movielens import synthetic_movielens
+
+    cache = os.path.join(".bench_cache", f"synth_{nU}_{nI}_{nnz}_{seed}.npz")
+    try:
+        d = np.load(cache, allow_pickle=False)
+        log(f"synthetic triples from cache ({cache})")
+        return d["u"], d["i"], d["r"]
+    except Exception:
+        pass
+    frame = synthetic_movielens(nU, nI, nnz, seed=seed)
+    u = np.asarray(frame["user"])
+    i = np.asarray(frame["item"])
+    r = np.asarray(frame["rating"])
+    try:
+        os.makedirs(".bench_cache", exist_ok=True)
+        # tmp must END in .npz or np.savez appends the suffix itself
+        tmp = cache + f".{os.getpid()}.tmp.npz"
+        np.savez(tmp, u=u, i=i, r=r)
+        os.replace(tmp, cache)
+    except Exception as e:
+        log(f"synthetic cache write skipped: {e}")
+    return u, i, r
+
+
 def analytic_flops_per_iter(nnz, n_users, n_items, rank, implicit):
     """Useful (unpadded) FLOPs in one full ALS iteration.
 
@@ -305,7 +342,7 @@ def run_headline(args):
 
     from tpu_als.core.als import AlsConfig, make_step, init_factors
     from tpu_als.core.ratings import build_csr_buckets
-    from tpu_als.io.movielens import ML25M_SHAPE, synthetic_movielens
+    from tpu_als.io.movielens import ML25M_SHAPE
 
     nU, nI, nnz = ML25M_SHAPE
     if args.small:
@@ -315,10 +352,7 @@ def run_headline(args):
                              "jax.devices() hung after successful probe")
     log(f"devices: {devs}")
     t0 = time.time()
-    frame = synthetic_movielens(nU, nI, nnz, seed=0)
-    u = np.asarray(frame["user"])
-    i = np.asarray(frame["item"])
-    r = np.asarray(frame["rating"])
+    u, i, r = synthetic_cached(nU, nI, nnz, seed=0)
     log(f"synthesized {nnz:,} ratings ({time.time()-t0:.1f}s)")
 
     t0 = time.time()
@@ -415,10 +449,7 @@ def run_rmse(args):
     devs = call_with_timeout(jax.devices, 180,
                              "jax.devices() hung after successful probe")
     log(f"devices: {devs}")
-    frame = synthetic_movielens(nU, nI, nnz, seed=0)
-    u = np.asarray(frame["user"])
-    i = np.asarray(frame["item"])
-    r = np.asarray(frame["rating"])
+    u, i, r = synthetic_cached(nU, nI, nnz, seed=0)
 
     rng = np.random.default_rng(1)
     test = rng.random(nnz) < 0.05

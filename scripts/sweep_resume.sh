@@ -1,0 +1,113 @@
+#!/bin/bash
+# Resumable TPU measurement loop (round-4 replacement for the one-shot
+# scripts/sweep_tpu.sh after the 2026-07-31 tunnel flap showed up-windows
+# can be ~3 minutes long).
+#
+# Design:
+#   - One cheap tunnel probe gates every step; while the tunnel is down
+#     the loop naps instead of letting each bench burn 6x120s of its own
+#     probe retries (steps run with --probe-attempts 1).
+#   - Steps are value-ordered and individually timeout-bounded; a step is
+#     DONE when its .out carries a non-error JSON line (bench steps) or
+#     exits rc=0 (script steps), recorded as sweep_logs/<name>.done so
+#     any restart of this script resumes instead of re-measuring.
+#   - A step that fails while the tunnel is still up counts as a real
+#     attempt; after MAX_TRIES it is parked as <name>.fail and the loop
+#     moves on (a dead step must not eat the window the others need).
+#   - The known-good exact-path headline runs FIRST: bank the number the
+#     round needs before gambling the window on the cg2 candidate.
+#
+#   bash scripts/sweep_resume.sh [max_loop_minutes]
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p sweep_logs
+LOG=sweep_logs/watch.log
+MAX_MIN=${1:-600}
+MAX_TRIES=3
+DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
+
+# name|timeout|command   (value order: exact headline + quality first,
+# then the cg2 lever + its quality gate, then kernels/rank256, then the
+# remaining A/Bs and application benchmarks)
+STEPS=(
+  "headline_f32|580|python bench.py --no-auto-config --iters 5 --probe-attempts 1"
+  "rmse|580|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --probe-attempts 1"
+  "headline_cg2|700|python bench.py --no-auto-config --iters 5 --cg-iters 2 --probe-attempts 1"
+  "rmse_cg2|700|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --cg-iters 2 --probe-attempts 1"
+  "kernel_lab|580|python scripts/kernel_lab.py --panels 4 8 16"
+  "rank256_proxy|900|python scripts/rank256_proxy.py"
+  "headline_cg2_dense|700|python bench.py --no-auto-config --iters 5 --cg-iters 2 --cg-mode dense --probe-attempts 1"
+  "headline_cg3|700|python bench.py --no-auto-config --iters 5 --cg-iters 3 --probe-attempts 1"
+  "headline_cg2_bf16|700|python bench.py --no-auto-config --iters 5 --cg-iters 2 --compute-dtype bfloat16 --probe-attempts 1"
+  "rmse_cg2_bf16|700|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --cg-iters 2 --compute-dtype bfloat16 --probe-attempts 1"
+  "headline_bf16|580|python bench.py --no-auto-config --iters 5 --compute-dtype bfloat16 --probe-attempts 1"
+  "rmse_bf16|580|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --compute-dtype bfloat16 --probe-attempts 1"
+  "kernel_lab_r256|580|python scripts/kernel_lab.py --rank 256 --n 8192 --panels 4 8 16"
+  "headline_wg15|580|python bench.py --no-auto-config --iters 5 --width-growth 1.5 --probe-attempts 1"
+  "headline_bf16_wg15|580|python bench.py --no-auto-config --iters 5 --compute-dtype bfloat16 --width-growth 1.5 --probe-attempts 1"
+  "foldin|580|python bench.py --no-auto-config --mode foldin --probe-attempts 1"
+  "ablate_full_cg2|900|python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2"
+  "twotower_5ep|900|python bench.py --no-auto-config --mode twotower --tt-epochs 5 --probe-attempts 1"
+  "twotower_20ep|1500|python bench.py --no-auto-config --mode twotower --probe-attempts 1"
+)
+
+step_ok() {  # decide DONE from the step's .out: bench JSON without error,
+  local out=$1 # or (script steps) any content with rc recorded 0 by caller
+  python - "$out" <<'EOF'
+import json, sys
+try:
+    lines = [l.strip() for l in open(sys.argv[1]) if l.strip()]
+except OSError:
+    sys.exit(1)
+for ln in reversed(lines):
+    if ln.startswith("{"):
+        try:
+            d = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        sys.exit(0 if d.get("value") is not None and not d.get("error") else 1)
+sys.exit(1)
+EOF
+}
+
+probe() {
+  timeout 90 python -c \
+    "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d" \
+    >/dev/null 2>&1
+}
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  next=""
+  for s in "${STEPS[@]}"; do
+    name=${s%%|*}
+    if [ ! -f "sweep_logs/$name.done" ] && [ ! -f "sweep_logs/$name.fail" ]; then
+      next=$s; break
+    fi
+  done
+  if [ -z "$next" ]; then
+    echo "$(date -Is) resume-sweep: all steps done/parked" >>"$LOG"
+    exit 0
+  fi
+  name=${next%%|*}; rest=${next#*|}; to=${rest%%|*}; cmd=${rest#*|}
+  if ! probe; then
+    echo "$(date -Is) resume-sweep: tunnel down (next=$name), napping 150s" >>"$LOG"
+    sleep 150
+    continue
+  fi
+  tries_file="sweep_logs/$name.tries"
+  tries=$(( $(cat "$tries_file" 2>/dev/null || echo 0) + 1 ))
+  echo "$tries" >"$tries_file"
+  echo "$(date -Is) resume-sweep: RUN $name (try $tries/$MAX_TRIES, timeout ${to}s)" >>"$LOG"
+  timeout "$to" $cmd >"sweep_logs/$name.out" 2>"sweep_logs/$name.err"
+  rc=$?
+  if { [ "$rc" -eq 0 ] && [[ "$cmd" != python\ bench.py* ]]; } || step_ok "sweep_logs/$name.out"; then
+    touch "sweep_logs/$name.done"
+    echo "$(date -Is) resume-sweep: $name DONE (rc=$rc)" >>"$LOG"
+  elif [ "$tries" -ge "$MAX_TRIES" ]; then
+    touch "sweep_logs/$name.fail"
+    echo "$(date -Is) resume-sweep: $name PARKED after $tries tries (rc=$rc)" >>"$LOG"
+  else
+    echo "$(date -Is) resume-sweep: $name failed (rc=$rc), will retry" >>"$LOG"
+  fi
+done
+echo "$(date -Is) resume-sweep: wall budget exhausted" >>"$LOG"

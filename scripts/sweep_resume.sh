@@ -70,8 +70,12 @@ sys.exit(1)
 EOF
 }
 
+# Probe timeout 60s: a live tunnel answers in 2-11s (bench_full.log /
+# this round's sweep), so 60s only bounds the hang case.  Nap 45s: the
+# 2026-07-31 up-window lasted ~3 minutes — a 150s nap could eat most of
+# a window that short.
 probe() {
-  timeout 90 python -c \
+  timeout 60 python -c \
     "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d" \
     >/dev/null 2>&1
 }
@@ -90,8 +94,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   fi
   name=${next%%|*}; rest=${next#*|}; to=${rest%%|*}; cmd=${rest#*|}
   if ! probe; then
-    echo "$(date -Is) resume-sweep: tunnel down (next=$name), napping 150s" >>"$LOG"
-    sleep 150
+    echo "$(date -Is) resume-sweep: tunnel down (next=$name), napping 45s" >>"$LOG"
+    sleep 45
     continue
   fi
   tries_file="sweep_logs/$name.tries"

@@ -237,6 +237,7 @@ def builder_measured_provenance(mode, sweep_dir="sweep_logs"):
 
     steps = {"headline": list(_AUTO_SELECTABLE),
              "rmse": ["rmse", "rmse_cg2", "rmse_bf16", "rmse_cg2_bf16"],
+             "ml100k": ["ml100k"],
              "foldin": ["foldin"],
              "twotower": ["twotower_20ep", "twotower_5ep"]}.get(mode, [])
     # higher-is-better only for throughput/recall modes
@@ -433,16 +434,29 @@ def run_rmse(args):
     """Held-out RMSE at ML-25M scale (BASELINE.json metric 2): explicit ALS
     on the planted-low-rank synthetic, 95/5 split.  The generator plants a
     rank-16 structure + noise, so a correct solver must recover most of it;
-    the floor is the half-star quantization + noise (~0.36 stars)."""
+    the floor is the half-star quantization + noise (~0.36 stars).
+
+    ``--mode ml100k`` reuses this path at BASELINE config 1's operating
+    point instead: ML-100K shape (943 x 1,682, 100k ratings), rank 10,
+    10 iterations, explicit, 80/20 split — the stock-PySpark starter
+    config.  The reported value there is fit wall-clock (the row's
+    comparison is against `local[*]` Spark, which this environment cannot
+    run), with held-out RMSE carried in the config block."""
     import numpy as np
 
     import jax
 
     from tpu_als.core.als import AlsConfig, train, predict
     from tpu_als.core.ratings import build_csr_buckets
-    from tpu_als.io.movielens import ML25M_SHAPE, synthetic_movielens
+    from tpu_als.io.movielens import ML100K_SHAPE, ML25M_SHAPE
 
-    nU, nI, nnz = ML25M_SHAPE
+    if args.mode == "ml100k":
+        nU, nI, nnz = ML100K_SHAPE
+        rank, iters, reg, test_frac = 10, 10, 0.1, 0.2
+    else:
+        nU, nI, nnz = ML25M_SHAPE
+        rank, iters, reg, test_frac = (args.rank, args.iters_rmse,
+                                       args.reg, 0.05)
     if args.small:
         nU, nI, nnz = nU // 25, nI // 25, nnz // 25
 
@@ -452,7 +466,7 @@ def run_rmse(args):
     u, i, r = synthetic_cached(nU, nI, nnz, seed=0)
 
     rng = np.random.default_rng(1)
-    test = rng.random(nnz) < 0.05
+    test = rng.random(nnz) < test_frac
     ut, it_, rt = u[test], i[test], r[test]
     u, i, r = u[~test], i[~test], r[~test]
     log(f"split: {len(r):,} train / {len(rt):,} test")
@@ -462,8 +476,8 @@ def run_rmse(args):
     icsr = build_csr_buckets(i, u, r, nI, width_growth=args.width_growth)
     log(f"blocked ({time.time()-t0:.1f}s)")
 
-    cfg = AlsConfig(rank=args.rank, max_iter=args.iters_rmse,
-                    reg_param=args.reg, implicit_prefs=False, seed=0,
+    cfg = AlsConfig(rank=rank, max_iter=iters,
+                    reg_param=reg, implicit_prefs=False, seed=0,
                     solve_backend=args.solve_backend,
                     compute_dtype=args.compute_dtype,
                     cg_iters=args.cg_iters, cg_mode=args.cg_mode)
@@ -492,21 +506,35 @@ def run_rmse(args):
     base = float(np.sqrt(np.mean((rt - r.mean()) ** 2)))
     log(f"held-out RMSE {rmse:.4f} (global-mean predictor {base:.4f})")
 
+    config = {
+        "users": nU, "items": nI, "ratings": nnz, "rank": cfg.rank,
+        "iters": cfg.max_iter, "reg_param": cfg.reg_param,
+        "train_seconds": round(train_s, 1),
+        "seconds_per_iter": round(train_s / cfg.max_iter, 3),
+        "test_pairs_scored": cnt,
+        "device": str(jax.devices()[0]),
+        **_resolve(cfg),
+    }
+    if args.mode == "ml100k":
+        config["heldout_rmse"] = round(rmse, 4)
+        config["global_mean_rmse"] = round(base, 4)
+        return {
+            "value": round(train_s, 2),
+            "unit": "seconds_fit_wallclock",
+            "vs_baseline": None,
+            "baseline_note": "BASELINE config 1: stock-PySpark `local[*]` "
+                             "baseline is unpublished and Spark cannot run "
+                             "in this environment; the measured artifact "
+                             "is our fit wall-clock + held-out RMSE",
+            "config": config,
+        }
     return {
         "value": round(rmse, 4),
         "unit": "rmse_stars",
         "vs_baseline": round(base / rmse, 3),
         "baseline_note": "vs_baseline = global-mean-predictor RMSE / model "
                          "RMSE (>1 is better); reference publishes no RMSE",
-        "config": {
-            "users": nU, "items": nI, "ratings": nnz, "rank": args.rank,
-            "iters": cfg.max_iter, "reg_param": cfg.reg_param,
-            "train_seconds": round(train_s, 1),
-            "seconds_per_iter": round(train_s / cfg.max_iter, 3),
-            "test_pairs_scored": cnt,
-            "device": str(jax.devices()[0]),
-            **_resolve(cfg),
-        },
+        "config": config,
     }
 
 
@@ -776,7 +804,8 @@ def run_twotower(args):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="headline",
-                    choices=["headline", "rmse", "foldin", "twotower"])
+                    choices=["headline", "rmse", "ml100k", "foldin",
+                             "twotower"])
     ap.add_argument("--small", action="store_true",
                     help="1/25 scale for quick checks")
     ap.add_argument("--iters", type=int, default=3,
@@ -847,6 +876,8 @@ def main():
         "headline": ("als_iters_per_sec_rank128_ml25m_implicit",
                      "iters/sec"),
         "rmse": ("als_heldout_rmse_ml25m_explicit", "rmse_stars"),
+        "ml100k": ("als_ml100k_rank10_fit_seconds",
+                   "seconds_fit_wallclock"),
         "foldin": ("foldin_p50_latency", "seconds_p50"),
         "twotower": ("two_tower_recall_at_10", "recall_at_10"),
     }[args.mode]
@@ -866,6 +897,7 @@ def main():
 
     try:
         run = {"headline": run_headline, "rmse": run_rmse,
+               "ml100k": run_rmse,
                "foldin": run_foldin, "twotower": run_twotower}[args.mode]
         result = run(args)
         result["metric"] = metric

@@ -25,8 +25,10 @@ run headline_f32     580 python bench.py --no-auto-config --iters 5
 run rmse_cg2 580 python bench.py --no-auto-config --mode rmse --iters-rmse 12 --cg-iters 2
 
 # 2. rank-256 single-core proxy (BASELINE row 3 / config 3 evidence:
-#    pallas_solve at the production rank, s/iter, peak HBM)
+#    pallas_solve at the production rank, s/iter, peak HBM) + the cheap
+#    BASELINE config-1 row (ML-100K shape, rank 10, explicit)
 run rank256_proxy 900 python scripts/rank256_proxy.py
+run ml100k 300 python bench.py --no-auto-config --mode ml100k
 
 # 3. solve-kernel panel sweep (sets DEFAULT_PANEL if a non-8 wins) and
 #    the remaining headline A/Bs

@@ -133,3 +133,22 @@ def test_error_json_embeds_provenance():
     assert j["value"] is None
     lb = j["last_builder_measured"]
     assert lb is not None and lb["value"] is not None
+
+
+def test_ml100k_mode_registered():
+    # BASELINE config-1 row: the mode must exist in the CLI surface and
+    # its sweep step must transport through provenance like the others
+    import subprocess
+
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "nonsense"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert "ml100k" in p.stderr  # argparse lists valid choices
+
+
+def test_ml100k_provenance_transports(tmp_path):
+    d = str(tmp_path)
+    _write(d, "ml100k", {"value": 2.1, "unit": "seconds_fit_wallclock"})
+    p = bench.builder_measured_provenance("ml100k", d)
+    assert p["value"] == 2.1

@@ -176,17 +176,27 @@ def best_measured_flags(sweep_dir="sweep_logs"):
     if best_name is None:
         return None
     flags = dict(_SWEEP_FLAGS[best_name])
-    quality_step = _AUTO_SELECTABLE[best_name]
-    if quality_step is not None:
-        q = _last_json(os.path.join(sweep_dir, quality_step + ".out"))
-        if not (q and q.get("value") and q["value"] <= _RMSE_GATE):
-            log(f"sweep winner {best_name} lacks quality evidence "
-                f"({quality_step} missing or > {_RMSE_GATE}); keeping "
-                "defaults")
-            return None
+    if not _quality_validated(best_name, sweep_dir):
+        log(f"sweep winner {best_name} lacks quality evidence "
+            f"({_AUTO_SELECTABLE[best_name]} missing or > {_RMSE_GATE}); "
+            "keeping defaults")
+        return None
     log(f"auto-selected sweep-validated config {best_name} "
         f"({best_val} iters/sec measured): {flags}")
     return flags
+
+
+def _quality_validated(name, sweep_dir):
+    """The single evidence bar shared by auto-selection AND the
+    provenance block: a numerics-changing headline config counts only if
+    its matching rmse sweep step exists and beats the gate."""
+    import os
+
+    quality_step = _AUTO_SELECTABLE[name]
+    if quality_step is None:
+        return True
+    q = _last_json(os.path.join(sweep_dir, quality_step + ".out"))
+    return bool(q and q.get("value") and q["value"] <= _RMSE_GATE)
 
 
 # Builder-measured evidence per mode (strongest number measured by hand on
@@ -246,18 +256,10 @@ def builder_measured_provenance(mode, sweep_dir="sweep_logs"):
         j = _last_json(os.path.join(sweep_dir, name + ".out"))
         if not (j and j.get("value") is not None):
             continue
-        if mode == "headline":
-            # same evidence bar as auto-selection: a numerics-changing
-            # config only counts with its passing quality step — the
-            # provenance block must not advertise a number
-            # best_measured_flags itself would reject as unvalidated
-            quality_step = _AUTO_SELECTABLE[name]
-            if quality_step is not None:
-                q = _last_json(os.path.join(sweep_dir,
-                                            quality_step + ".out"))
-                if not (q and q.get("value")
-                        and q["value"] <= _RMSE_GATE):
-                    continue
+        if mode == "headline" and not _quality_validated(name, sweep_dir):
+            # same evidence bar as auto-selection — the provenance block
+            # must not advertise a number best_measured_flags rejects
+            continue
         better = (j["value"] > best["value"] if mode in ("headline",
                                                          "twotower")
                   else j["value"] < best["value"]) if best else True

@@ -217,3 +217,51 @@ def test_cli_tt_train(tmp_path, capsys):
 
     z = np.asarray(user_repr(params, np.arange(5)))
     assert z.shape == (5, cfg.out_dim) and np.isfinite(z).all()
+
+
+def test_cli_evaluate_ranking_scores_cold_users_as_misses(tmp_path,
+                                                          capsys):
+    """A test split containing users the model never saw must count them
+    as empty prediction lists (zero contribution), not silently drop
+    them — dropping inflates every ranking metric (advisor r4)."""
+    model_dir = str(tmp_path / "m")
+    cli_main(["train", "--data", "synthetic:150x60x4000", "--rank", "6",
+              "--max-iter", "5", "--seed", "0", "--output", model_dir])
+    capsys.readouterr()
+    # eval file = training interactions + positives for unknown users
+    from tpu_als.io.movielens import synthetic_movielens
+
+    frame = synthetic_movielens(150, 60, 4000, seed=0)
+    csv_path = tmp_path / "eval.csv"
+    lines = ["userId,movieId,rating,timestamp"]
+    for u, i, r in zip(frame["user"], frame["item"], frame["rating"]):
+        lines.append(f"{int(u)},{int(i)},{float(r)},0")
+    n_cold = 7
+    for cu in range(10 ** 6, 10 ** 6 + n_cold):  # ids absent from training
+        lines.append(f"{cu},1,5.0,0")
+    csv_path.write_text("\n".join(lines) + "\n")
+
+    cli_main(["evaluate", "--model", model_dir,
+              "--data", f"csv:{csv_path}", "--ranking-k", "5"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ranking_users_cold"] == n_cold
+    # and the cold users are IN the averaged population
+    cli_main(["evaluate", "--model", model_dir,
+              "--data", "synthetic:150x60x4000", "--ranking-k", "5"])
+    warm_only = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ranking_users"] == warm_only["ranking_users"] + n_cold
+    assert out["recall_at_5"] < warm_only["recall_at_5"]
+
+
+def test_cli_tt_train_empty_holdout_emits_valid_json(capsys):
+    """--holdout 0 leaves no test pairs; the metric must serialize as
+    null, not the non-standard `NaN` token (advisor r4)."""
+    cli_main(["tt-train", "--data", "synthetic:200x80x4000",
+              "--epochs", "1", "--embed-dim", "8", "--cold",
+              "--holdout", "0"])
+    raw = capsys.readouterr().out.strip().splitlines()[-1]
+    line = json.loads(raw)  # strict parse would fail on bare NaN
+    assert "NaN" not in raw
+    assert line["filtered_recall_at_10"] is None
+    assert line["test_pairs"] == 0

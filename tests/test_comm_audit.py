@@ -131,3 +131,73 @@ def test_a2a_model_matches_traced_bytes():
                                 implicit=True)
     assert breakdown.get("all_to_all") and breakdown.get("psum")
     assert traced == model, (traced, model, breakdown)
+
+
+def _shmap_psum_fn(mesh, branch_bytes_differ=False, while_pred=False):
+    """Tiny shard_mapped programs exercising the audit's control-flow
+    conventions (cond counted once / disagreeing branches rejected /
+    collective in a while predicate rejected)."""
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(AXIS)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=P())
+    def equal_branches(x):
+        return jax.lax.cond(
+            x.sum() > 0,
+            lambda v: jax.lax.psum(v.sum(), AXIS),
+            lambda v: jax.lax.psum(v.sum() * 2.0, AXIS),
+            x)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=P())
+    def unequal_branches(x):
+        return jax.lax.cond(
+            x.sum() > 0,
+            lambda v: jax.lax.psum(v[:2], AXIS).sum(),
+            lambda v: jax.lax.psum(v.sum(), AXIS) * 0.0,
+            x)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=P())
+    def psum_in_while_pred(x):
+        return jax.lax.while_loop(
+            lambda s: jax.lax.psum(s.sum(), AXIS) > 1.0,
+            lambda s: s * 0.5,
+            x)
+
+    if branch_bytes_differ:
+        return unequal_branches
+    if while_pred:
+        return psum_in_while_pred
+    return equal_branches
+
+
+def test_cond_branches_counted_once():
+    mesh = make_mesh(D)
+    x = jnp.ones((D * 4,), jnp.float32)
+    fn = _shmap_psum_fn(mesh)
+    total, breakdown = collective_bytes(fn, x, axis_size=D)
+    # one scalar f32 psum, counted ONCE (not per branch):
+    # 2*(S-1)/S * 4 bytes
+    assert total == int(2 * (D - 1) / D * 4)
+
+
+def test_cond_disagreeing_branches_rejected():
+    import pytest
+
+    mesh = make_mesh(D)
+    x = jnp.ones((D * 4,), jnp.float32)
+    fn = _shmap_psum_fn(mesh, branch_bytes_differ=True)
+    with pytest.raises(ValueError, match="branches"):
+        collective_bytes(fn, x, axis_size=D)
+
+
+def test_collective_in_while_predicate_rejected():
+    import pytest
+
+    mesh = make_mesh(D)
+    x = jnp.ones((D * 4,), jnp.float32)
+    fn = _shmap_psum_fn(mesh, while_pred=True)
+    with pytest.raises(ValueError, match="while"):
+        collective_bytes(fn, x, axis_size=D)

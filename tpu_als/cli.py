@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 
 import numpy as np
@@ -200,7 +201,10 @@ def cmd_evaluate(args):
     result = {}
     for metric in ("rmse", "mae", "r2"):
         ev = RegressionEvaluator(labelCol="rating", metricName=metric)
-        result[metric] = round(ev.evaluate(out), 4)
+        v = ev.evaluate(out)
+        # None, not NaN (every row unservable → all-NaN predictions):
+        # json.dumps would emit the non-standard `NaN` token
+        result[metric] = round(v, 4) if math.isfinite(v) else None
     if args.ranking_k > 0:
         # retrieval-quality protocol (SURVEY §2.B7): per test user,
         # ground truth = their test items rated >= --positive-threshold;
@@ -227,6 +231,14 @@ def cmd_evaluate(args):
              truth[int(recs[key][row])])
             for row in range(len(recs))
         ]
+        # test users the model cannot serve (absent from training) are
+        # filtered out by recommendForUserSubset; the reference protocol
+        # scores them as an EMPTY prediction list (zero contribution),
+        # not as excluded — dropping them silently would bias every
+        # ranking metric upward whenever the split has cold users
+        served = {int(recs[key][row]) for row in range(len(recs))}
+        cold = [uu for uu in truth if uu not in served]
+        pairs.extend(([], truth[uu]) for uu in cold)
         rm = RankingMetrics(pairs)
         result.update({
             f"precision_at_{k}": round(rm.precisionAt(k), 4),
@@ -234,6 +246,7 @@ def cmd_evaluate(args):
             "map": round(rm.meanAveragePrecision, 4),
             f"ndcg_at_{k}": round(rm.ndcgAt(k), 4),
             "ranking_users": len(pairs),
+            "ranking_users_cold": len(cold),
         })
     print(json.dumps(result))
 
@@ -398,9 +411,11 @@ def cmd_tt_train(args):
     cfg = TwoTowerConfig(embed_dim=args.embed_dim, out_dim=args.embed_dim,
                          epochs=args.epochs, seed=args.seed)
     params = train_two_tower(u2, i2, nU, nI, cfg, **warm_kw)
-    rec = recall_at_k(params, ut, it_, k=args.k, exclude=(u2, i2)) \
-        if len(ut) else float("nan")
-    out = {"filtered_recall_at_%d" % args.k: round(rec, 4),
+    # None, not NaN: json.dumps would emit the non-standard `NaN` token
+    # that strict parsers (jq etc.) reject
+    rec = (round(recall_at_k(params, ut, it_, k=args.k, exclude=(u2, i2)),
+                 4) if len(ut) else None)
+    out = {"filtered_recall_at_%d" % args.k: rec,
            "train_pairs": int(len(u2)), "test_pairs": int(len(ut)),
            "users": nU, "items": nI, "epochs": cfg.epochs,
            "warm_start": not args.cold}

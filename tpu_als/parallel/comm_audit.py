@@ -18,6 +18,10 @@ conventions per primitive mirror the model's documented ones
 - ``psum``        → bidirectional-ring all-reduce, ``2·(S−1)/S × |out|``
 - ``all_to_all``  → sent + received minus the self slice,
   ``2·(S−1)/S × |out|``
+- ``cond``        → one branch executes per call: branches moving equal
+  totals count once; disagreeing branches raise (data-dependent traffic)
+- ``while``       → a collective in the body OR the predicate raises
+  (unbounded trip count cannot be scaled)
 """
 
 from __future__ import annotations
@@ -56,12 +60,12 @@ def collective_bytes(fn, *args, axis_size):
     COLLECTIVES = ("all_gather", "ppermute", "psum", "psum2",
                    "psum_invariant", "all_to_all")
 
-    def add(name, nbytes):
-        breakdown[name] = breakdown.get(name, 0) + int(nbytes)
-
     S = int(axis_size)
 
-    def walk(jaxpr, mult):
+    def walk(jaxpr, mult, out):
+        def add(name, nbytes):
+            out[name] = out.get(name, 0) + int(nbytes)
+
         for eqn in jaxpr.eqns:
             name = eqn.primitive.name
             if name == "all_gather":
@@ -75,22 +79,43 @@ def collective_bytes(fn, *args, axis_size):
                 add(name, mult * 2 * (S - 1) / S * _out_bytes(eqn))
             elif name == "scan":
                 walk(eqn.params["jaxpr"].jaxpr,
-                     mult * int(eqn.params["length"]))
+                     mult * int(eqn.params["length"]), out)
             elif name == "while":
-                body = eqn.params["body_jaxpr"].jaxpr
-                if _has_collective(body):
+                # both sub-jaxprs run an unbounded number of times —
+                # a collective in EITHER (a converged-everywhere psum
+                # predicate is the classic case) is unscalable here
+                if (_has_collective(eqn.params["body_jaxpr"].jaxpr)
+                        or _has_collective(eqn.params["cond_jaxpr"].jaxpr)):
                     raise ValueError(
                         "collective inside a while loop with unbounded "
                         "trip count — the audit cannot scale it; use a "
                         "static-bound fori_loop/scan")
             elif name == "cond":
+                # exactly one branch executes per call: counting all
+                # branches would over-report.  Branches that move the
+                # same total are counted once; disagreeing branches make
+                # the per-iteration traffic data-dependent, which the
+                # closed-form model cannot represent — raise.
+                per_branch = []
                 for br in eqn.params["branches"]:
-                    walk(br.jaxpr, mult)
+                    sub = {}
+                    walk(br.jaxpr, mult, sub)
+                    per_branch.append(sub)
+                # full per-primitive dicts, not grand totals: branches
+                # moving the same bytes through DIFFERENT primitives
+                # would make the breakdown's attribution data-dependent
+                if any(d != per_branch[0] for d in per_branch[1:]):
+                    raise ValueError(
+                        "cond branches move different collective "
+                        f"traffic {per_branch} — per-iteration traffic "
+                        "is data-dependent and unauditable")
+                for k, v in per_branch[0].items():
+                    add(k, v)
             else:
                 for p in ("jaxpr", "call_jaxpr"):
                     inner = eqn.params.get(p) if eqn.params else None
                     if inner is not None:
-                        walk(getattr(inner, "jaxpr", inner), mult)
+                        walk(getattr(inner, "jaxpr", inner), mult, out)
 
     def _has_collective(jaxpr):
         found = []
@@ -110,7 +135,7 @@ def collective_bytes(fn, *args, axis_size):
         probe(jaxpr)
         return bool(found)
 
-    walk(closed.jaxpr, 1)
+    walk(closed.jaxpr, 1, breakdown)
     # the jaxpr is per-program; under shard_map the collectives are
     # per-device ops already, so no further division
     return int(sum(breakdown.values())), breakdown

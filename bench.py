@@ -896,6 +896,11 @@ def main():
         if not ok:
             print(json.dumps(error_json(args, metric, unit, err)))
             return
+        # a step retried in the next tunnel window skips its warmup
+        # compile if the executable was cached before the tunnel died
+        from tpu_als.utils.platform import enable_persistent_compile_cache
+
+        enable_persistent_compile_cache()
 
     try:
         run = {"headline": run_headline, "rmse": run_rmse,

@@ -125,6 +125,8 @@ def main():
                     choices=["default", "cpu"],
                     help="cpu = force the CPU backend (smoke tests)")
     args = ap.parse_args()
+    from tpu_als.utils.platform import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
     if args.cg_iters > 0 and args.solve_backend == "fused":
         # fused takes precedence over cg (core/als.py doc) — refusing the
         # combination beats printing fused timings under a CG label

@@ -30,6 +30,9 @@ def main():
     args = ap.parse_args()
     n, r = args.n, args.rank
 
+    from tpu_als.utils.platform import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
+
     rng = np.random.default_rng(0)
     # correctness batch (small), validated vs XLA
     nc = LANES + 8

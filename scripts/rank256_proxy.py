@@ -67,6 +67,8 @@ def main():
     import jax
 
     from bench import analytic_flops_per_iter, call_with_timeout, log
+    from tpu_als.utils.platform import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
     from tpu_als.core.als import (
         AlsConfig, init_factors, make_step, resolve_solve_path)
     from tpu_als.core.ratings import build_csr_buckets

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import sys
+
 import jax
 
 
@@ -139,6 +141,27 @@ def fence(x):
     import jax.numpy as jnp
 
     return float(jnp.sum(jnp.abs(x)))
+
+
+def enable_persistent_compile_cache(path=".bench_cache/xla_cache"):
+    """Best-effort persistent XLA compilation cache.
+
+    The tunneled TPU comes and goes in windows of a few minutes; a sweep
+    step that dies mid-run and retries in the next window pays its ~40 s
+    warmup compile again unless the executable is cached on disk.  The
+    threshold knobs admit even fast compiles so every retry benefits.
+    Failure is non-fatal (older jax, read-only disk, backend without
+    serialization support): the step just compiles as before.
+    """
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return True
+    except Exception as e:
+        print(f"persistent compile cache unavailable ({type(e).__name__}: "
+              f"{e}); steps will recompile on retry", file=sys.stderr)
+        return False
 
 
 def on_tpu():

@@ -1,0 +1,304 @@
+"""Pipeline / StringIndexer / IndexToString — the reference's
+`pyspark.ml` composition layer (SURVEY.md §1 L2; canonical upstream
+`python/pyspark/ml/pipeline.py`, `python/pyspark/ml/feature.py`).
+
+The flagship test is the canonical recommender pipeline shape:
+StringIndexer(user) → StringIndexer(item) → ALS on raw string ids.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_als import (
+    ALS,
+    ColumnarFrame,
+    CrossValidator,
+    IndexToString,
+    ParamGridBuilder,
+    Pipeline,
+    PipelineModel,
+    RegressionEvaluator,
+    StringIndexer,
+    StringIndexerModel,
+)
+
+
+def _string_ratings(rng, n_users=30, n_items=20, rank=4, density=0.5):
+    from tests.conftest import make_ratings
+
+    u, i, r, _, _ = make_ratings(rng, n_users, n_items, rank, density)
+    return ColumnarFrame({
+        "userName": np.array([f"user_{k}" for k in u], dtype=object),
+        "itemName": np.array([f"item_{k}" for k in i], dtype=object),
+        "rating": r,
+    })
+
+
+# -- StringIndexer ---------------------------------------------------------
+
+def test_indexer_frequency_desc_order():
+    df = ColumnarFrame({"c": np.array(["b", "a", "b", "c", "b", "a"])})
+    m = StringIndexer(inputCol="c", outputCol="ci").fit(df)
+    assert m.labels == ["b", "a", "c"]  # freq 3, 2, 1
+    out = m.transform(df)
+    np.testing.assert_array_equal(out["ci"], [0, 1, 0, 2, 0, 1])
+    assert out["ci"].dtype == np.int64
+
+
+def test_indexer_tie_breaks_alphabetically():
+    df = ColumnarFrame({"c": np.array(["z", "a", "z", "a"])})
+    m = StringIndexer(inputCol="c", outputCol="ci").fit(df)
+    assert m.labels == ["a", "z"]
+
+
+@pytest.mark.parametrize("order,expected", [
+    ("frequencyAsc", ["c", "a", "b"]),
+    ("alphabetAsc", ["a", "b", "c"]),
+    ("alphabetDesc", ["c", "b", "a"]),
+])
+def test_indexer_order_types(order, expected):
+    df = ColumnarFrame({"c": np.array(["b", "a", "b", "c", "b", "a"])})
+    m = StringIndexer(inputCol="c", outputCol="ci",
+                      stringOrderType=order).fit(df)
+    assert m.labels == expected
+
+
+def test_indexer_handle_invalid_error():
+    train = ColumnarFrame({"c": np.array(["a", "b"])})
+    test = ColumnarFrame({"c": np.array(["a", "zzz"])})
+    m = StringIndexer(inputCol="c", outputCol="ci").fit(train)
+    with pytest.raises(ValueError, match="unseen.*zzz"):
+        m.transform(test)
+
+
+def test_indexer_handle_invalid_skip_and_keep():
+    train = ColumnarFrame({"c": np.array(["a", "b", "a"])})
+    test = ColumnarFrame({"c": np.array(["a", "zzz", "b"]),
+                          "x": np.arange(3)})
+    m = StringIndexer(inputCol="c", outputCol="ci",
+                      handleInvalid="skip").fit(train)
+    out = m.transform(test)
+    assert len(out) == 2
+    np.testing.assert_array_equal(out["x"], [0, 2])  # row 1 dropped
+    out = m.setHandleInvalid("keep").transform(test)
+    np.testing.assert_array_equal(out["ci"], [0, len(m.labels), 1])
+
+
+def test_indexer_rejects_bad_policy_and_order():
+    with pytest.raises(ValueError, match="handleInvalid"):
+        StringIndexer(inputCol="c", outputCol="ci", handleInvalid="drop")
+    with pytest.raises(ValueError, match="stringOrderType"):
+        StringIndexer(inputCol="c", outputCol="ci",
+                      stringOrderType="random")
+
+
+def test_indexer_numeric_column_indexes_by_string_form():
+    # pyspark casts non-string columns to string before indexing
+    df = ColumnarFrame({"c": np.array([10, 2, 10, 3])})
+    m = StringIndexer(inputCol="c", outputCol="ci").fit(df)
+    assert m.labels == ["10", "2", "3"]
+
+
+def test_indexer_model_roundtrip(tmp_path):
+    df = ColumnarFrame({"c": np.array(["b", "a", "b"])})
+    m = StringIndexer(inputCol="c", outputCol="ci",
+                      handleInvalid="keep").fit(df)
+    p = str(tmp_path / "idx")
+    m.save(p)
+    m2 = StringIndexerModel.load(p)
+    assert m2.labels == m.labels
+    np.testing.assert_array_equal(m2.transform(df)["ci"],
+                                  m.transform(df)["ci"])
+    assert m2.getOrDefault(m2.getParam("handleInvalid")) == "keep"
+
+
+def test_index_to_string_inverse():
+    df = ColumnarFrame({"c": np.array(["b", "a", "c", "b"])})
+    m = StringIndexer(inputCol="c", outputCol="ci").fit(df)
+    out = m.transform(df)
+    inv = IndexToString(inputCol="ci", outputCol="back",
+                        labels=m.labels).transform(out)
+    np.testing.assert_array_equal(inv["back"], df["c"])
+
+
+def test_index_to_string_bounds_check():
+    t = IndexToString(inputCol="i", outputCol="s", labels=["a", "b"])
+    with pytest.raises(ValueError, match="out of range"):
+        t.transform(ColumnarFrame({"i": np.array([0, 5])}))
+
+
+def test_index_to_string_roundtrip(tmp_path):
+    t = IndexToString(inputCol="i", outputCol="s", labels=["a", "b"])
+    p = str(tmp_path / "i2s")
+    t.save(p)
+    t2 = IndexToString.load(p)
+    assert t2.labels == ["a", "b"]
+    out = t2.transform(ColumnarFrame({"i": np.array([1, 0])}))
+    np.testing.assert_array_equal(out["s"], ["b", "a"])
+
+
+def test_indexer_model_rejects_bad_policy_everywhere():
+    with pytest.raises(ValueError, match="handleInvalid"):
+        StringIndexerModel(labels=["a"], handleInvalid="drop")
+    with pytest.raises(ValueError, match="handleInvalid"):
+        StringIndexerModel.from_labels(["a"], handleInvalid="eror")
+
+
+def test_pipeline_fit_skips_transform_after_last_estimator(rng):
+    """A stage after the last estimator must not be driven during fit —
+    in particular the fitted model must not score the training set."""
+    calls = []
+
+    class SpyTransformer:
+        def transform(self, df):
+            calls.append(len(df))
+            return df
+
+    df = _string_ratings(rng, n_users=20, n_items=12)
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCol="userName", outputCol="user"),
+        StringIndexer(inputCol="itemName", outputCol="item"),
+        ALS(userCol="user", itemCol="item", ratingCol="rating",
+            rank=3, maxIter=2, regParam=0.005, seed=1),
+        SpyTransformer(),
+    ])
+    pipe.fit(df)
+    assert calls == []  # ALSModel.transform + spy both skipped in fit
+
+
+def test_pipeline_save_rejects_foreign_stage(tmp_path):
+    class Foreign:
+        def transform(self, df):
+            return df
+
+        def _save_to(self, path):
+            pass
+
+    pipe = Pipeline(stages=[Foreign()])
+    with pytest.raises(ValueError, match="outside tpu_als"):
+        pipe.save(str(tmp_path / "f"))
+
+
+# -- Pipeline --------------------------------------------------------------
+
+def test_pipeline_string_ids_through_als(rng, tmp_path):
+    """The canonical reference pipeline: index both id columns, fit ALS
+    on the indices, predict on raw string ids end-to-end."""
+    df = _string_ratings(rng)
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCol="userName", outputCol="user",
+                      handleInvalid="skip"),
+        StringIndexer(inputCol="itemName", outputCol="item",
+                      handleInvalid="skip"),
+        ALS(userCol="user", itemCol="item", ratingCol="rating",
+            rank=4, maxIter=6, regParam=0.005, seed=7),
+    ])
+    model = pipe.fit(df)
+    assert isinstance(model, PipelineModel)
+    out = model.transform(df)
+    pred = out["prediction"]
+    assert np.all(np.isfinite(pred))
+    rmse = float(np.sqrt(np.mean((pred - df["rating"]) ** 2)))
+    assert rmse < float(np.std(df["rating"]))  # beats trivial predictor
+
+    # the fitted ALSModel is reachable for the recommend surface
+    als_model = model.stages[-1]
+    recs = als_model.recommendForAllUsers(3)
+    assert len(recs) > 0
+
+    # round-trip the whole fitted pipeline
+    p = str(tmp_path / "pipe_model")
+    model.save(p)
+    loaded = PipelineModel.load(p)
+    out2 = loaded.transform(df)
+    np.testing.assert_allclose(out2["prediction"], pred, rtol=1e-6)
+
+
+def test_pipeline_transformer_only_and_order():
+    df = ColumnarFrame({"c": np.array(["b", "a", "b"])})
+    idx_model = StringIndexer(inputCol="c", outputCol="ci").fit(df)
+    pipe = Pipeline(stages=[
+        idx_model,  # already-fitted transformer mixes with estimators
+        IndexToString(inputCol="ci", outputCol="back",
+                      labels=idx_model.labels),
+    ])
+    out = pipe.fit(df).transform(df)
+    np.testing.assert_array_equal(out["back"], df["c"])
+
+
+def test_pipeline_rejects_non_stage():
+    with pytest.raises(TypeError, match="neither an estimator"):
+        Pipeline(stages=[object()])
+
+
+def test_unfitted_pipeline_roundtrip(tmp_path):
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCol="userName", outputCol="user"),
+        ALS(userCol="user", itemCol="item", rank=3, maxIter=2),
+    ])
+    p = str(tmp_path / "pipe")
+    pipe.save(p)
+    loaded = Pipeline.load(p)
+    stages = loaded.getStages()
+    assert isinstance(stages[0], StringIndexer)
+    assert isinstance(stages[1], ALS)
+    assert stages[1].getRank() == 3
+    assert stages[0].getOrDefault(
+        stages[0].getParam("outputCol")) == "user"
+
+
+def test_pipeline_copy_routes_grid_params(rng):
+    df = _string_ratings(rng, n_users=20, n_items=12)
+    als = ALS(userCol="user", itemCol="item", ratingCol="rating",
+              rank=3, maxIter=3, regParam=0.005, seed=1)
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCol="userName", outputCol="user"),
+        StringIndexer(inputCol="itemName", outputCol="item"),
+        als,
+    ])
+    c = pipe.copy({als.rank: 5})
+    assert c.getStages()[2].getRank() == 5
+    assert pipe.getStages()[2].getRank() == 3  # original untouched
+
+    # instance identity wins over class+name: each indexer's own param
+    # drives only that stage, even though both stages share the class
+    user_idx, item_idx = pipe.getStages()[0], pipe.getStages()[1]
+    c2 = pipe.copy({user_idx.getParam("inputCol"): "renamed"})
+    assert c2.getStages()[0].getOrDefault(
+        c2.getStages()[0].getParam("inputCol")) == "renamed"
+    assert c2.getStages()[1].getOrDefault(
+        c2.getStages()[1].getParam("inputCol")) == "itemName"  # untouched
+
+    # a DETACHED same-class param cannot pick between the two indexer
+    # stages — refusing beats silently configuring both
+    other = StringIndexer(inputCol="zz", outputCol="qq")
+    with pytest.raises(ValueError, match="ambiguous"):
+        pipe.copy({other.getParam("inputCol"): "nope"})
+    with pytest.raises(ValueError, match="matches no pipeline stage"):
+        ev = RegressionEvaluator()
+        pipe.copy({ev.getParam("metricName"): "mae"})
+
+
+def test_crossvalidator_over_pipeline(rng):
+    """CrossValidator(estimator=Pipeline) — the reference tuning idiom."""
+    df = _string_ratings(rng, n_users=24, n_items=16, density=0.7)
+    als = ALS(userCol="user", itemCol="item", ratingCol="rating",
+              rank=3, maxIter=4, regParam=0.005, seed=3,
+              coldStartStrategy="drop")
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCol="userName", outputCol="user",
+                      handleInvalid="skip"),
+        StringIndexer(inputCol="itemName", outputCol="item",
+                      handleInvalid="skip"),
+        als,
+    ])
+    grid = ParamGridBuilder().addGrid(als.regParam, [0.005, 0.05]).build()
+    cv = CrossValidator(estimator=pipe, estimatorParamMaps=grid,
+                        evaluator=RegressionEvaluator(
+                            metricName="rmse", labelCol="rating"),
+                        numFolds=2, seed=11)
+    cvm = cv.fit(df)
+    assert len(cvm.avgMetrics) == 2
+    assert np.all(np.isfinite(cvm.avgMetrics))
+    out = cvm.transform(df)
+    assert np.all(np.isfinite(out["prediction"]))

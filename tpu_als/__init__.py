@@ -25,6 +25,13 @@ Package map (SURVEY.md §7):
 __version__ = "0.1.0"
 
 from tpu_als.api.estimator import ALS, ALSModel  # noqa: F401
+from tpu_als.api.pipeline import (  # noqa: F401
+    IndexToString,
+    Pipeline,
+    PipelineModel,
+    StringIndexer,
+    StringIndexerModel,
+)
 from tpu_als.api.evaluation import (  # noqa: F401
     RankingEvaluator,
     RankingMetrics,

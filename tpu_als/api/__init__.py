@@ -5,6 +5,13 @@ from tpu_als.api.evaluation import (  # noqa: F401
     RegressionEvaluator,
 )
 from tpu_als.api.params import Param, Params, TypeConverters  # noqa: F401
+from tpu_als.api.pipeline import (  # noqa: F401
+    IndexToString,
+    Pipeline,
+    PipelineModel,
+    StringIndexer,
+    StringIndexerModel,
+)
 from tpu_als.api.tuning import (  # noqa: F401
     CrossValidator,
     CrossValidatorModel,

@@ -1,6 +1,7 @@
 """Streaming fold-in driver + MovieLens IO tests."""
 
 import numpy as np
+import pytest
 
 from tpu_als import ALS, ColumnarFrame
 from tpu_als.io.movielens import (
@@ -204,6 +205,28 @@ def test_movielens_loaders(tmp_path):
     # trainable end-to-end
     model = ALS(rank=2, maxIter=2).fit(f)
     assert model.rank == 2
+
+
+def test_movielens_dat_loader(tmp_path):
+    from tpu_als.io.movielens import load_movielens_dat
+
+    # ml-1m/ml-10m format: '::' separated, no header, half-star ratings
+    dat = tmp_path / "ratings.dat"
+    dat.write_text("1::1193::5::978300760\n2::661::3.5::978302109\n\n")
+    f = load_movielens_dat(str(tmp_path))  # directory form resolves
+    assert f["user"].tolist() == [1, 2]
+    assert f["item"].tolist() == [1193, 661]
+    assert f["rating"].tolist() == [5.0, 3.5]
+    assert f["timestamp"].tolist() == [978300760, 978302109]
+    assert f["user"].dtype == np.int64 and f["rating"].dtype == np.float32
+
+    bad = tmp_path / "bad.dat"
+    bad.write_text("1::2::3\n")  # missing timestamp field
+    with pytest.raises(ValueError, match="malformed ratings line"):
+        load_movielens_dat(str(bad))
+    bad.write_text("1::2::xx::9\n")  # non-numeric rating
+    with pytest.raises(ValueError, match="malformed ratings line"):
+        load_movielens_dat(str(bad))
 
 
 def test_fastcsv_native_parser(tmp_path):

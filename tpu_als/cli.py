@@ -6,6 +6,7 @@ that surface for the TPU framework:
     python -m tpu_als.cli train --data ml-100k:/path/u.data --rank 16 \\
         --max-iter 10 --output /tmp/model
     python -m tpu_als.cli train --data synthetic:10000x2000x500000 ...
+    (data specs: ml-100k:PATH | csv:PATH | dat:PATH | synthetic:UxIxN)
     python -m tpu_als.cli evaluate --model /tmp/model --data ...
     python -m tpu_als.cli recommend --model /tmp/model --users 1,2,3 --k 10
     python -m tpu_als.cli foldin-bench --model /tmp/model
@@ -25,6 +26,7 @@ def _load_data(spec):
     from tpu_als.io.movielens import (
         load_movielens_100k,
         load_movielens_csv,
+        load_movielens_dat,
         synthetic_movielens,
     )
 
@@ -33,11 +35,14 @@ def _load_data(spec):
         return load_movielens_100k(arg)
     if kind == "csv":
         return load_movielens_csv(arg)
+    if kind == "dat":
+        return load_movielens_dat(arg)
     if kind == "synthetic":
         nu, ni, nnz = (int(x) for x in arg.split("x"))
         return synthetic_movielens(nu, ni, nnz)
     raise SystemExit(f"unknown data spec {spec!r} "
-                     "(use ml-100k:PATH | csv:PATH | synthetic:UxIxN)")
+                     "(use ml-100k:PATH | csv:PATH | dat:PATH (ml-1m/10m "
+                     "ratings.dat) | synthetic:UxIxN)")
 
 
 def cmd_train(args):

@@ -1,7 +1,8 @@
 """MovieLens loaders + a scale-faithful synthetic generator.
 
 Covers the reference app's data-ingest step (SURVEY.md §2.A1): ml-100k
-``u.data`` (tab-separated user/item/rating/ts) and ml-latest/ml-25m
+``u.data`` (tab-separated user/item/rating/ts), ml-1m/ml-10m
+``ratings.dat`` (``'::'``-separated), and ml-latest/ml-25m
 ``ratings.csv`` (header ``userId,movieId,rating,timestamp``).  Since this
 environment has no network, :func:`synthetic_movielens` generates
 MovieLens-shaped data (power-law user/item degrees, 0.5–5.0 star ratings on
@@ -33,6 +34,32 @@ def load_movielens_100k(path):
         "item": raw[:, 1],
         "rating": raw[:, 2].astype(np.float32),
         "timestamp": raw[:, 3],
+    })
+
+
+def load_movielens_dat(path):
+    """Read ml-1m / ml-10m ``ratings.dat`` (or a directory containing it):
+    ``UserID::MovieID::Rating::Timestamp``, no header; ml-10m ratings come
+    in half-star steps, so the rating column is parsed as float.
+
+    Vectorized: splitting ``a::b::c::d`` on single ``':'`` yields empty
+    fields at odd positions, so ``usecols=(0, 2, 4, 6)`` reads the ``'::'``
+    format exactly (the fields are bare numbers — no quoting or escapes in
+    this format) and the 10M-row ml-10m file stays in numpy end-to-end
+    instead of boxing 40M Python objects."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "ratings.dat")
+    try:
+        raw = np.loadtxt(path, dtype=np.float64, delimiter=":",
+                         usecols=(0, 2, 4, 6), ndmin=2)
+    except (ValueError, IndexError) as e:
+        raise ValueError(
+            f"{path}: malformed ratings line ({e})") from None
+    return ColumnarFrame({
+        "user": raw[:, 0].astype(np.int64),
+        "item": raw[:, 1].astype(np.int64),
+        "rating": raw[:, 2].astype(np.float32),
+        "timestamp": raw[:, 3].astype(np.int64),
     })
 
 

@@ -1,0 +1,92 @@
+"""Sharded serving (parallel/serve.py): both strategies must agree with
+the single-device chunked top-k — the serving analog of the trainer's
+sharded == single-device equivalence tests (SURVEY.md §4.4)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_als.ops.topk import chunked_topk_scores
+from tpu_als.parallel.mesh import make_mesh
+from tpu_als.parallel.serve import topk_sharded
+
+
+def _factors(rng, nu, ni, r):
+    # continuous values: score ties (which strategies may break
+    # differently) have probability ~0
+    U = rng.normal(size=(nu, r)).astype(np.float32)
+    V = rng.normal(size=(ni, r)).astype(np.float32)
+    return U, V
+
+
+def _reference(U, V, valid, k):
+    return chunked_topk_scores(jnp.asarray(U), jnp.asarray(V),
+                               jnp.asarray(valid), k=k)
+
+
+@pytest.mark.parametrize("strategy", ["all_gather", "ring"])
+def test_matches_single_device(rng, strategy):
+    U, V = _factors(rng, 41, 97, 8)  # neither divisible by 8 devices
+    valid = np.ones(97, bool)
+    k = 10
+    ref_s, ref_i = _reference(U, V, valid, k)
+    s, ix = topk_sharded(U, V, k, make_mesh(8), strategy=strategy)
+    np.testing.assert_allclose(s, np.asarray(ref_s), rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(ix, np.asarray(ref_i))
+
+
+@pytest.mark.parametrize("strategy", ["all_gather", "ring"])
+def test_k_larger_than_shard(rng, strategy):
+    # 8 devices x 2 items/shard: k=5 exceeds every shard's local k
+    U, V = _factors(rng, 12, 16, 4)
+    k = 5
+    ref_s, ref_i = _reference(U, V, np.ones(16, bool), k)
+    s, ix = topk_sharded(U, V, k, make_mesh(8), strategy=strategy)
+    np.testing.assert_allclose(s, np.asarray(ref_s), rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(ix, np.asarray(ref_i))
+
+
+@pytest.mark.parametrize("strategy", ["all_gather", "ring"])
+def test_item_valid_mask(rng, strategy):
+    U, V = _factors(rng, 9, 40, 4)
+    valid = rng.random(40) < 0.5
+    k = 3
+    ref_s, ref_i = _reference(U, V, valid, k)
+    s, ix = topk_sharded(U, V, k, make_mesh(8), strategy=strategy,
+                         item_valid=valid)
+    np.testing.assert_allclose(s, np.asarray(ref_s), rtol=1e-5, atol=1e-6)
+    # every selected index must be a valid item
+    assert valid[ix].all()
+
+
+def test_k_capped_at_catalog(rng):
+    U, V = _factors(rng, 5, 6, 4)
+    s, ix = topk_sharded(U, V, 50, make_mesh(8))
+    assert s.shape == (5, 6) and ix.shape == (5, 6)
+    # every real item appears exactly once per row
+    assert np.array_equal(np.sort(ix, axis=1),
+                          np.broadcast_to(np.arange(6), (5, 6)))
+
+
+def test_unknown_strategy_rejected(rng):
+    U, V = _factors(rng, 4, 4, 2)
+    with pytest.raises(ValueError, match="unknown serving strategy"):
+        topk_sharded(U, V, 2, make_mesh(8), strategy="broadcast")
+
+
+def test_recommend_arrays_mesh_equivalence(rng):
+    """ALSModel.recommend_arrays(mesh=...) == the single-device path."""
+    from tests.conftest import make_ratings
+    from tpu_als import ALS, ColumnarFrame
+
+    u, i, r, _, _ = make_ratings(rng, 30, 20, 4, density=0.5)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    model = ALS(rank=4, maxIter=3, regParam=0.005, seed=0).fit(frame)
+    ids0, rec0, sc0 = model.recommend_arrays(5)
+    for strategy in ("all_gather", "ring"):
+        ids1, rec1, sc1 = model.recommend_arrays(
+            5, mesh=make_mesh(8), gatherStrategy=strategy)
+        np.testing.assert_array_equal(ids0, ids1)
+        np.testing.assert_allclose(sc0, sc1, rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(rec0, rec1)

@@ -697,18 +697,33 @@ class ALSModel:
         key_col = self._get("userCol") if users else self._get("itemCol")
         return ColumnarFrame({key_col: q_ids, "recommendations": recs})
 
-    def recommend_arrays(self, numItems, for_users=True):
+    def recommend_arrays(self, numItems, for_users=True, mesh=None,
+                         gatherStrategy="all_gather"):
         """Dense variant of recommendForAll*: (query_ids, ids [n,k],
-        scores [n,k]) as plain arrays — the TPU-friendly serving surface."""
+        scores [n,k]) as plain arrays — the TPU-friendly serving surface.
+
+        ``mesh``: serve sharded over a ``jax.sharding.Mesh`` — query rows
+        sharded across devices, and the opposite factor table either
+        gathered (``gatherStrategy='all_gather'``) or ppermute-streamed
+        (``'ring'``, for catalogs that don't fit one device's HBM) —
+        the serving analog of the trainer's strategies
+        (``parallel/serve.py``).
+        """
         frame_ids = self._user_map.ids if for_users else self._item_map.ids
         Q = self._U if for_users else self._V
         other = self._V if for_users else self._U
         other_ids = self._item_map.ids if for_users else self._user_map.ids
         k = min(numItems, other.shape[0])
-        sc, ix = topk_scores(
-            jnp.asarray(Q), jnp.asarray(other),
-            jnp.ones(other.shape[0], bool), k=k,
-        )
+        if mesh is not None:
+            from tpu_als.parallel.serve import topk_sharded
+
+            sc, ix = topk_sharded(Q, other, k, mesh,
+                                  strategy=gatherStrategy)
+        else:
+            sc, ix = topk_scores(
+                jnp.asarray(Q), jnp.asarray(other),
+                jnp.ones(other.shape[0], bool), k=k,
+            )
         return frame_ids, other_ids[np.asarray(ix)], np.asarray(sc)
 
     # -- persistence ----------------------------------------------------

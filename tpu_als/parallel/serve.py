@@ -1,0 +1,144 @@
+"""Sharded top-k serving: ``recommendForAll*`` over a device mesh.
+
+The reference serves recommendations with the same machinery it trains
+with — blockified factor RDDs, cross-join GEMMs, and a shuffle-merged
+``BoundedPriorityQueue`` per user (``MatrixFactorizationModel.
+recommendProductsForUsers`` / ``ALSModel.recommendForAllUsers``,
+SURVEY.md §3.3).  At config-3 scale (SURVEY.md §6: ~48M items × rank 256)
+the opposite factor table no longer fits one device for SERVING any more
+than it does for training, so this module gives the serving path the same
+two scale-out strategies the trainer has (``parallel/trainer.py``):
+
+- ``all_gather``: query rows stay sharded; each device gathers the full
+  item table once and runs the single-device chunked GEMM + running
+  ``lax.top_k`` scan (``ops/topk.py``).  One collective, full-table HBM.
+- ``ring``: the item-factor shards stream around the mesh via
+  ``ppermute`` (the training ring's dataflow re-used for serving); each
+  device folds one shard's local top-k into its running (scores, ids)
+  per step.  The full table never materializes — peak HBM is two shards
+  + the [n, k] running state, and the cross-device traffic is the item
+  table once around the ring plus nothing else (the [n, 2k] merge is
+  local).
+
+Tie-breaking note: with equal scores the selected index can differ
+between strategies (merge order is shard-rotation order, which differs
+per device); scores are always identical.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from tpu_als.ops.topk import NEG_INF, chunked_topk_scores
+from tpu_als.parallel.mesh import AXIS
+
+shard_map = jax.shard_map
+
+STRATEGIES = ("all_gather", "ring")
+
+
+def _merge_topk(s1, i1, s2, i2, k):
+    """Fold (s2, i2) into the running (s1, i1): one [n, k1+k2] top_k."""
+    cat_s = jnp.concatenate([s1, s2], axis=1)
+    cat_i = jnp.concatenate([i1, i2], axis=1)
+    new_s, sel = jax.lax.top_k(cat_s, k)
+    return new_s, jnp.take_along_axis(cat_i, sel, axis=1)
+
+
+@functools.lru_cache(maxsize=32)
+def _build(mesh, ni_loc, k, k_loc, strategy, item_chunk):
+    """Compiled sharded top-k for one (mesh, shapes, k, strategy) tuple.
+
+    ``jax.sharding.Mesh`` is hashable, so the cache key is exact; without
+    the cache every serving call would rebuild the shard_map closure and
+    recompile.
+    """
+    D = mesh.devices.size
+
+    def body_all_gather(U_loc, V_loc, valid_loc):
+        V_full = jax.lax.all_gather(V_loc, AXIS, axis=0, tiled=True)
+        valid_full = jax.lax.all_gather(valid_loc, AXIS, axis=0,
+                                        tiled=True)
+        return chunked_topk_scores(U_loc, V_full, valid_full, k,
+                                   item_chunk=item_chunk)
+
+    def body_ring(U_loc, V_loc, valid_loc):
+        me = jax.lax.axis_index(AXIS)
+        perm = [(i, (i + 1) % D) for i in range(D)]
+        n = U_loc.shape[0]
+
+        def step(t, carry):
+            V_cur, valid_cur, s, ix = carry
+            # device i starts with its own shard and receives from i-1:
+            # after t permutes it holds shard (i - t) mod D
+            owner = jax.lax.rem(me - t + D, D)
+            sc_t, ix_t = chunked_topk_scores(U_loc, V_cur, valid_cur,
+                                             k_loc,
+                                             item_chunk=item_chunk)
+            s, ix = _merge_topk(s, ix, sc_t,
+                                owner.astype(jnp.int32) * ni_loc + ix_t,
+                                k)
+            return (jax.lax.ppermute(V_cur, AXIS, perm),
+                    jax.lax.ppermute(valid_cur, AXIS, perm), s, ix)
+
+        s0 = jnp.full((n, k), NEG_INF, dtype=jnp.float32)
+        i0 = jnp.zeros((n, k), dtype=jnp.int32)
+        _, _, s, ix = jax.lax.fori_loop(
+            0, D, step, (V_loc, valid_loc, s0, i0))
+        return s, ix
+
+    body = body_all_gather if strategy == "all_gather" else body_ring
+    return jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS)),
+        check_vma=False,
+    ))
+
+
+def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
+                 item_chunk=8192):
+    """Top-k over a mesh: ``U`` rows sharded as queries, ``V`` rows
+    sharded as the catalog.  Returns host ``(scores [Nu, k'], indices
+    [Nu, k'])`` with ``k' = min(k, len(V))``, identical (up to
+    tie-breaking) to ``chunked_topk_scores(U, V, valid, k')`` on one
+    device.
+    """
+    if strategy not in STRATEGIES:
+        raise ValueError(f"unknown serving strategy {strategy!r} "
+                         f"(expected one of {STRATEGIES})")
+    U = np.asarray(U, dtype=np.float32)
+    V = np.asarray(V, dtype=np.float32)
+    Nu, r = U.shape
+    Ni = V.shape[0]
+    if Ni == 0 or Nu == 0:
+        kk = min(k, Ni)
+        return (np.zeros((Nu, kk), np.float32),
+                np.zeros((Nu, kk), np.int32))
+    valid = (np.ones(Ni, dtype=bool) if item_valid is None
+             else np.asarray(item_valid, dtype=bool))
+    D = mesh.devices.size
+    k_eff = min(k, Ni)
+    nu_loc = -(-Nu // D)
+    ni_loc = -(-Ni // D)
+    Up = np.pad(U, ((0, D * nu_loc - Nu), (0, 0)))
+    Vp = np.pad(V, ((0, D * ni_loc - Ni), (0, 0)))
+    validp = np.pad(valid, (0, D * ni_loc - Ni))  # pad rows never win
+    k_loc = min(k_eff, ni_loc)
+    f = _build(mesh, ni_loc, k_eff, k_loc, strategy,
+               min(item_chunk, ni_loc if strategy == "ring"
+                   else D * ni_loc))
+    # place shard-wise (NOT jnp.asarray, which would commit the FULL
+    # padded catalog to one device before resharding — the exact OOM the
+    # ring strategy exists to avoid at 48M-item scale)
+    from tpu_als.parallel.mesh import shard_leading
+
+    spec = shard_leading(mesh)
+    s, ix = f(jax.device_put(Up, spec), jax.device_put(Vp, spec),
+              jax.device_put(validp, spec))
+    return np.asarray(s)[:Nu], np.asarray(ix)[:Nu]

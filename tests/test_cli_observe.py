@@ -265,3 +265,123 @@ def test_cli_tt_train_empty_holdout_emits_valid_json(capsys):
     assert "NaN" not in raw
     assert line["filtered_recall_at_10"] is None
     assert line["test_pairs"] == 0
+
+
+def test_cli_recommend_titles_and_sharded(tmp_path, capsys):
+    """--titles joins movie metadata into the output; --devices serves
+    the all-users path through the sharded top-k (parallel/serve.py)."""
+    model_dir = str(tmp_path / "m")
+    cli_main(["train", "--data", "synthetic:120x50x3000", "--rank", "4",
+              "--max-iter", "3", "--reg-param", "0.01",
+              "--output", model_dir])
+    capsys.readouterr()
+
+    movies = tmp_path / "movies.csv"
+    rows = ["movieId,title,genres"] + [
+        f'{i},"Movie {i}, The ({1990 + i % 30})",Drama' for i in range(50)]
+    movies.write_text("\n".join(rows) + "\n")
+
+    cli_main(["recommend", "--model", model_dir, "--limit", "3",
+              "--k", "4", "--titles", str(movies)])
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 3
+    for ln in lines:
+        assert len(ln["titles"]) == 4
+        for (i, _), t in zip(ln["items"], ln["titles"]):
+            assert t == f"Movie {i}, The ({1990 + i % 30})"
+
+    # sharded serving must produce the same scores as single-device
+    cli_main(["recommend", "--model", model_dir, "--limit", "3",
+              "--k", "4"])
+    single = [json.loads(x) for x in
+              capsys.readouterr().out.strip().splitlines()]
+    for strategy in ("all_gather", "ring"):
+        cli_main(["recommend", "--model", model_dir, "--limit", "3",
+                  "--k", "4", "--devices", "0",
+                  "--gather-strategy", strategy, "--titles", str(movies)])
+        sharded = [json.loads(x) for x in
+                   capsys.readouterr().out.strip().splitlines()]
+        assert len(sharded) == 3
+        for a, b in zip(single, sharded):
+            assert a["user"] == b["user"]
+            sa = [s for _, s in a["items"]]
+            sb = [s for _, s in b["items"]]
+            np.testing.assert_allclose(sa, sb, rtol=1e-4, atol=1e-4)
+            assert len(b["titles"]) == 4
+
+
+def test_movies_metadata_formats(tmp_path):
+    from tpu_als.io.movielens import load_movielens_movies
+
+    (tmp_path / "u.item").write_text(
+        "1|Toy Story (1995)|01-Jan-1995||http://x\n"
+        "2|GoldenEye (1995)|01-Jan-1995||http://y\n", encoding="latin-1")
+    f = load_movielens_movies(str(tmp_path / "u.item"))
+    assert f["item"].tolist() == [1, 2]
+    assert f["title"][0] == "Toy Story (1995)"
+
+    (tmp_path / "movies.dat").write_text(
+        "1::Toy Story (1995)::Animation\n2::Jumanji (1995)::Adventure\n",
+        encoding="latin-1")
+    f = load_movielens_movies(str(tmp_path / "movies.dat"))
+    assert f["title"].tolist() == ["Toy Story (1995)", "Jumanji (1995)"]
+
+    (tmp_path / "movies.csv").write_text(
+        'movieId,title,genres\n1,"American President, The (1995)",Drama\n')
+    f = load_movielens_movies(str(tmp_path / "movies.csv"))
+    assert f["title"][0] == "American President, The (1995)"
+    # directory form prefers movies.csv
+    f2 = load_movielens_movies(str(tmp_path))
+    assert f2["title"][0] == "American President, The (1995)"
+
+
+def test_cli_recommend_users_with_devices_routes_sharded(tmp_path, capsys):
+    """--users + --devices must serve the subset through the mesh (the
+    catalog side is what outgrows one device), not silently ignore the
+    sharding flags (advisor-style r4 finding)."""
+    model_dir = str(tmp_path / "m")
+    cli_main(["train", "--data", "synthetic:100x40x2500", "--rank", "4",
+              "--max-iter", "2", "--reg-param", "0.01",
+              "--output", model_dir])
+    capsys.readouterr()
+    cli_main(["recommend", "--model", model_dir, "--k", "3"])
+    allu = {json.loads(x)["user"]: json.loads(x)["items"]
+            for x in capsys.readouterr().out.strip().splitlines()}
+    some = list(allu)[:2]
+    cli_main(["recommend", "--model", model_dir, "--k", "3",
+              "--users", ",".join(str(u) for u in some),
+              "--devices", "0", "--gather-strategy", "ring"])
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    assert {ln["user"] for ln in lines} == set(some)
+    for ln in lines:
+        np.testing.assert_allclose([s for _, s in ln["items"]],
+                                   [s for _, s in allu[ln["user"]]],
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_cli_recommend_negative_devices_rejected(tmp_path, capsys):
+    import pytest
+
+    model_dir = str(tmp_path / "m")
+    cli_main(["train", "--data", "synthetic:60x30x1200", "--rank", "3",
+              "--max-iter", "1", "--output", model_dir])
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="--devices must be >= 0"):
+        cli_main(["recommend", "--model", model_dir, "--devices", "-8"])
+
+
+def test_movies_dat_utf8_titles(tmp_path):
+    from tpu_als.io.movielens import load_movielens_movies
+
+    # ml-10m style UTF-8 content must NOT be mojibaked by a latin-1 read
+    (tmp_path / "movies.dat").write_bytes(
+        "1::Les Misérables (1995)::Drama\n".encode("utf-8"))
+    f = load_movielens_movies(str(tmp_path / "movies.dat"))
+    assert f["title"][0] == "Les Misérables (1995)"
+    # ml-1m style latin-1 still reads via the fallback
+    (tmp_path / "movies.dat").write_bytes(
+        "1::Am\xe9lie (2001)::Comedy\n".encode("latin-1"))
+    f = load_movielens_movies(str(tmp_path / "movies.dat"))
+    assert f["title"][0] == "Amélie (2001)"

@@ -630,32 +630,48 @@ class ALSModel:
         return float(out[self._get("predictionCol")][0]) if len(out) else float("nan")
 
     # -- top-k recommendation ------------------------------------------
-    def recommendForAllUsers(self, numItems):
+    # mesh/gatherStrategy are keyword-only additions on top of the
+    # reference signatures: serve sharded over a jax.sharding.Mesh
+    # (parallel/serve.py — catalog gathered or ring-streamed); the
+    # default path is unchanged
+    def recommendForAllUsers(self, numItems, *, mesh=None,
+                             gatherStrategy="all_gather"):
         return self._recommend(self._U, self._user_map.ids, numItems,
-                               users=True)
+                               users=True, mesh=mesh,
+                               gatherStrategy=gatherStrategy)
 
-    def recommendForAllItems(self, numUsers):
+    def recommendForAllItems(self, numUsers, *, mesh=None,
+                             gatherStrategy="all_gather"):
         return self._recommend(self._V, self._item_map.ids, numUsers,
-                               users=False)
+                               users=False, mesh=mesh,
+                               gatherStrategy=gatherStrategy)
 
-    def recommendForUserSubset(self, dataset, numItems):
+    def recommendForUserSubset(self, dataset, numItems, *, mesh=None,
+                               gatherStrategy="all_gather"):
         ids = np.unique(as_frame(dataset)[self._get("userCol")])
         dense = self._user_map.to_dense(ids)
         keep = dense >= 0
         return self._recommend(self._U[dense[keep]], ids[keep], numItems,
-                               users=True)
+                               users=True, mesh=mesh,
+                               gatherStrategy=gatherStrategy)
 
-    def recommendForItemSubset(self, dataset, numUsers):
+    def recommendForItemSubset(self, dataset, numUsers, *, mesh=None,
+                               gatherStrategy="all_gather"):
         ids = np.unique(as_frame(dataset)[self._get("itemCol")])
         dense = self._item_map.to_dense(ids)
         keep = dense >= 0
         return self._recommend(self._V[dense[keep]], ids[keep], numUsers,
-                               users=False)
+                               users=False, mesh=mesh,
+                               gatherStrategy=gatherStrategy)
 
-    def _recommend(self, Q, q_ids, k, users):
+    def _recommend(self, Q, q_ids, k, users, mesh=None,
+                   gatherStrategy="all_gather"):
         """Blocked top-k: stream `blockSize` query rows at a time through the
         chunked GEMM+top_k kernel (the reference's blockify+crossJoin+queue
-        path collapsed into one jitted scan — SURVEY.md §3.3)."""
+        path collapsed into one jitted scan — SURVEY.md §3.3).  With
+        ``mesh``, the whole call runs sharded instead
+        (parallel/serve.py): queries sharded over devices, catalog
+        gathered or ring-streamed per ``gatherStrategy``."""
         other = self._V if users else self._U
         other_ids = self._item_map.ids if users else self._user_map.ids
         other_col = self._get("itemCol") if users else self._get("userCol")
@@ -670,18 +686,26 @@ class ALSModel:
                 "recommendations struct (reference schema); rename the "
                 "column before calling recommendFor*")
         k = min(k, other.shape[0])
-        block = max(1, int(self._get("blockSize")))
-        valid = jnp.ones(other.shape[0], dtype=bool)
-        other_j = jnp.asarray(other)
-        ids_out = np.empty((Q.shape[0], k), dtype=other_ids.dtype)
-        scores_out = np.empty((Q.shape[0], k), dtype=np.float32)
-        for s in range(0, Q.shape[0], block):
-            sc, ix = topk_scores(
-                jnp.asarray(Q[s:s + block]), other_j, valid, k=k,
-                item_chunk=block,
-            )
-            ids_out[s:s + block] = other_ids[np.asarray(ix)]
-            scores_out[s:s + block] = np.asarray(sc)
+        if mesh is not None:
+            from tpu_als.parallel.serve import topk_sharded
+
+            sc, ix = topk_sharded(Q, other, k, mesh,
+                                  strategy=gatherStrategy)
+            ids_out = other_ids[ix]
+            scores_out = sc
+        else:
+            block = max(1, int(self._get("blockSize")))
+            valid = jnp.ones(other.shape[0], dtype=bool)
+            other_j = jnp.asarray(other)
+            ids_out = np.empty((Q.shape[0], k), dtype=other_ids.dtype)
+            scores_out = np.empty((Q.shape[0], k), dtype=np.float32)
+            for s in range(0, Q.shape[0], block):
+                sc, ix = topk_scores(
+                    jnp.asarray(Q[s:s + block]), other_j, valid, k=k,
+                    item_chunk=block,
+                )
+                ids_out[s:s + block] = other_ids[np.asarray(ix)]
+                scores_out[s:s + block] = np.asarray(sc)
         # vectorized assembly (VERDICT r2 weak #5): the recommendations
         # column is one [n, k] structured array with the reference's struct
         # field names ((itemCol|userCol), 'rating') — column[row] is a

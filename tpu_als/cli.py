@@ -280,20 +280,41 @@ def cmd_recommend(args):
             touched = srv.update(batch)
             print(f"folded in {len(batch)} ratings touching "
                   f"{len(touched)} users", file=sys.stderr)
+    titles = None
+    if getattr(args, "titles", None):
+        from tpu_als.io.movielens import load_movielens_movies
+
+        t = load_movielens_movies(args.titles)
+        titles = dict(zip(t["item"].tolist(), t["title"].tolist()))
+    devices = getattr(args, "devices", 1)
+    if devices < 0:
+        raise SystemExit(f"--devices must be >= 0, got {devices}")
+    mesh = None
+    if devices != 1:
+        # serving sharded over the mesh — applies to the subset path
+        # too (the catalog side is what outgrows one device's HBM)
+        from tpu_als.parallel.mesh import make_mesh
+
+        mesh = make_mesh(devices if devices > 0 else None)
+    strategy = getattr(args, "gather_strategy", "all_gather")
     if args.users:
         ids = np.array([int(x) for x in args.users.split(",")])
         recs = model.recommendForUserSubset(
-            ColumnarFrame({model._params["userCol"]: ids}), args.k)
+            ColumnarFrame({model._params["userCol"]: ids}), args.k,
+            mesh=mesh, gatherStrategy=strategy)
     else:
-        recs = model.recommendForAllUsers(args.k)
+        recs = model.recommendForAllUsers(args.k, mesh=mesh,
+                                          gatherStrategy=strategy)
     key = recs.columns[0]
     limit = args.limit if args.limit > 0 else len(recs)
     for row in range(min(limit, len(recs))):
-        print(json.dumps({
-            "user": int(recs[key][row]),
-            "items": [[int(i), round(float(s), 4)]
-                      for i, s in recs["recommendations"][row]],
-        }))
+        out = {"user": int(recs[key][row]),
+               "items": [[int(i), round(float(s), 4)]
+                         for i, s in recs["recommendations"][row]]}
+        if titles is not None:
+            out["titles"] = [titles.get(int(i))
+                             for i, _ in recs["recommendations"][row]]
+        print(json.dumps(out))
 
 
 def cmd_tune(args):
@@ -491,6 +512,18 @@ def main(argv=None):
                    help="ratings whose ITEMS are folded in against the "
                         "fixed user factors (new catalog entries served "
                         "without a refit); applied before --foldin-data")
+    r.add_argument("--titles", default=None,
+                   help="movie metadata path (u.item / movies.dat / "
+                        "movies.csv, or their directory): join titles "
+                        "into the output")
+    r.add_argument("--devices", type=int, default=1,
+                   help="serve all-users top-k sharded over N devices "
+                        "(0 = all visible; 1 = single device)")
+    r.add_argument("--gather-strategy", default="all_gather",
+                   choices=["all_gather", "ring"],
+                   help="sharded serving: gather the catalog once, or "
+                        "ring-stream shards (catalog larger than one "
+                        "device's HBM)")
     r.set_defaults(fn=cmd_recommend)
 
     g = sub.add_parser("tune", help="cross-validated grid search")

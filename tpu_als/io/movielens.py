@@ -81,6 +81,65 @@ def load_movielens_csv(path):
     return ColumnarFrame({"user": u, "item": i, "rating": r, "timestamp": t})
 
 
+def load_movielens_movies(path):
+    """Read movie metadata — the id→title table the reference app joins
+    recommendations against (SURVEY.md §2.A5's human-readable output).
+
+    Accepts all three MovieLens metadata formats, detected by filename:
+    ml-100k ``u.item`` (``|``-separated, latin-1), ml-1m/ml-10m
+    ``movies.dat`` (``'::'``-separated), ml-latest/ml-25m ``movies.csv``
+    (quoted CSV with header).  A directory resolves to whichever of the
+    three it contains.  Returns a ColumnarFrame with ``item`` (int64) and
+    ``title`` (object) columns.
+    """
+    if os.path.isdir(path):
+        for name in ("movies.csv", "movies.dat", "u.item"):
+            cand = os.path.join(path, name)
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            raise FileNotFoundError(
+                f"{path} contains none of movies.csv / movies.dat / u.item")
+    base = os.path.basename(path)
+    ids, titles = [], []
+    if base.endswith(".csv"):
+        import csv
+
+        with open(path, newline="", encoding="utf-8") as f:
+            reader = csv.reader(f)
+            next(reader, None)  # header: movieId,title,genres
+            for row in reader:
+                if len(row) < 2:
+                    continue
+                ids.append(int(row[0]))
+                titles.append(row[1])
+    elif base.endswith(".dat"):
+        # ml-10m ships movies.dat as UTF-8, ml-1m as latin-1: try strict
+        # UTF-8 first (latin-1 would silently mojibake UTF-8 titles —
+        # every byte sequence is valid latin-1), fall back for ml-1m
+        try:
+            text = open(path, encoding="utf-8").read()
+        except UnicodeDecodeError:
+            text = open(path, encoding="latin-1").read()
+        for line in text.splitlines():
+            parts = line.split("::")
+            if len(parts) >= 2:
+                ids.append(int(parts[0]))
+                titles.append(parts[1])
+    else:  # u.item
+        with open(path, encoding="latin-1") as f:
+            for line in f:
+                parts = line.rstrip("\n").split("|")
+                if len(parts) >= 2:
+                    ids.append(int(parts[0]))
+                    titles.append(parts[1])
+    return ColumnarFrame({
+        "item": np.asarray(ids, dtype=np.int64),
+        "title": np.asarray(titles, dtype=object),
+    })
+
+
 def synthetic_movielens(num_users, num_items, num_ratings, seed=0,
                         rank=16, noise=0.3, user_power=0.9, item_power=1.1,
                         return_factors=False):

@@ -385,3 +385,14 @@ def test_movies_dat_utf8_titles(tmp_path):
         "1::Am\xe9lie (2001)::Comedy\n".encode("latin-1"))
     f = load_movielens_movies(str(tmp_path / "movies.dat"))
     assert f["title"][0] == "Amélie (2001)"
+
+
+def test_cli_recommend_too_many_devices_rejected(tmp_path, capsys):
+    import pytest
+
+    model_dir = str(tmp_path / "m")
+    cli_main(["train", "--data", "synthetic:60x30x1200", "--rank", "3",
+              "--max-iter", "1", "--output", model_dir])
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="refusing to silently serve"):
+        cli_main(["recommend", "--model", model_dir, "--devices", "99"])

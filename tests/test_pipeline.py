@@ -302,3 +302,34 @@ def test_crossvalidator_over_pipeline(rng):
     assert np.all(np.isfinite(cvm.avgMetrics))
     out = cvm.transform(df)
     assert np.all(np.isfinite(out["prediction"]))
+
+
+def test_crossvalidator_model_persistence_with_pipeline(rng, tmp_path):
+    """CV over a Pipeline: the best model (a PipelineModel) must survive
+    CrossValidatorModel save/load (tuning._save_tuned records the class)."""
+    from tpu_als import CrossValidatorModel
+
+    df = _string_ratings(rng, n_users=24, n_items=16, density=0.7)
+    als = ALS(userCol="user", itemCol="item", ratingCol="rating",
+              rank=3, maxIter=3, regParam=0.005, seed=3,
+              coldStartStrategy="drop")
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCol="userName", outputCol="user",
+                      handleInvalid="skip"),
+        StringIndexer(inputCol="itemName", outputCol="item",
+                      handleInvalid="skip"),
+        als,
+    ])
+    grid = ParamGridBuilder().addGrid(als.regParam, [0.005, 0.02]).build()
+    cvm = CrossValidator(estimator=pipe, estimatorParamMaps=grid,
+                         evaluator=RegressionEvaluator(
+                             metricName="rmse", labelCol="rating"),
+                         numFolds=2, seed=5).fit(df)
+    p = str(tmp_path / "cvm")
+    cvm.save(p)
+    loaded = CrossValidatorModel.load(p)
+    assert isinstance(loaded.bestModel, PipelineModel)
+    a = cvm.transform(df)
+    b = loaded.transform(df)
+    np.testing.assert_allclose(np.asarray(b["prediction"]),
+                               np.asarray(a["prediction"]), rtol=1e-6)

@@ -293,8 +293,15 @@ def cmd_recommend(args):
     if devices != 1:
         # serving sharded over the mesh — applies to the subset path
         # too (the catalog side is what outgrows one device's HBM)
+        import jax
+
         from tpu_als.parallel.mesh import make_mesh
 
+        visible = len(jax.devices())
+        if devices > visible:
+            raise SystemExit(
+                f"--devices {devices} but only {visible} visible; "
+                "refusing to silently serve on fewer devices")
         mesh = make_mesh(devices if devices > 0 else None)
     strategy = getattr(args, "gather_strategy", "all_gather")
     if args.users:

@@ -394,5 +394,5 @@ def test_cli_recommend_too_many_devices_rejected(tmp_path, capsys):
     cli_main(["train", "--data", "synthetic:60x30x1200", "--rank", "3",
               "--max-iter", "1", "--output", model_dir])
     capsys.readouterr()
-    with pytest.raises(SystemExit, match="refusing to silently serve"):
+    with pytest.raises(ValueError, match="silently smaller mesh"):
         cli_main(["recommend", "--model", model_dir, "--devices", "99"])

@@ -250,3 +250,34 @@ def test_cv_respects_larger_is_better(rng):
     model = cv.fit(frame)
     assert model.avgMetrics[1] > model.avgMetrics[0]  # rank 6 wins on r2
     assert model.bestModel._params["rank"] == 6
+
+
+def test_regression_metrics_legacy_surface():
+    """mllib.evaluation.RegressionMetrics parity (SURVEY.md §2.B7):
+    five metric properties vs hand-computed values."""
+    from tpu_als import RegressionMetrics
+
+    pred = np.array([2.0, 1.0, 3.0, 4.0])
+    obs = np.array([2.5, 0.5, 3.0, 5.0])
+    m = RegressionMetrics(zip(pred, obs))
+    res = pred - obs
+    assert np.isclose(m.meanSquaredError, np.mean(res ** 2))
+    assert np.isclose(m.rootMeanSquaredError, np.sqrt(np.mean(res ** 2)))
+    assert np.isclose(m.meanAbsoluteError, np.mean(np.abs(res)))
+    ss_res = np.sum(res ** 2)
+    ss_tot = np.sum((obs - obs.mean()) ** 2)
+    assert np.isclose(m.r2, 1 - ss_res / ss_tot)
+    # Spark semantics: SSreg/n = E[(pred - mean(obs))^2], always >= 0
+    assert np.isclose(m.explainedVariance,
+                      np.mean((pred - obs.mean()) ** 2))
+    # agreement with the DataFrame-era evaluator on the same pairs
+    from tpu_als import RegressionEvaluator
+
+    ev = RegressionEvaluator(metricName="rmse", labelCol="label")
+    rmse = ev.evaluate({"prediction": pred, "label": obs})
+    assert np.isclose(m.rootMeanSquaredError, rmse)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="at least one"):
+        RegressionMetrics([])

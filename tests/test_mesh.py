@@ -97,3 +97,12 @@ def test_two_slice_training_matches_flat_mesh(rng):
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(V1), np.asarray(V0),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_make_mesh_rejects_overask():
+    import pytest
+
+    from tpu_als.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="silently smaller mesh"):
+        make_mesh(99)

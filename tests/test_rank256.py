@@ -135,3 +135,22 @@ def test_all_strategies_agree_at_rank256(rng):
                                rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(np.asarray(Va), np.asarray(Vg),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_sharded_serving_at_rank256(rng):
+    """Config-3 serving evidence (SURVEY.md §5.7): top-k at rank 256 over
+    the 8-device mesh, ring (catalog never materialized) == all_gather ==
+    single device."""
+    from tpu_als.ops.topk import chunked_topk_scores
+    from tpu_als.parallel.serve import topk_sharded
+    import jax.numpy as jnp
+
+    U = rng.normal(size=(40, 256)).astype(np.float32)
+    V = rng.normal(size=(100, 256)).astype(np.float32)
+    ref_s, ref_i = chunked_topk_scores(
+        jnp.asarray(U), jnp.asarray(V), jnp.ones(100, bool), k=10)
+    for strategy in ("all_gather", "ring"):
+        s, ix = topk_sharded(U, V, 10, make_mesh(8), strategy=strategy)
+        np.testing.assert_allclose(s, np.asarray(ref_s), rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_array_equal(ix, np.asarray(ref_i))

@@ -35,6 +35,7 @@ from tpu_als.api.pipeline import (  # noqa: F401
 from tpu_als.api.evaluation import (  # noqa: F401
     RankingEvaluator,
     RankingMetrics,
+    RegressionMetrics,
     RegressionEvaluator,
 )
 from tpu_als.api.tuning import (  # noqa: F401

@@ -2,6 +2,7 @@ from tpu_als.api.estimator import ALS, ALSModel  # noqa: F401
 from tpu_als.api.evaluation import (  # noqa: F401
     RankingEvaluator,
     RankingMetrics,
+    RegressionMetrics,
     RegressionEvaluator,
 )
 from tpu_als.api.params import Param, Params, TypeConverters  # noqa: F401

@@ -66,6 +66,49 @@ class RegressionEvaluator(Params):
         return self.getOrDefault("metricName") in ("r2",)
 
 
+class RegressionMetrics:
+    """Legacy ``pyspark.mllib.evaluation.RegressionMetrics`` surface:
+    constructed from (prediction, observation) pairs, exposing the five
+    metric properties (canonical upstream
+    ``mllib/.../evaluation/RegressionMetrics.scala`` — SURVEY.md §2.B7).
+    The DataFrame-era equivalent is :class:`RegressionEvaluator`."""
+
+    def __init__(self, pred_and_obs):
+        arr = np.asarray([(float(p), float(o)) for p, o in pred_and_obs],
+                         dtype=np.float64)
+        if arr.size == 0:
+            raise ValueError("RegressionMetrics needs at least one "
+                             "(prediction, observation) pair")
+        self._pred = arr[:, 0]
+        self._obs = arr[:, 1]
+
+    @property
+    def meanSquaredError(self):
+        return float(np.mean((self._pred - self._obs) ** 2))
+
+    @property
+    def rootMeanSquaredError(self):
+        return float(np.sqrt(self.meanSquaredError))
+
+    @property
+    def meanAbsoluteError(self):
+        return float(np.mean(np.abs(self._pred - self._obs)))
+
+    @property
+    def r2(self):
+        ss_res = float(np.sum((self._obs - self._pred) ** 2))
+        ss_tot = float(np.sum((self._obs - np.mean(self._obs)) ** 2))
+        return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
+
+    @property
+    def explainedVariance(self):
+        # reference semantics: SSreg/n = E[(pred - E[obs])^2] (the
+        # mllib summarizer's definition — always >= 0), NOT the
+        # var(obs) - var(residuals) form, which coincides only for
+        # unbiased OLS-style fits
+        return float(np.mean((self._pred - np.mean(self._obs)) ** 2))
+
+
 class RankingMetrics:
     """Ranking quality over (predicted ranking, ground-truth set) pairs.
 

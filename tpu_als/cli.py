@@ -62,11 +62,7 @@ def cmd_train(args):
         init_distributed()  # no-op single-process; DCN rendezvous on pods
         if jax.process_count() > 1:
             return _train_multiprocess(args)
-        visible = len(jax.devices())
-        if args.devices > visible:
-            raise SystemExit(
-                f"--devices {args.devices} but only {visible} visible; "
-                "refusing to silently train on fewer devices")
+        # make_mesh raises when the request exceeds visible devices
         mesh = make_mesh(None if args.devices == 0 else args.devices)
     if args.per_host_data:
         raise SystemExit(
@@ -292,16 +288,10 @@ def cmd_recommend(args):
     mesh = None
     if devices != 1:
         # serving sharded over the mesh — applies to the subset path
-        # too (the catalog side is what outgrows one device's HBM)
-        import jax
-
+        # too (the catalog side is what outgrows one device's HBM);
+        # make_mesh raises when the request exceeds visible devices
         from tpu_als.parallel.mesh import make_mesh
 
-        visible = len(jax.devices())
-        if devices > visible:
-            raise SystemExit(
-                f"--devices {devices} but only {visible} visible; "
-                "refusing to silently serve on fewer devices")
         mesh = make_mesh(devices if devices > 0 else None)
     strategy = getattr(args, "gather_strategy", "all_gather")
     if args.users:

@@ -77,6 +77,14 @@ def make_mesh(n_devices=None, devices=None, axis=AXIS, slice_of=None):
     if devices is None:
         devices = order_devices_slice_major(jax.devices(), slice_of)
         if n_devices is not None:
+            if n_devices > len(devices):
+                # fixed at depth (advisor r4): every caller — CLI train,
+                # CLI recommend, library users — must get an error, not
+                # a silently smaller mesh than requested
+                raise ValueError(
+                    f"requested a {n_devices}-device mesh but only "
+                    f"{len(devices)} devices are visible; refusing to "
+                    "build a silently smaller mesh")
             devices = devices[:n_devices]
     else:
         devices = order_devices_slice_major(devices, slice_of)

@@ -61,5 +61,35 @@ def main():
     print(f"worker {pid} ok", flush=True)
 
 
+def main_serve():
+    """Sharded SERVING across processes (parallel/serve.py): both
+    strategies' cross-process collectives (all_gather / ppermute ring)
+    over the 2-process x 2-device gloo mesh.  Each process saves its
+    addressable output shards; the parent stitches and compares to the
+    single-device reference."""
+    pid, pcount = init_distributed()
+    assert pcount == 2, pcount
+    mesh = make_mesh()
+
+    from tpu_als.parallel.serve import topk_sharded
+
+    # divisible by the 4-device mesh so output shards map cleanly
+    rng = np.random.default_rng(11)
+    U = rng.normal(size=(24, 8)).astype(np.float32)
+    V = rng.normal(size=(36, 8)).astype(np.float32)
+    out = {}
+    for strategy in ("all_gather", "ring"):
+        s, ix = topk_sharded(U, V, 6, mesh, strategy=strategy)
+        for arr, tag in ((s, "s"), (ix, "i")):
+            for sh in arr.addressable_shards:
+                row0 = sh.index[0].start or 0
+                out[f"{tag}_{strategy}_{row0}"] = np.asarray(sh.data)
+    np.savez(os.environ["MH_OUT"] + f".{pid}.npz", **out)
+    print(f"serve worker {pid} ok", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("MH_MODE") == "serve":
+        main_serve()
+    else:
+        main()

@@ -517,3 +517,46 @@ def test_two_process_checkpoint_resume(tmp_path, mode):
     dat = np.load(out + ".ckpt.npz")
     np.testing.assert_allclose(dat["Ur"], dat["Us"], rtol=5e-4, atol=5e-4)
     np.testing.assert_allclose(dat["Vr"], dat["Vs"], rtol=5e-4, atol=5e-4)
+
+
+def test_two_process_sharded_serving_matches_single(tmp_path):
+    """REAL multi-process serving: topk_sharded's all_gather AND ring
+    collectives across two spawned gloo processes == the single-device
+    chunked top-k (parallel/serve.py multi-process contract: global
+    arrays back, shards read per host)."""
+    import os
+
+    import jax.numpy as jnp
+
+    from tpu_als.ops.topk import chunked_topk_scores
+
+    out = str(tmp_path / "serve")
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_worker.py")
+    _spawn_two_procs(worker, {"MH_OUT": out, "MH_MODE": "serve"})
+
+    rng = np.random.default_rng(11)
+    U = rng.normal(size=(24, 8)).astype(np.float32)
+    V = rng.normal(size=(36, 8)).astype(np.float32)
+    ref_s, ref_i = chunked_topk_scores(
+        jnp.asarray(U), jnp.asarray(V), jnp.ones(36, bool), k=6)
+    ref_s, ref_i = np.asarray(ref_s), np.asarray(ref_i)
+
+    for strategy in ("all_gather", "ring"):
+        got_s = np.full((24, 6), np.nan, np.float32)
+        got_i = np.full((24, 6), -1, np.int64)
+        for pid in range(2):
+            z = np.load(f"{out}.{pid}.npz")
+            for key in z.files:
+                tag, strat, row0 = key.split("_")[0], key[2:].rsplit(
+                    "_", 1)[0], int(key.rsplit("_", 1)[1])
+                if strat != strategy:
+                    continue
+                block = z[key]
+                if tag == "s":
+                    got_s[row0:row0 + len(block)] = block
+                else:
+                    got_i[row0:row0 + len(block)] = block
+        assert not np.isnan(got_s).any(), f"{strategy}: missing rows"
+        np.testing.assert_allclose(got_s, ref_s, rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(got_i, ref_i)

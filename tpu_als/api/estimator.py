@@ -687,8 +687,20 @@ class ALSModel:
                 "column before calling recommendFor*")
         k = min(k, other.shape[0])
         if mesh is not None:
+            import jax
+
             from tpu_als.parallel.serve import topk_sharded
 
+            if jax.process_count() > 1:
+                # topk_sharded returns GLOBAL arrays cross-process;
+                # the id-join + frame assembly below needs host rows.
+                # Refuse with direction instead of crashing on
+                # np.asarray of non-addressable shards.
+                raise ValueError(
+                    "recommendFor*(mesh=...) supports single-process "
+                    "meshes; in a multi-process deployment call "
+                    "tpu_als.parallel.serve.topk_sharded directly and "
+                    "read .addressable_shards per host")
             sc, ix = topk_sharded(Q, other, k, mesh,
                                   strategy=gatherStrategy)
             ids_out = other_ids[ix]
@@ -739,8 +751,16 @@ class ALSModel:
         other_ids = self._item_map.ids if for_users else self._user_map.ids
         k = min(numItems, other.shape[0])
         if mesh is not None:
+            import jax
+
             from tpu_als.parallel.serve import topk_sharded
 
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "recommend_arrays(mesh=...) supports single-process "
+                    "meshes; in a multi-process deployment call "
+                    "tpu_als.parallel.serve.topk_sharded directly and "
+                    "read .addressable_shards per host")
             sc, ix = topk_sharded(Q, other, k, mesh,
                                   strategy=gatherStrategy)
         else:

@@ -104,10 +104,17 @@ def _build(mesh, ni_loc, k, k_loc, strategy, item_chunk):
 def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
                  item_chunk=8192):
     """Top-k over a mesh: ``U`` rows sharded as queries, ``V`` rows
-    sharded as the catalog.  Returns host ``(scores [Nu, k'], indices
-    [Nu, k'])`` with ``k' = min(k, len(V))``, identical (up to
-    tie-breaking) to ``chunked_topk_scores(U, V, valid, k')`` on one
-    device.
+    sharded as the catalog.  Identical (up to tie-breaking) to
+    ``chunked_topk_scores(U, V, valid, k')`` on one device, with
+    ``k' = min(k, len(V))``.
+
+    Return contract depends on the deployment: single-process → host
+    numpy ``(scores [Nu, k'], indices [Nu, k'])``; multi-process
+    (``jax.process_count() > 1``) → GLOBAL jax.Arrays whose row shards
+    live across hosts — read ``.addressable_shards`` for this host's
+    rows (``shard.index[0].start`` is the global row offset).  The
+    higher-level ``ALSModel.recommendFor*`` surfaces refuse the
+    multi-process case rather than crash mid-assembly.
     """
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown serving strategy {strategy!r} "
@@ -141,4 +148,11 @@ def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
     spec = shard_leading(mesh)
     s, ix = f(jax.device_put(Up, spec), jax.device_put(Vp, spec),
               jax.device_put(validp, spec))
+    if jax.process_count() > 1:
+        # multi-process mesh: the result is a GLOBAL array whose shards
+        # live across hosts — np.asarray would fail on non-addressable
+        # shards.  Trim the query padding on device (every process
+        # executes the same op) and hand the global arrays back; the
+        # caller reads .addressable_shards for its own rows.
+        return s[:Nu], ix[:Nu]
     return np.asarray(s)[:Nu], np.asarray(ix)[:Nu]

@@ -117,6 +117,10 @@ _SWEEP_FLAGS = {
 # rmse evidence must beat.  The known-good band is ~0.43 (BASELINE row
 # 2); 0.50 rejects anything that regressed quality materially.
 _RMSE_GATE = 0.50
+# quality gate for the bf16 serving variant: mean top-10 overlap vs the
+# exact f32 ranking (carried inside serve_bf16's own JSON) must stay
+# near-exact for the faster number to count as THE serve evidence
+_SERVE_OVERLAP_GATE = 0.97
 
 # configs eligible for auto-selection, mapped to the sweep QUALITY step
 # that must validate them (None = quality-neutral: f32 exact is the
@@ -250,7 +254,7 @@ def builder_measured_provenance(mode, sweep_dir="sweep_logs"):
              "rmse": ["rmse", "rmse_cg2", "rmse_bf16", "rmse_cg2_bf16"],
              "ml100k": ["ml100k"],
              "foldin": ["foldin"],
-             "serve": ["serve"],
+             "serve": ["serve", "serve_bf16"],
              "twotower": ["twotower_20ep", "twotower_5ep"]}.get(mode, [])
     # higher-is-better only for throughput/recall modes
     best = None
@@ -262,6 +266,10 @@ def builder_measured_provenance(mode, sweep_dir="sweep_logs"):
             # same evidence bar as auto-selection — the provenance block
             # must not advertise a number best_measured_flags rejects
             continue
+        if mode == "serve" and name == "serve_bf16":
+            ov = (j.get("config") or {}).get("topk_overlap_vs_f32")
+            if ov is None or ov < _SERVE_OVERLAP_GATE:
+                continue
         better = (j["value"] > best["value"] if mode in ("headline",
                                                          "twotower",
                                                          "serve")
@@ -454,13 +462,15 @@ def run_serve(args):
     devs = call_with_timeout(jax.devices, 180, "jax.devices() hung")
     log(f"devices: {devs}")
     rng = np.random.default_rng(0)
-    U = jnp.asarray(rng.normal(size=(nU, args.rank)).astype(np.float32))
-    V = jnp.asarray(rng.normal(size=(nI, args.rank)).astype(np.float32))
+    U32 = jnp.asarray(rng.normal(size=(nU, args.rank)).astype(np.float32))
+    V32 = jnp.asarray(rng.normal(size=(nI, args.rank)).astype(np.float32))
+    cdt = jnp.dtype(args.compute_dtype)
+    U, V = U32.astype(cdt), V32.astype(cdt)
     valid = jnp.ones(nI, dtype=bool)
-    pallas_ok = bool(on_tpu() and k <= 128
+    pallas_ok = bool(on_tpu() and k <= 128 and cdt == jnp.float32
                      and pallas_topk.available(args.rank, k))
     log(f"catalog {nI:,} items, {nU:,} users, rank {args.rank}, "
-        f"pallas_topk={pallas_ok}")
+        f"dtype {args.compute_dtype}, pallas_topk={pallas_ok}")
 
     nblocks = nU // block  # whole blocks only: one compiled shape
     backend = "pallas" if pallas_ok else "xla"  # report what is measured
@@ -486,6 +496,18 @@ def run_serve(args):
     ups = users / dt
     log(f"{users:,} users served in {dt:.2f}s -> {ups:,.0f} users/sec "
         f"(checksum {checksum:.4g})")
+    overlap = None
+    if cdt != jnp.float32:
+        # the variant carries its own quality evidence: top-k overlap
+        # vs the exact f32 ranking on the first user block
+        _, ix32 = topk_scores(U32[:block], V32, valid, k=k,
+                              item_chunk=block, backend="xla")
+        _, ixv = topk_scores(U[:block], V, valid, k=k, item_chunk=block,
+                             backend=backend)
+        a, b = np.asarray(ixv), np.asarray(ix32)
+        overlap = float(np.mean([len(set(a[r]) & set(b[r])) / k
+                                 for r in range(block)]))
+        log(f"top-{k} overlap vs f32: {overlap:.4f}")
     return {
         "value": round(ups, 1),
         "unit": "users/sec",
@@ -498,6 +520,9 @@ def run_serve(args):
             "k": k, "block": block, "device": str(jax.devices()[0]),
             "seconds_full_pass": round(dt, 3),
             "topk_backend": backend,
+            "compute_dtype": args.compute_dtype,
+            "topk_overlap_vs_f32": (None if overlap is None
+                                    else round(overlap, 4)),
             "gemm_tflops": round(
                 2.0 * users * nI * args.rank / dt / 1e12, 3),
         },

@@ -36,6 +36,7 @@ STEPS=(
   "rmse_cg2|700|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --cg-iters 2 --probe-attempts 1"
   "ml100k|300|python bench.py --no-auto-config --mode ml100k --probe-attempts 1"
   "serve|420|python bench.py --no-auto-config --mode serve --probe-attempts 1"
+  "serve_bf16|420|python bench.py --no-auto-config --mode serve --compute-dtype bfloat16 --probe-attempts 1"
   "kernel_lab|580|python scripts/kernel_lab.py --panels 4 8 16"
   "rank256_proxy|900|python scripts/rank256_proxy.py"
   "headline_cg2_dense|700|python bench.py --no-auto-config --iters 5 --cg-iters 2 --cg-mode dense --probe-attempts 1"

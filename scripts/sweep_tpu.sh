@@ -30,6 +30,7 @@ run rmse_cg2 580 python bench.py --no-auto-config --mode rmse --iters-rmse 12 --
 run rank256_proxy 900 python scripts/rank256_proxy.py
 run ml100k 300 python bench.py --no-auto-config --mode ml100k
 run serve 420 python bench.py --no-auto-config --mode serve
+run serve_bf16 420 python bench.py --no-auto-config --mode serve --compute-dtype bfloat16
 
 # 3. solve-kernel panel sweep (sets DEFAULT_PANEL if a non-8 wins) and
 #    the remaining headline A/Bs

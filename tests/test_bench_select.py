@@ -152,3 +152,22 @@ def test_ml100k_provenance_transports(tmp_path):
     _write(d, "ml100k", {"value": 2.1, "unit": "seconds_fit_wallclock"})
     p = bench.builder_measured_provenance("ml100k", d)
     assert p["value"] == 2.1
+
+
+def test_serve_provenance_gates_bf16_on_overlap(tmp_path):
+    d = str(tmp_path)
+    _write(d, "serve", {"value": 50000.0, "unit": "users/sec"})
+    # faster bf16 but below the overlap gate: f32 number must win
+    _write(d, "serve_bf16", {"value": 90000.0, "unit": "users/sec",
+                             "config": {"topk_overlap_vs_f32": 0.80}})
+    p = bench.builder_measured_provenance("serve", d)
+    assert p["value"] == 50000.0
+    # at/above the gate the faster validated number carries
+    _write(d, "serve_bf16", {"value": 90000.0, "unit": "users/sec",
+                             "config": {"topk_overlap_vs_f32": 0.995}})
+    p = bench.builder_measured_provenance("serve", d)
+    assert p["value"] == 90000.0
+    # overlap missing entirely -> never counted
+    _write(d, "serve_bf16", {"value": 90000.0, "unit": "users/sec",
+                             "config": {}})
+    assert bench.builder_measured_provenance("serve", d)["value"] == 50000.0

@@ -266,10 +266,16 @@ def builder_measured_provenance(mode, sweep_dir="sweep_logs"):
             # same evidence bar as auto-selection — the provenance block
             # must not advertise a number best_measured_flags rejects
             continue
-        if mode == "serve" and name == "serve_bf16":
-            ov = (j.get("config") or {}).get("topk_overlap_vs_f32")
-            if ov is None or ov < _SERVE_OVERLAP_GATE:
-                continue
+        if mode == "serve":
+            # gate on the EVIDENCE, not the step filename: any serve
+            # result measured at a non-f32 dtype must carry a passing
+            # overlap field, whichever .out it landed in
+            c = j.get("config") or {}
+            if (c.get("compute_dtype", "float32") != "float32"
+                    or name.endswith("_bf16")):
+                ov = c.get("topk_overlap_vs_f32")
+                if ov is None or ov < _SERVE_OVERLAP_GATE:
+                    continue
         better = (j["value"] > best["value"] if mode in ("headline",
                                                          "twotower",
                                                          "serve")

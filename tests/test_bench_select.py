@@ -171,3 +171,16 @@ def test_serve_provenance_gates_bf16_on_overlap(tmp_path):
     _write(d, "serve_bf16", {"value": 90000.0, "unit": "users/sec",
                              "config": {}})
     assert bench.builder_measured_provenance("serve", d)["value"] == 50000.0
+
+
+def test_serve_gate_keys_on_evidence_not_filename(tmp_path):
+    # a bf16 result landing in serve.out (re-run with --compute-dtype)
+    # must face the same overlap gate as serve_bf16.out
+    d = str(tmp_path)
+    _write(d, "serve", {"value": 90000.0, "unit": "users/sec",
+                        "config": {"compute_dtype": "bfloat16"}})
+    assert bench.builder_measured_provenance("serve", d) is None
+    _write(d, "serve", {"value": 90000.0, "unit": "users/sec",
+                        "config": {"compute_dtype": "bfloat16",
+                                   "topk_overlap_vs_f32": 0.99}})
+    assert bench.builder_measured_provenance("serve", d)["value"] == 90000.0

@@ -1,10 +1,11 @@
 """The canonical reference workflow (SURVEY.md §2.A): load ratings,
 split, fit ALS, evaluate RMSE, print top-10 recommendations.
 
-With a real MovieLens download, point --data at `u.data` (ml-100k),
-`ratings.dat` (ml-1m/10m) or `ratings.csv` (ml-latest/25m); without one
-(this environment has no network) the synthetic generator produces
-MovieLens-shaped data at any scale.
+With a real MovieLens download, pass --data with the matching prefix:
+`ml-100k:PATH` (u.data), `dat:PATH` (ml-1m/10m ratings.dat) or
+`csv:PATH` (ml-latest/25m ratings.csv); without one (this environment
+has no network) the synthetic generator produces MovieLens-shaped data
+at any scale.
 
 Run:  python examples/01_movielens_basic.py [--data ml-100k:/path/u.data]
 """
@@ -23,9 +24,13 @@ def load(spec):
     kind, _, arg = spec.partition(":")
     from tpu_als.io import movielens as ml
 
-    return {"ml-100k": ml.load_movielens_100k,
-            "dat": ml.load_movielens_dat,
-            "csv": ml.load_movielens_csv}[kind](arg)
+    loaders = {"ml-100k": ml.load_movielens_100k,
+               "dat": ml.load_movielens_dat,
+               "csv": ml.load_movielens_csv}
+    if kind not in loaders:
+        raise SystemExit(f"unknown data spec {spec!r} — use one of "
+                         f"{'|'.join(loaders)}:PATH")
+    return loaders[kind](arg)
 
 
 def main():

@@ -505,3 +505,54 @@ def test_alpha_and_blocksize_validation():
         ALS(alpha=-1.0).fit(tiny)
     with pytest.raises(ValueError, match="blockSize"):
         ALS(blockSize=0).fit(tiny)
+
+
+def test_fit_with_param_map_list_and_fitMultiple(rng):
+    """Reference Estimator.fit(dataset, [pm...]) and fitMultiple
+    overloads (python/pyspark/ml/base.py)."""
+    u, i, r, _, _ = make_ratings(rng, 30, 20, 4, density=0.5)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    als = ALS(rank=3, maxIter=2, regParam=0.01, seed=0)
+    maps = [{als.rank: 2}, {als.rank: 4}]
+    models = als.fit(frame, maps)
+    assert [m.rank for m in models] == [2, 4]
+    assert als.getRank() == 3  # originals untouched
+
+    pairs = list(als.fitMultiple(frame, maps))
+    assert [i for i, _ in pairs] == [0, 1]
+    assert [m.rank for _, m in pairs] == [2, 4]
+
+    # single-dict overload still fits one model
+    one = als.fit(frame, {als.rank: 5})
+    assert one.rank == 5
+
+
+def test_pipeline_fit_with_param_map_list(rng):
+    from tpu_als import Pipeline
+
+    u, i, r, _, _ = make_ratings(rng, 25, 15, 3, density=0.5)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    als = ALS(rank=3, maxIter=2, regParam=0.01, seed=0)
+    pipe = Pipeline(stages=[als])
+    models = pipe.fit(frame, [{als.rank: 2}, {als.rank: 4}])
+    assert [m.stages[-1].rank for m in models] == [2, 4]
+
+
+def test_fit_rejects_non_parammap_params(rng):
+    u, i, r, _, _ = make_ratings(rng, 20, 12, 3, density=0.5)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    als = ALS(rank=3, maxIter=1)
+    with pytest.raises(TypeError, match="param map"):
+        als.fit(frame, als.rank)  # forgot the {param: value} wrapping
+
+
+def test_fitMultiple_snapshots_estimator_state(rng):
+    """Reference contract: fitMultiple fits against the estimator state
+    AT CALL TIME — later mutations must not leak into pending fits."""
+    u, i, r, _, _ = make_ratings(rng, 20, 12, 3, density=0.5)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    als = ALS(rank=3, maxIter=1, seed=0)
+    it = als.fitMultiple(frame, [{}])
+    als.setRank(9)  # mutate AFTER the iterator was created
+    _, model = next(it)
+    assert model.rank == 3  # snapshot, not live state

@@ -10,29 +10,6 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.parametrize("name", ["01_movielens_basic.py",
-                                  "02_pipeline_string_ids.py",
-                                  "03_distributed_and_streaming.py"])
-def test_example_compiles(name):
-    import py_compile
-
-    py_compile.compile(os.path.join(ROOT, "examples", name), doraise=True)
-
-
-def test_basic_example_runs_end_to_end():
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    p = subprocess.run(
-        [sys.executable, "-c",
-         "import jax; jax.config.update('jax_platforms', 'cpu'); "
-         "import runpy, sys; sys.argv=['x']; "
-         "runpy.run_path('examples/01_movielens_basic.py', "
-         "run_name='__main__')"],
-        cwd=ROOT, env=env, capture_output=True, text=True, timeout=500)
-    assert p.returncode == 0, p.stderr[-2000:]
-    assert "held-out RMSE" in p.stdout and "top-10" in p.stdout
-
-
 def _run_example(name, extra_env=None, timeout=500):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
@@ -45,6 +22,21 @@ def _run_example(name, extra_env=None, timeout=500):
          f"runpy.run_path('examples/{name}', run_name='__main__')"],
         cwd=ROOT, env=env, capture_output=True, text=True,
         timeout=timeout)
+
+
+@pytest.mark.parametrize("name", ["01_movielens_basic.py",
+                                  "02_pipeline_string_ids.py",
+                                  "03_distributed_and_streaming.py"])
+def test_example_compiles(name):
+    import py_compile
+
+    py_compile.compile(os.path.join(ROOT, "examples", name), doraise=True)
+
+
+def test_basic_example_runs_end_to_end():
+    p = _run_example("01_movielens_basic.py")
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "held-out RMSE" in p.stdout and "top-10" in p.stdout
 
 
 def test_pipeline_example_runs_end_to_end():

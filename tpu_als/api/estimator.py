@@ -16,7 +16,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from tpu_als.api.params import Params, TypeConverters
+from tpu_als.api.params import Estimator, Params, TypeConverters
 from tpu_als.core.als import AlsConfig, predict as _predict_kernel, train as _train
 from tpu_als.core.ratings import IdMap, build_csr_buckets, remap_ids
 from tpu_als.io.checkpoint import load_factors, save_factors
@@ -171,7 +171,7 @@ def _attach_accessors(cls, names):
         setattr(cls, f"set{cap}", setter)
 
 
-class ALS(_ALSParams):
+class ALS(_ALSParams, Estimator):
     """ALS matrix-factorization Estimator (explicit + implicit feedback).
 
     Runtime-only (non-Param) knobs: ``mesh`` — a ``jax.sharding.Mesh`` to
@@ -299,9 +299,9 @@ class ALS(_ALSParams):
         # ingest; this guards direct API callers)
         return frame[userCol], frame[itemCol], r, int((~np.isfinite(r)).sum())
 
-    def fit(self, dataset, params=None):
-        if params:
-            return self.copy(params).fit(dataset)
+    def _fit(self, dataset):
+        # fit()/fitMultiple() param-map overloads come from the shared
+        # api.params.Estimator base (reference python/pyspark/ml/base.py)
         self._validate()
         frame = as_frame(dataset)
         ratingCol = self.getRatingCol()

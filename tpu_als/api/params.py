@@ -172,3 +172,51 @@ class Params:
                 return own
             raise ValueError(f"{type(self).__name__} has no param {param.name}")
         raise TypeError(f"expected Param or str, got {param!r}")
+
+
+class Estimator(Params):
+    """Shared ``fit``/``fitMultiple`` param-map overloads (reference
+    ``python/pyspark/ml/base.py``): subclasses implement ``_fit(dataset)``
+    and inherit the whole overload surface, so the TypeError contract and
+    the fitMultiple snapshot semantics exist in exactly one place."""
+
+    def fit(self, dataset, params=None):
+        if isinstance(params, (list, tuple)):
+            models = [None] * len(params)
+            for i, m in self.fitMultiple(dataset, params):
+                models[i] = m
+            return models
+        if params is None or isinstance(params, dict):
+            est = self.copy(params) if params else self
+            return est._fit(dataset)
+        raise TypeError(
+            "params must be either a param map (dict) or a list/tuple "
+            f"of param maps, got {type(params).__name__}")
+
+    def fitMultiple(self, dataset, paramMaps):
+        """Thread-safe iterator of ``(index, model)`` — one per param
+        map, fit against a SNAPSHOT of this estimator taken now (later
+        mutations of ``self`` do not leak into pending fits, per the
+        reference contract).  Index allocation is locked; the fits
+        themselves run outside the lock so callers may drain the
+        iterator from several threads."""
+        import threading
+
+        est = self.copy()
+        maps = list(paramMaps)
+        lock = threading.Lock()
+        counter = {"i": 0}
+
+        class _FitIter:
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                with lock:
+                    i = counter["i"]
+                    if i >= len(maps):
+                        raise StopIteration
+                    counter["i"] = i + 1
+                return i, est.copy(maps[i])._fit(dataset)
+
+        return _FitIter()

@@ -36,7 +36,7 @@ import os
 import numpy as np
 
 from tpu_als.api.estimator import MLWriter, recover_interrupted_overwrite
-from tpu_als.api.params import Params, TypeConverters
+from tpu_als.api.params import Estimator, Params, TypeConverters
 from tpu_als.utils.frame import ColumnarFrame, as_frame
 
 _ORDER_TYPES = ("frequencyDesc", "frequencyAsc", "alphabetDesc",
@@ -44,7 +44,7 @@ _ORDER_TYPES = ("frequencyDesc", "frequencyAsc", "alphabetDesc",
 _INVALID_POLICIES = ("error", "skip", "keep")
 
 
-class StringIndexer(Params):
+class StringIndexer(Estimator):
     """Estimator mapping a column of arbitrary values to dense int64
     indices ordered by ``stringOrderType`` (reference default
     ``frequencyDesc``: most frequent value gets index 0; ties break
@@ -82,7 +82,7 @@ class StringIndexer(Params):
                     f"{self.getOrDefault(self.getParam(name))!r}")
         return self
 
-    def fit(self, dataset):
+    def _fit(self, dataset):
         df = as_frame(dataset)
         col = self.getOrDefault(self.getParam("inputCol"))
         if col not in df:
@@ -300,7 +300,7 @@ class IndexToString(Params):
         return df.withColumn(out_col, arr[idx])
 
 
-class Pipeline(Params):
+class Pipeline(Estimator):
     """Ordered composition of transformers and estimators (reference
     ``pyspark.ml.Pipeline``).  ``fit`` folds the dataset through the
     stages: a transformer stage applies; an estimator stage fits on the
@@ -326,7 +326,7 @@ class Pipeline(Params):
     def getStages(self):
         return list(self.getOrDefault(self.getParam("stages")))
 
-    def fit(self, dataset):
+    def _fit(self, dataset):
         df = as_frame(dataset)
         stages = self.getStages()
         last_est = max((i for i, s in enumerate(stages)

@@ -396,3 +396,63 @@ def test_cli_recommend_too_many_devices_rejected(tmp_path, capsys):
     capsys.readouterr()
     with pytest.raises(ValueError, match="silently smaller mesh"):
         cli_main(["recommend", "--model", model_dir, "--devices", "99"])
+
+
+def test_cli_evaluate_pipeline_model(tmp_path, capsys):
+    """`evaluate --model` accepts a persisted PipelineModel: regression
+    metrics flow through the whole pipeline; --ranking-k is refused with
+    direction (it needs raw-id recommendForUserSubset)."""
+    import pytest
+
+    from tpu_als import ALS, ColumnarFrame, Pipeline, StringIndexer
+    from tpu_als.io.movielens import synthetic_movielens
+
+    raw = synthetic_movielens(150, 60, 5000, seed=4)
+    # CLI data loaders emit integer user/item columns; index their
+    # string forms so the saved pipeline maps them itself
+    df = ColumnarFrame({"user": raw["user"], "item": raw["item"],
+                        "rating": raw["rating"]})
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCol="user", outputCol="u_idx",
+                      handleInvalid="skip"),
+        StringIndexer(inputCol="item", outputCol="i_idx",
+                      handleInvalid="skip"),
+        ALS(userCol="u_idx", itemCol="i_idx", ratingCol="rating",
+            rank=4, maxIter=3, regParam=0.01, seed=0,
+            coldStartStrategy="drop"),
+    ])
+    pm_dir = str(tmp_path / "pm")
+    pipe.fit(df).save(pm_dir)
+
+    data = tmp_path / "ratings.csv"
+    rows = ["userId,movieId,rating,timestamp"] + [
+        f"{u},{i},{r},0" for u, i, r in
+        zip(raw["user"][:500], raw["item"][:500], raw["rating"][:500])]
+    data.write_text("\n".join(rows) + "\n")
+
+    cli_main(["evaluate", "--model", pm_dir, "--data", f"csv:{data}"])
+    metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert metrics["rmse"] is not None and metrics["rmse"] < 2.0
+
+    with pytest.raises(SystemExit, match="ranking"):
+        cli_main(["evaluate", "--model", pm_dir, "--data",
+                  f"csv:{data}", "--ranking-k", "5"])
+
+
+def test_cli_recommend_rejects_pipeline_save_with_direction(tmp_path,
+                                                            capsys):
+    import pytest
+
+    from tpu_als import ALS, Pipeline, StringIndexer
+    from tpu_als.io.movielens import synthetic_movielens
+
+    raw = synthetic_movielens(100, 40, 2500, seed=5)
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCol="user", outputCol="u", handleInvalid="skip"),
+        ALS(userCol="u", itemCol="item", ratingCol="rating",
+            rank=3, maxIter=1, seed=0),
+    ])
+    d = str(tmp_path / "pm")
+    pipe.fit(raw).save(d)
+    with pytest.raises(SystemExit, match="PipelineModel save"):
+        cli_main(["recommend", "--model", d, "--k", "3"])

@@ -333,3 +333,21 @@ def test_crossvalidator_model_persistence_with_pipeline(rng, tmp_path):
     b = loaded.transform(df)
     np.testing.assert_allclose(np.asarray(b["prediction"]),
                                np.asarray(a["prediction"]), rtol=1e-6)
+
+
+def test_pipeline_fitMultiple_snapshots_stage_state(rng):
+    """The Estimator snapshot contract must hold THROUGH Pipeline.copy:
+    mutating a stage after creating the iterator must not leak
+    (advisor r4 — Pipeline.copy used to share unmutated stages)."""
+    df = _string_ratings(rng, n_users=20, n_items=12)
+    als = ALS(userCol="user", itemCol="item", ratingCol="rating",
+              rank=3, maxIter=1, regParam=0.01, seed=0)
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCol="userName", outputCol="user"),
+        StringIndexer(inputCol="itemName", outputCol="item"),
+        als,
+    ])
+    it = pipe.fitMultiple(df, [{}])
+    als.setRank(9)
+    _, model = next(it)
+    assert model.stages[-1].rank == 3  # snapshot, not live state

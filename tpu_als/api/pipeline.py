@@ -381,8 +381,12 @@ class Pipeline(Estimator):
                     "ambiguous; key the grid with the stage instance's "
                     "own Param (e.g. pipeline.getStages()[i].paramName)")
             per_stage[owner[0]][k] = v
+        # copy EVERY copyable stage, not only param-receiving ones: the
+        # Estimator.fitMultiple snapshot contract relies on copy()
+        # isolating later mutations of the original stages (advisor r4);
+        # duck-typed transformer stages without copy() pass through
         return Pipeline(stages=[
-            stage.copy(own) if own else stage
+            stage.copy(own or None) if hasattr(stage, "copy") else stage
             for stage, own in zip(stages, per_stage)])
 
     # -- persistence ----------------------------------------------------

@@ -193,10 +193,29 @@ def _train_multiprocess(args):
     return model
 
 
-def cmd_evaluate(args):
-    from tpu_als import ALSModel, RegressionEvaluator
+def _load_model_any(path):
+    """Load an ALSModel save, or fall back to a PipelineModel save (a
+    user who persisted the whole fitted pipeline evaluates it with the
+    same command).  Returns (model, is_pipeline)."""
+    import os
 
-    model = ALSModel.load(args.model)
+    from tpu_als import ALSModel, PipelineModel
+
+    if os.path.exists(os.path.join(path, "pipeline.json")):
+        return PipelineModel.load(path), True
+    return ALSModel.load(path), False
+
+
+def cmd_evaluate(args):
+    from tpu_als import RegressionEvaluator
+
+    model, is_pipeline = _load_model_any(args.model)
+    if is_pipeline and args.ranking_k > 0:
+        raise SystemExit(
+            "--ranking-k needs an ALSModel save (the ranking protocol "
+            "runs recommendForUserSubset on raw ids); evaluate the "
+            "pipeline's ALS stage directly, or drop --ranking-k for "
+            "regression metrics through the full pipeline")
     frame = _load_data(args.data)
     out = model.transform(frame)
     result = {}
@@ -253,10 +272,17 @@ def cmd_evaluate(args):
 
 
 def cmd_recommend(args):
-    from tpu_als import ALSModel
     from tpu_als.utils.frame import ColumnarFrame
 
-    model = ALSModel.load(args.model)
+    model, is_pipeline = _load_model_any(args.model)
+    if is_pipeline:
+        raise SystemExit(
+            f"{args.model} holds a PipelineModel save; `recommend` "
+            "serves an ALSModel (its ids are the raw id space). Load "
+            "the pipeline in Python and serve its ALS stage "
+            "(PipelineModel.load(path).stages[-1]), mapping indices "
+            "back with IndexToString — see "
+            "examples/02_pipeline_string_ids.py")
     if (getattr(args, "foldin_data", None)
             or getattr(args, "foldin_items_data", None)):
         # the full serving flow in one command (SURVEY.md §3.5): fold the

@@ -27,6 +27,7 @@ Usage:
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 import threading
@@ -355,6 +356,148 @@ def analytic_flops_per_iter(nnz, n_users, n_items, rank, implicit):
     return float(ne + solves + yty)
 
 
+def _ab_specs(args, allow_wg=True):
+    """Parse ``--ab`` into (spec, flag-override) pairs.
+
+    Specs are the suffixes of the canonical sweep step names ('exact' =
+    the default f32 exact path), so one combined run writes evidence the
+    name-keyed selection machinery (best_measured_flags /
+    builder_measured_provenance) already understands.  ``allow_wg=False``
+    rejects width-growth specs for modes whose measure() cannot rebuild
+    the blocked containers — banking a default-ladder run under a wg15
+    name would be fabricated evidence."""
+    out = []
+    for spec in [s for s in (args.ab or "").split(",") if s]:
+        name = _canonical_name("headline", spec)
+        if name not in _SWEEP_FLAGS:
+            raise SystemExit(f"unknown --ab spec {spec!r} "
+                             f"(known: exact, "
+                             f"{', '.join(k[len('headline_'):] for k in _SWEEP_FLAGS if k != 'headline_f32')})")
+        overrides = _SWEEP_FLAGS[name]
+        if not allow_wg and "width_growth" in overrides:
+            raise SystemExit(f"--ab spec {spec!r} changes width_growth, "
+                             "which this mode measures only at its "
+                             "--width-growth flag; run it as a separate "
+                             "step instead")
+        out.append((spec, overrides))
+    return out
+
+
+def _canonical_name(mode, spec):
+    """The sweep-step name a variant's evidence is filed under — shared by
+    spec parsing and banking so the two can never disagree about where
+    auto-selection will look."""
+    if mode == "headline":
+        return "headline_f32" if spec == "exact" else f"headline_{spec}"
+    return "rmse" if spec == "exact" else f"rmse_{spec}"
+
+
+def _ab_log_path(mode, spec, ab_dir):
+    """Canonical evidence file for a variant: the SAME path the separate
+    sweep step for this config would have written."""
+    return os.path.join(ab_dir, _canonical_name(mode, spec) + ".out")
+
+
+# the flags a banked variant's canonical name encodes; when --ab-dir is
+# set, every one of these must sit at its canonical default so the ONLY
+# thing distinguishing variants is the spec name itself
+_AB_BASE_DEFAULTS = {"cg_iters": 0, "cg_mode": "matfree",
+                     "compute_dtype": "float32", "width_growth": 2.0,
+                     "solve_backend": "auto"}
+
+
+def _check_ab_bankable(args):
+    """Banked evidence is keyed purely by spec name; a non-default base
+    flag would leak into every non-overridden variant and file a
+    measurement under a name that promises a different config (the
+    advisor's 'fabricated evidence' case).  Refuse up front."""
+    if not args.ab_dir:
+        return
+    off = {k: getattr(args, k, v) for k, v in _AB_BASE_DEFAULTS.items()
+           if getattr(args, k, v) != v}
+    if off:
+        raise SystemExit(
+            f"--ab-dir banking requires canonical base flags; these are "
+            f"off-default: {off}.  Encode the config as an --ab spec "
+            "instead (e.g. cg2_bf16), or drop --ab-dir.")
+
+
+def _bank_variant(mode, spec, ab_dir, result, metric, small=False):
+    """Append a variant's JSON line to its canonical sweep log the moment
+    it finishes — a tunnel death later in the A/B run must not cost the
+    variants already measured.  Errors are NOT banked (_last_json reads
+    the last line; a null would mask earlier good evidence), and neither
+    are --small runs (canonical logs carry full-scale evidence only —
+    a smoke number must never win auto-selection)."""
+    if not ab_dir or small or result.get("value") is None:
+        return
+    path = _ab_log_path(mode, spec, ab_dir)
+    os.makedirs(ab_dir, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps({**result, "metric": metric,
+                            "banked_by": f"{mode} --ab"}) + "\n")
+    log(f"banked {spec} -> {path}")
+
+
+def _already_banked(mode, spec, ab_dir):
+    """A previous run — a partially-failed A/B retry OR a dedicated sweep
+    step for the same config — already banked this variant in its
+    canonical log; a retry should spend its tunnel window only on the
+    missing ones.  Small-scale smoke lines never count (their metric
+    carries the ``_small`` suffix)."""
+    if not ab_dir:
+        return None
+    j = _last_json(_ab_log_path(mode, spec, ab_dir))
+    ok = (j and j.get("value") is not None and not j.get("error")
+          and not str(j.get("metric", "")).endswith("_small"))
+    return j if ok else None
+
+
+def _run_ab(specs, measure, mode, metric, args, summary_key):
+    """The shared A/B driver: measure each spec (skipping ones a prior
+    run banked), bank each success immediately, and return the primary
+    result.  If ANY variant failed, the primary carries an ``error``
+    field: the sweep runner's done-check then retries the step instead of
+    silently parking the lost variants (the banked ones are skipped on
+    that retry, so a flap costs only the missing measurements)."""
+    _check_ab_bankable(args)
+    primary, ab, failed = None, {}, []
+    for spec, overrides in specs:
+        # a --small smoke must actually RUN its variants — full-scale
+        # prior evidence is not a substitute for the code path
+        prior = (None if args.small
+                 else _already_banked(mode, spec, args.ab_dir))
+        if prior is not None:
+            log(f"=== A/B variant {spec}: already banked "
+                f"({prior['value']}), skipping ===")
+            ab[spec] = {"value": prior["value"], "banked": "prior run"}
+            if primary is None:
+                primary = prior
+            continue
+        log(f"=== A/B variant {spec}: {overrides or 'defaults'} ===")
+        try:
+            res = measure(overrides)
+        except Exception as e:          # noqa: BLE001 — one broken
+            log(f"variant {spec} FAILED: {e!r}")   # variant must not
+            ab[spec] = {"error": repr(e)}          # cost the others
+            failed.append(spec)
+            continue
+        _bank_variant(mode, spec, args.ab_dir, res, metric,
+                      small=bool(args.small))
+        ab[spec] = {"value": res["value"],
+                    summary_key: res["config"][summary_key]}
+        if primary is None:
+            primary = res
+    if primary is None:
+        raise RuntimeError(f"every A/B variant failed: {ab}")
+    primary.setdefault("config", {})["ab"] = ab
+    if failed:
+        # a partial A/B is NOT done: surface the loss where the runner's
+        # step_ok sees it (banked variants survive in their own logs)
+        primary["error"] = f"ab variants failed: {failed}"
+    return primary
+
+
 def run_headline(args):
     import numpy as np
 
@@ -375,72 +518,104 @@ def run_headline(args):
     u, i, r = synthetic_cached(nU, nI, nnz, seed=0)
     log(f"synthesized {nnz:,} ratings ({time.time()-t0:.1f}s)")
 
-    t0 = time.time()
-    ucsr = build_csr_buckets(u, i, r, nU, width_growth=args.width_growth)
-    icsr = build_csr_buckets(i, u, r, nI, width_growth=args.width_growth)
-    log(f"blocked: user waste {ucsr.padded_nnz/ucsr.nnz:.2f}x, "
-        f"item waste {icsr.padded_nnz/icsr.nnz:.2f}x ({time.time()-t0:.1f}s)")
+    blocked = {}   # width_growth -> staged (ucsr, icsr, ub, ib)
 
-    cfg = AlsConfig(rank=args.rank, max_iter=1, reg_param=0.01,
-                    implicit_prefs=True, alpha=40.0, seed=0,
-                    solve_backend=args.solve_backend,
-                    compute_dtype=args.compute_dtype,
-                    cg_iters=args.cg_iters, cg_mode=args.cg_mode)
-    key = jax.random.PRNGKey(0)
-    ku, kv = jax.random.split(key)
-    U = init_factors(ku, nU, cfg.rank)
-    V = init_factors(kv, nI, cfg.rank)
-    ub = jax.device_put(ucsr.device_buckets())
-    ib = jax.device_put(icsr.device_buckets())
-    step = make_step(ub, ib, nU, nI, cfg, ucsr.chunk_elems, icsr.chunk_elems)
+    def staged(width_growth):
+        if width_growth not in blocked:
+            # one ladder resident at a time: both full-scale padded-CSR
+            # bucket sets at once (~2x ≈ 1 GB+) is HBM a 7-variant A/B
+            # doesn't have to spare; specs are ordered same-wg-together
+            # so eviction happens at most once
+            blocked.clear()
+            t0 = time.time()
+            ucsr = build_csr_buckets(u, i, r, nU, width_growth=width_growth)
+            icsr = build_csr_buckets(i, u, r, nI, width_growth=width_growth)
+            log(f"blocked (wg {width_growth}): user waste "
+                f"{ucsr.padded_nnz/ucsr.nnz:.2f}x, item waste "
+                f"{icsr.padded_nnz/icsr.nnz:.2f}x ({time.time()-t0:.1f}s)")
+            ub = jax.device_put(ucsr.device_buckets())
+            ib = jax.device_put(icsr.device_buckets())
+            blocked[width_growth] = (ucsr, icsr, ub, ib)
+        return blocked[width_growth]
 
-    from tpu_als.core.als import resolve_solve_path
-    from tpu_als.utils.platform import fence
+    def measure(overrides):
+        """One full headline measurement at args+overrides; the expensive
+        shared state (synthesis, blocking, staged buckets) is reused, so
+        an A/B variant costs one compile + the timed iterations instead
+        of a whole process."""
+        from tpu_als.core.als import resolve_solve_path
+        from tpu_als.utils.platform import fence
 
-    backends = resolve_solve_path(cfg, cfg.rank)
-    log(f"resolved backends: {backends}")
+        wg = overrides.get("width_growth", args.width_growth)
+        cdt = overrides.get("compute_dtype", args.compute_dtype)
+        ucsr, icsr, ub, ib = staged(wg)
+        cfg = AlsConfig(rank=args.rank, max_iter=1, reg_param=0.01,
+                        implicit_prefs=True, alpha=40.0, seed=0,
+                        solve_backend=args.solve_backend,
+                        compute_dtype=cdt,
+                        cg_iters=overrides.get("cg_iters", args.cg_iters),
+                        cg_mode=overrides.get("cg_mode", args.cg_mode))
+        key = jax.random.PRNGKey(0)
+        ku, kv = jax.random.split(key)
+        U = init_factors(ku, nU, cfg.rank)
+        V = init_factors(kv, nI, cfg.rank)
+        step = make_step(ub, ib, nU, nI, cfg,
+                         ucsr.chunk_elems, icsr.chunk_elems)
+        backends = resolve_solve_path(cfg, cfg.rank)
+        log(f"resolved backends: {backends}")
 
-    t0 = time.time()
-    U, V = step(U, V)
-    U.block_until_ready()
-    fence(U)
-    log(f"warmup (compile + 1 iter): {time.time()-t0:.1f}s")
-
-    t0 = time.time()
-    for _ in range(args.iters):
+        t0 = time.time()
         U, V = step(U, V)
-    U.block_until_ready()
-    checksum = fence(U)
-    dt = time.time() - t0
-    iters_per_sec = args.iters / dt
-    log(f"{args.iters} iters in {dt:.2f}s -> {iters_per_sec:.3f} iters/sec "
-        f"(checksum {checksum:.4g})")
+        U.block_until_ready()
+        fence(U)
+        log(f"warmup (compile + 1 iter): {time.time()-t0:.1f}s")
 
-    flops = analytic_flops_per_iter(nnz, nU, nI, cfg.rank, implicit=True)
-    achieved = flops * iters_per_sec
-    return {
-        "value": round(iters_per_sec, 4),
-        "unit": "iters/sec",
-        "vs_baseline": round(iters_per_sec / SPARK_8EXEC_ITERS_PER_SEC, 2),
-        "baseline_note": "baseline = assumed 60 s/iter for 8-executor Spark "
-                         "ALS on ML-25M rank=128 (reference publishes no "
-                         "numbers; Spark not runnable here — see BASELINE.md)",
-        "config": {
-            "users": nU, "items": nI, "ratings": nnz, "rank": args.rank,
-            "implicit": True, "alpha": 40.0,
-            "device": str(jax.devices()[0]),
-            "seconds_per_iter": round(dt / args.iters, 3),
-            "compute_dtype": args.compute_dtype,
-            "width_growth": args.width_growth,
-            "padding_waste": round(
-                (ucsr.padded_nnz + icsr.padded_nnz) / (2.0 * nnz), 3),
-            "tflops_per_iter_analytic": round(flops / 1e12, 3),
-            "achieved_tflops": round(achieved / 1e12, 3),
-            "mfu_pct_vs_v5e_bf16_peak": round(
-                100.0 * achieved / V5E_BF16_PEAK_FLOPS, 2),
-            **backends,
-        },
-    }
+        t0 = time.time()
+        for _ in range(args.iters):
+            U, V = step(U, V)
+        U.block_until_ready()
+        checksum = fence(U)
+        dt = time.time() - t0
+        iters_per_sec = args.iters / dt
+        log(f"{args.iters} iters in {dt:.2f}s -> {iters_per_sec:.3f} "
+            f"iters/sec (checksum {checksum:.4g})")
+
+        flops = analytic_flops_per_iter(nnz, nU, nI, cfg.rank,
+                                        implicit=True)
+        achieved = flops * iters_per_sec
+        return {
+            "value": round(iters_per_sec, 4),
+            "unit": "iters/sec",
+            "vs_baseline": round(
+                iters_per_sec / SPARK_8EXEC_ITERS_PER_SEC, 2),
+            "baseline_note": "baseline = assumed 60 s/iter for 8-executor "
+                             "Spark ALS on ML-25M rank=128 (reference "
+                             "publishes no numbers; Spark not runnable "
+                             "here — see BASELINE.md)",
+            "config": {
+                "users": nU, "items": nI, "ratings": nnz, "rank": args.rank,
+                "implicit": True, "alpha": 40.0,
+                "device": str(jax.devices()[0]),
+                "seconds_per_iter": round(dt / args.iters, 3),
+                "compute_dtype": cdt,
+                "width_growth": wg,
+                "padding_waste": round(
+                    (ucsr.padded_nnz + icsr.padded_nnz) / (2.0 * nnz), 3),
+                "tflops_per_iter_analytic": round(flops / 1e12, 3),
+                "achieved_tflops": round(achieved / 1e12, 3),
+                "mfu_pct_vs_v5e_bf16_peak": round(
+                    100.0 * achieved / V5E_BF16_PEAK_FLOPS, 2),
+                "cg_iters": cfg.cg_iters, "cg_mode": cfg.cg_mode,
+                **backends,
+            },
+        }
+
+    specs = _ab_specs(args)
+    if not specs:
+        return measure({})
+    return _run_ab(specs, measure, "headline",
+                   "als_iters_per_sec_rank128_ml25m_implicit",
+                   args, "seconds_per_iter")
 
 
 def run_serve(args):
@@ -587,66 +762,84 @@ def run_rmse(args):
     icsr = build_csr_buckets(i, u, r, nI, width_growth=args.width_growth)
     log(f"blocked ({time.time()-t0:.1f}s)")
 
-    cfg = AlsConfig(rank=rank, max_iter=iters,
-                    reg_param=reg, implicit_prefs=False, seed=0,
-                    solve_backend=args.solve_backend,
-                    compute_dtype=args.compute_dtype,
-                    cg_iters=args.cg_iters, cg_mode=args.cg_mode)
-    t0 = time.time()
-    U, V = train(ucsr, icsr, cfg)
-    U.block_until_ready()
-    train_s = time.time() - t0
-    log(f"trained {cfg.max_iter} iters in {train_s:.1f}s")
+    def measure(overrides):
+        """Train + held-out score at args+overrides, reusing the split and
+        blocked containers — an A/B variant costs its compile + train,
+        not a whole process (synthesis and blocking dominate startup)."""
+        import jax.numpy as jnp
 
-    # chunked held-out scoring (test set can be >1M pairs)
-    import jax.numpy as jnp
+        cfg = AlsConfig(rank=rank, max_iter=iters,
+                        reg_param=reg, implicit_prefs=False, seed=0,
+                        solve_backend=args.solve_backend,
+                        compute_dtype=overrides.get("compute_dtype",
+                                                    args.compute_dtype),
+                        cg_iters=overrides.get("cg_iters", args.cg_iters),
+                        cg_mode=overrides.get("cg_mode", args.cg_mode))
+        t0 = time.time()
+        U, V = train(ucsr, icsr, cfg)
+        U.block_until_ready()
+        train_s = time.time() - t0
+        log(f"trained {cfg.max_iter} iters in {train_s:.1f}s")
 
-    se, cnt = 0.0, 0
-    B = 1 << 20
-    ones = None
-    for s in range(0, len(rt), B):
-        ub_, ib_, rb = ut[s:s + B], it_[s:s + B], rt[s:s + B]
-        if ones is None or len(ub_) != len(ones):
-            ones = jnp.ones(len(ub_), bool)
-        pred = predict(U, V, jnp.asarray(ub_), jnp.asarray(ib_), ones, ones)
-        pred = np.asarray(pred)
-        ok = np.isfinite(pred)
-        se += float(((pred[ok] - rb[ok]) ** 2).sum())
-        cnt += int(ok.sum())
-    rmse = float(np.sqrt(se / max(cnt, 1)))
-    base = float(np.sqrt(np.mean((rt - r.mean()) ** 2)))
-    log(f"held-out RMSE {rmse:.4f} (global-mean predictor {base:.4f})")
+        # chunked held-out scoring (test set can be >1M pairs)
+        se, cnt = 0.0, 0
+        B = 1 << 20
+        ones = None
+        for s in range(0, len(rt), B):
+            ub_, ib_, rb = ut[s:s + B], it_[s:s + B], rt[s:s + B]
+            if ones is None or len(ub_) != len(ones):
+                ones = jnp.ones(len(ub_), bool)
+            pred = predict(U, V, jnp.asarray(ub_), jnp.asarray(ib_),
+                           ones, ones)
+            pred = np.asarray(pred)
+            ok = np.isfinite(pred)
+            se += float(((pred[ok] - rb[ok]) ** 2).sum())
+            cnt += int(ok.sum())
+        rmse = float(np.sqrt(se / max(cnt, 1)))
+        base = float(np.sqrt(np.mean((rt - r.mean()) ** 2)))
+        log(f"held-out RMSE {rmse:.4f} (global-mean predictor {base:.4f})")
 
-    config = {
-        "users": nU, "items": nI, "ratings": nnz, "rank": cfg.rank,
-        "iters": cfg.max_iter, "reg_param": cfg.reg_param,
-        "train_seconds": round(train_s, 1),
-        "seconds_per_iter": round(train_s / cfg.max_iter, 3),
-        "test_pairs_scored": cnt,
-        "device": str(jax.devices()[0]),
-        **_resolve(cfg),
-    }
-    if args.mode == "ml100k":
-        config["heldout_rmse"] = round(rmse, 4)
-        config["global_mean_rmse"] = round(base, 4)
+        config = {
+            "users": nU, "items": nI, "ratings": nnz, "rank": cfg.rank,
+            "iters": cfg.max_iter, "reg_param": cfg.reg_param,
+            "train_seconds": round(train_s, 1),
+            "seconds_per_iter": round(train_s / cfg.max_iter, 3),
+            "test_pairs_scored": cnt,
+            "device": str(jax.devices()[0]),
+            "cg_iters": cfg.cg_iters, "cg_mode": cfg.cg_mode,
+            "compute_dtype": str(cfg.compute_dtype),
+            **_resolve(cfg),
+        }
+        if args.mode == "ml100k":
+            config["heldout_rmse"] = round(rmse, 4)
+            config["global_mean_rmse"] = round(base, 4)
+            return {
+                "value": round(train_s, 2),
+                "unit": "seconds_fit_wallclock",
+                "vs_baseline": None,
+                "baseline_note": "BASELINE config 1: stock-PySpark "
+                                 "`local[*]` baseline is unpublished and "
+                                 "Spark cannot run in this environment; "
+                                 "the measured artifact is our fit "
+                                 "wall-clock + held-out RMSE",
+                "config": config,
+            }
         return {
-            "value": round(train_s, 2),
-            "unit": "seconds_fit_wallclock",
-            "vs_baseline": None,
-            "baseline_note": "BASELINE config 1: stock-PySpark `local[*]` "
-                             "baseline is unpublished and Spark cannot run "
-                             "in this environment; the measured artifact "
-                             "is our fit wall-clock + held-out RMSE",
+            "value": round(rmse, 4),
+            "unit": "rmse_stars",
+            "vs_baseline": round(base / rmse, 3),
+            "baseline_note": "vs_baseline = global-mean-predictor RMSE / "
+                             "model RMSE (>1 is better); reference "
+                             "publishes no RMSE",
             "config": config,
         }
-    return {
-        "value": round(rmse, 4),
-        "unit": "rmse_stars",
-        "vs_baseline": round(base / rmse, 3),
-        "baseline_note": "vs_baseline = global-mean-predictor RMSE / model "
-                         "RMSE (>1 is better); reference publishes no RMSE",
-        "config": config,
-    }
+
+    specs = _ab_specs(args, allow_wg=False) if args.mode == "rmse" else []
+    if not specs:
+        return measure({})
+    return _run_ab(specs, measure, "rmse",
+                   "als_heldout_rmse_ml25m_explicit",
+                   args, "train_seconds")
 
 
 def run_foldin(args):
@@ -952,6 +1145,17 @@ def main():
                     help="bucket width ladder: 2.0 = powers of two, "
                          "1.5 = add 0.75*2^k rungs (~25%% less padding, "
                          "more jit specializations)")
+    ap.add_argument("--ab", default="",
+                    help="comma list of variant specs (exact, cg2, cg3, "
+                         "cg2_dense, bf16, cg2_bf16, wg15, ...) measured "
+                         "in ONE process sharing synthesis/blocking/"
+                         "staging — flappy-tunnel A/B (headline and rmse "
+                         "modes)")
+    ap.add_argument("--ab-dir", default="",
+                    help="directory to append each finished variant's "
+                         "JSON line into its canonical sweep log (e.g. "
+                         "sweep_logs) so auto-selection sees the evidence "
+                         "even if a later variant dies")
     ap.add_argument("--platform", default="default",
                     choices=["default", "cpu"],
                     help="cpu = force the CPU backend (smoke tests; skips "
@@ -970,9 +1174,11 @@ def main():
 
     if (args.mode == "headline" and not args.no_auto_config
             and not args.small and args.platform == "default"
-            and args.cg_iters == 0
-            and args.compute_dtype == "float32"
-            and args.width_growth == 2.0 and args.cg_mode == "matfree"
+            and not args.ab          # an A/B run measures its own specs;
+            and args.cg_iters == 0   # auto-config mutating the base flags
+            and args.compute_dtype == "float32"   # would contaminate the
+            and args.width_growth == 2.0          # banked evidence
+            and args.cg_mode == "matfree"
             and args.solve_backend == "auto"):
         # `is not None`, not truthiness: {} is the legitimate "winner is
         # the default config, no overrides" outcome — behaviorally the

@@ -14,8 +14,13 @@
 #   - A step that fails while the tunnel is still up counts as a real
 #     attempt; after MAX_TRIES it is parked as <name>.fail and the loop
 #     moves on (a dead step must not eat the window the others need).
+#     A failure immediately followed by a DOWN probe is a window closing
+#     mid-step, not a step defect: the try is refunded (07:18 window:
+#     headline_cg2 burned a try staging data into a dying tunnel).
 #   - The known-good exact-path headline runs FIRST: bank the number the
 #     round needs before gambling the window on the cg2 candidate.
+#     After it banked (07:18 flap evidence): SHORT steps lead — a ~3-min
+#     window should always bank something before a 700s step gambles it.
 #
 #   bash scripts/sweep_resume.sh [max_loop_minutes]
 set -u
@@ -32,22 +37,14 @@ DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
 STEPS=(
   "headline_f32|580|python bench.py --no-auto-config --iters 5 --probe-attempts 1"
   "rmse|580|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --probe-attempts 1"
-  "headline_cg2|700|python bench.py --no-auto-config --iters 5 --cg-iters 2 --probe-attempts 1"
-  "rmse_cg2|700|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --cg-iters 2 --probe-attempts 1"
   "ml100k|300|python bench.py --no-auto-config --mode ml100k --probe-attempts 1"
+  "kernel_lab|580|python scripts/kernel_lab.py --panels 4 8 16"
+  "headline_ab|1200|python bench.py --no-auto-config --iters 5 --ab cg2,cg3,cg2_dense,bf16,cg2_bf16,wg15,bf16_wg15 --ab-dir sweep_logs --probe-attempts 1"
+  "rmse_ab|1500|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --ab cg2,bf16,cg2_bf16 --ab-dir sweep_logs --probe-attempts 1"
   "serve|420|python bench.py --no-auto-config --mode serve --probe-attempts 1"
   "serve_bf16|420|python bench.py --no-auto-config --mode serve --compute-dtype bfloat16 --probe-attempts 1"
-  "kernel_lab|580|python scripts/kernel_lab.py --panels 4 8 16"
   "rank256_proxy|900|python scripts/rank256_proxy.py"
-  "headline_cg2_dense|700|python bench.py --no-auto-config --iters 5 --cg-iters 2 --cg-mode dense --probe-attempts 1"
-  "headline_cg3|700|python bench.py --no-auto-config --iters 5 --cg-iters 3 --probe-attempts 1"
-  "headline_cg2_bf16|700|python bench.py --no-auto-config --iters 5 --cg-iters 2 --compute-dtype bfloat16 --probe-attempts 1"
-  "rmse_cg2_bf16|700|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --cg-iters 2 --compute-dtype bfloat16 --probe-attempts 1"
-  "headline_bf16|580|python bench.py --no-auto-config --iters 5 --compute-dtype bfloat16 --probe-attempts 1"
-  "rmse_bf16|580|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --compute-dtype bfloat16 --probe-attempts 1"
   "kernel_lab_r256|580|python scripts/kernel_lab.py --rank 256 --n 8192 --panels 4 8 16"
-  "headline_wg15|580|python bench.py --no-auto-config --iters 5 --width-growth 1.5 --probe-attempts 1"
-  "headline_bf16_wg15|580|python bench.py --no-auto-config --iters 5 --compute-dtype bfloat16 --width-growth 1.5 --probe-attempts 1"
   "foldin|580|python bench.py --no-auto-config --mode foldin --probe-attempts 1"
   "ablate_full_cg2|900|python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2"
   "twotower_5ep|900|python bench.py --no-auto-config --mode twotower --tt-epochs 5 --probe-attempts 1"
@@ -110,6 +107,11 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if { [ "$rc" -eq 0 ] && [[ "$cmd" != python\ bench.py* ]]; } || step_ok "sweep_logs/$name.out"; then
     touch "sweep_logs/$name.done"
     echo "$(date -Is) resume-sweep: $name DONE (rc=$rc)" >>"$LOG"
+  elif ! probe; then
+    # the tunnel died under the step: refund the try — this failure
+    # carries no information about the step itself
+    echo "$(( tries - 1 ))" >"$tries_file"
+    echo "$(date -Is) resume-sweep: $name window closed mid-step (rc=$rc), try refunded" >>"$LOG"
   elif [ "$tries" -ge "$MAX_TRIES" ]; then
     touch "sweep_logs/$name.fail"
     echo "$(date -Is) resume-sweep: $name PARKED after $tries tries (rc=$rc)" >>"$LOG"

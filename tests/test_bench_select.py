@@ -184,3 +184,115 @@ def test_serve_gate_keys_on_evidence_not_filename(tmp_path):
                         "config": {"compute_dtype": "bfloat16",
                                    "topk_overlap_vs_f32": 0.99}})
     assert bench.builder_measured_provenance("serve", d)["value"] == 90000.0
+
+
+def _args(**kw):
+    import argparse
+
+    d = dict(ab="", ab_dir="", small=False)
+    d.update(kw)
+    return argparse.Namespace(**d)
+
+
+def test_ab_specs_parse_known_and_reject_unknown():
+    assert bench._ab_specs(_args()) == []
+    specs = bench._ab_specs(_args(ab="exact,cg2,cg2_bf16"))
+    assert [s for s, _ in specs] == ["exact", "cg2", "cg2_bf16"]
+    assert specs[0][1] == {}
+    assert specs[1][1] == {"cg_iters": 2}
+    assert specs[2][1] == {"cg_iters": 2, "compute_dtype": "bfloat16"}
+    try:
+        bench._ab_specs(_args(ab="warp9"))
+    except SystemExit:
+        pass
+    else:
+        raise AssertionError("unknown spec must be rejected")
+
+
+def test_ab_banks_into_canonical_logs(tmp_path):
+    # the file the combined A/B writes is EXACTLY the file auto-selection
+    # reads for that config — a variant banked by --ab is equivalent
+    # evidence to a dedicated sweep step run
+    res = {"value": 0.9, "unit": "iters/sec", "config": {}}
+    bench._bank_variant("headline", "cg2", str(tmp_path), res, "m")
+    assert bench._last_json(
+        str(tmp_path / "headline_cg2.out"))["value"] == 0.9
+    bench._bank_variant("rmse", "cg2", str(tmp_path),
+                        {"value": 0.44, "config": {}}, "m")
+    assert bench._last_json(str(tmp_path / "rmse_cg2.out"))["value"] == 0.44
+    # exact maps to the canonical step names
+    bench._bank_variant("headline", "exact", str(tmp_path), res, "m")
+    assert bench._last_json(str(tmp_path / "headline_f32.out"))
+    bench._bank_variant("rmse", "exact", str(tmp_path),
+                        {"value": 0.43, "config": {}}, "m")
+    assert bench._last_json(str(tmp_path / "rmse.out"))
+
+
+def test_ab_never_banks_small_or_error_runs(tmp_path):
+    bench._bank_variant("headline", "cg2", str(tmp_path),
+                        {"value": 0.9, "config": {}}, "m", small=True)
+    bench._bank_variant("headline", "cg3", str(tmp_path),
+                        {"value": None, "config": {}}, "m")
+    assert not (tmp_path / "headline_cg2.out").exists()
+    assert not (tmp_path / "headline_cg3.out").exists()
+
+
+def test_ab_banked_evidence_drives_auto_selection(tmp_path):
+    # end-to-end contract: one combined A/B run's banked files are enough
+    # for best_measured_flags to pick the validated winner
+    _write(tmp_path, "headline_f32", {"value": 0.85})
+    _write(tmp_path, "headline_cg2", {"value": 2.1, "banked_by":
+                                      "headline --ab"})
+    _write(tmp_path, "rmse_cg2", {"value": 0.44, "banked_by": "rmse --ab"})
+    assert bench.best_measured_flags(str(tmp_path)) == {"cg_iters": 2}
+
+
+def test_ab_retry_skips_banked_and_flags_partial_failure(tmp_path):
+    import argparse
+
+    # prior evidence: cg2 banked by an earlier (partial) A/B run
+    _write(tmp_path, "headline_cg2", {"value": 2.0, "metric": "m",
+                                      "banked_by": "headline --ab",
+                                      "config": {"seconds_per_iter": 0.5}})
+    calls = []
+
+    def measure(overrides):
+        calls.append(dict(overrides))
+        if overrides.get("cg_iters") == 3:
+            raise RuntimeError("tunnel died")
+        return {"value": 1.0, "unit": "u",
+                "config": {"seconds_per_iter": 1.0}}
+
+    args = argparse.Namespace(ab="", ab_dir=str(tmp_path), small=False)
+    specs = [("cg2", {"cg_iters": 2}), ("exact", {}),
+             ("cg3", {"cg_iters": 3})]
+    res = bench._run_ab(specs, measure, "headline", "m", args,
+                        "seconds_per_iter")
+    # cg2 skipped (banked), exact measured, cg3 failed -> error surfaces
+    assert calls == [{}, {"cg_iters": 3}]
+    assert res["config"]["ab"]["cg2"]["banked"] == "prior run"
+    assert "cg3" in res["error"]
+    # a --small line in the canonical log is NOT prior evidence
+    _write(tmp_path, "headline_bf16", {"value": 9.9, "metric": "m_small",
+                                       "banked_by": "headline --ab"})
+    assert bench._already_banked("headline", "bf16", str(tmp_path)) is None
+
+
+def test_ab_banking_requires_canonical_base_flags():
+    import argparse
+
+    args = argparse.Namespace(ab="cg2", ab_dir="sweep_logs", small=False,
+                              cg_iters=0, cg_mode="matfree",
+                              compute_dtype="bfloat16", width_growth=2.0,
+                              solve_backend="auto")
+    try:
+        bench._check_ab_bankable(args)
+    except SystemExit as e:
+        assert "compute_dtype" in str(e)
+    else:
+        raise AssertionError("off-default base flag must refuse banking")
+    args.compute_dtype = "float32"
+    bench._check_ab_bankable(args)   # canonical defaults pass
+    args.ab_dir = ""
+    args.cg_iters = 2
+    bench._check_ab_bankable(args)   # no banking -> no constraint

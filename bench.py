@@ -236,11 +236,16 @@ _BUILDER_MEASURED = {
                            "59047-item catalog",
     },
     "twotower": {
-        "value": 0.0629, "unit": "recall_at_10",
-        "measured_at": "round 2",
-        "source_log": "BASELINE.md row 5",
-        "resolved_config": "filtered recall@10, warm start, 20 epochs "
-                           "(cold 0.0620; Bayes oracle ceiling 0.2481)",
+        "value": 0.1869, "unit": "recall_at_10",
+        "measured_at": "2026-07-31 (bench scale on CPU — recall is "
+                       "device-independent; only train_seconds differ)",
+        "source_log": "tt_curve_full.log",
+        "resolved_config": "filtered recall@10, warm + serving-time "
+                           "popularity prior, 20 epochs = 75.3% of the "
+                           "0.2481 Bayes oracle ceiling; prior curve flat "
+                           "0.182-0.187 across epoch budgets {1..20}; raw "
+                           "warm-vs-cold is a measured wash at this scale "
+                           "(-0.03 early, +0.004 at 20)",
     },
 }
 

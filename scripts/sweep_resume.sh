@@ -47,7 +47,6 @@ STEPS=(
   "rank256_proxy|900|python scripts/rank256_proxy.py"
   "kernel_lab_r256|580|python scripts/kernel_lab.py --rank 256 --n 8192 --panels 4 8 16"
   "ablate_full_cg2|900|python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2"
-  "twotower_5ep|900|python bench.py --no-auto-config --mode twotower --tt-epochs 5 --probe-attempts 1"
   "twotower_20ep|1500|python bench.py --no-auto-config --mode twotower --probe-attempts 1"
 )
 

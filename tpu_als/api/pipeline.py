@@ -37,7 +37,7 @@ import numpy as np
 
 from tpu_als.api.estimator import MLWriter, recover_interrupted_overwrite
 from tpu_als.api.params import Estimator, Params, TypeConverters
-from tpu_als.utils.frame import ColumnarFrame, as_frame
+from tpu_als.utils.frame import as_frame
 
 _ORDER_TYPES = ("frequencyDesc", "frequencyAsc", "alphabetDesc",
                 "alphabetAsc")

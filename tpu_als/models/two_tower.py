@@ -14,7 +14,7 @@ matrices, so ``chunked_topk_scores`` serves both model families.
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 

@@ -163,7 +163,6 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
     ``tests/test_multihost.py`` (two spawned processes, result equal to
     the single-process run).
     """
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from tpu_als.core.als import init_factors

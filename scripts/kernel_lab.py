@@ -27,8 +27,25 @@ def main():
     ap.add_argument("--rank", type=int, default=128)
     ap.add_argument("--panels", type=int, nargs="*", default=[4, 8, 16])
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--platform", default="default",
+                    choices=["default", "cpu"],
+                    help="cpu = force the CPU backend + interpret-mode "
+                         "kernels (script dry-run; the axon plugin "
+                         "ignores JAX_PLATFORMS from the environment, so "
+                         "this is the only way to run without a tunnel)")
     args = ap.parse_args()
     n, r = args.n, args.rank
+
+    interpret = args.platform == "cpu"
+    if interpret:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        # interpret mode serially emulates every lane group: a full-size
+        # timing batch would take hours and its numbers are meaningless
+        # anyway — the dry-run exists to prove the script + kernels run
+        # end-to-end, so shrink the batch and keep the correctness check
+        n = min(n, 2 * LANES)
 
     from tpu_als.utils.platform import enable_persistent_compile_cache
     enable_persistent_compile_cache()
@@ -64,10 +81,12 @@ def main():
 
     if r <= 128:
         for p in [1] + list(args.panels):
-            f = functools.partial(spd_solve_lanes, panel=p)
+            f = functools.partial(spd_solve_lanes, panel=p,
+                                  interpret=interpret)
             bench(f, f"lanes panel={p}")
-            err = np.abs(np.asarray(spd_solve_lanes(Ac, bc, panel=p))
-                         - ref).max()
+            err = np.abs(np.asarray(
+                spd_solve_lanes(Ac, bc, panel=p, interpret=interpret))
+                - ref).max()
             print(f"  panel={p} max err vs xla: {err:.2e}")
     else:
         # ranks past the flat layout: sweep the blocked out-of-core
@@ -75,10 +94,13 @@ def main():
         from tpu_als.ops.pallas_lanes_blocked import spd_solve_lanes_blocked
 
         for p in args.panels:
-            f = functools.partial(spd_solve_lanes_blocked, panel=p)
+            f = functools.partial(spd_solve_lanes_blocked, panel=p,
+                                  interpret=interpret)
             bench(f, f"lanes_blocked panel={p}")
             err = np.abs(np.asarray(
-                spd_solve_lanes_blocked(Ac, bc, panel=p)) - ref).max()
+                spd_solve_lanes_blocked(Ac, bc, panel=p,
+                                        interpret=interpret))
+                - ref).max()
             print(f"  panel={p} max err vs xla: {err:.2e}")
 
 

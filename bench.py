@@ -235,6 +235,24 @@ _BUILDER_MEASURED = {
         "resolved_config": "512 ratings/batch, 30 batches, rank 128, "
                            "59047-item catalog",
     },
+    "ml100k": {
+        "value": 9.43, "unit": "seconds_fit_wallclock",
+        "measured_at": "2026-07-31 (host CPU — no tunnel window; the "
+                       "on-chip ml100k sweep step supersedes this)",
+        "source_log": "BASELINE.md row 1",
+        "resolved_config": "ML-100K shape, rank 10, 10 iters, 80/20 "
+                           "split, held-out RMSE 0.7179 (global-mean "
+                           "1.0533)",
+    },
+    "serve": {
+        "value": 3445.1, "unit": "users/sec",
+        "measured_at": "2026-07-31 (host CPU full pass — the serving "
+                       "FLOOR; on-chip serve step supersedes this)",
+        "source_log": "serve_overlap_cpu.log",
+        "resolved_config": "recommendForAllUsers, 162k users x 59k items "
+                           "rank 128 k=10, bf16 with measured top-10 "
+                           "overlap 0.9947 vs f32 (gate >= 0.97)",
+    },
     "twotower": {
         "value": 0.1869, "unit": "recall_at_10",
         "measured_at": "2026-07-31 (bench scale on CPU — recall is "

@@ -179,7 +179,11 @@ def test_serve_gate_keys_on_evidence_not_filename(tmp_path):
     d = str(tmp_path)
     _write(d, "serve", {"value": 90000.0, "unit": "users/sec",
                         "config": {"compute_dtype": "bfloat16"}})
-    assert bench.builder_measured_provenance("serve", d) is None
+    # overlap-less bf16 evidence is gated OUT: provenance degrades to the
+    # static builder-measured record, never to the unvalidated number
+    prov = bench.builder_measured_provenance("serve", d)
+    assert prov["value"] != 90000.0
+    assert prov == bench._BUILDER_MEASURED["serve"]
     _write(d, "serve", {"value": 90000.0, "unit": "users/sec",
                         "config": {"compute_dtype": "bfloat16",
                                    "topk_overlap_vs_f32": 0.99}})

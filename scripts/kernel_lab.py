@@ -60,10 +60,12 @@ def main():
     from tpu_als.ops.solve import solve_spd
     ref = np.asarray(solve_spd(Ac, bc, jnp.ones(nc), backend="xla"))
 
-    # timing batch: same SPD instance tiled (cheap to build, full-size solve)
+    # timing batch: same SPD instance tiled ON DEVICE — host-tiling 2 GB
+    # and shipping it through the tunnel was most of a window's budget;
+    # only the small correctness batch (~8 MB) crosses now
     reps = -(-n // nc)
-    A = jnp.asarray(np.tile(np.asarray(Ac), (reps, 1, 1))[:n])
-    b = jnp.asarray(np.tile(np.asarray(bc), (reps, 1))[:n])
+    A = jnp.tile(Ac, (reps, 1, 1))[:n]
+    b = jnp.tile(bc, (reps, 1))[:n]
     A.block_until_ready()
     print(f"data staged: {A.nbytes/1e9:.1f} GB on device", flush=True)
 

@@ -284,7 +284,11 @@ class ALS(_ALSParams, Estimator):
                 raise ValueError(
                     f"ALS only supports integer ids; column {c!r} has dtype "
                     f"{frame[c].dtype} (the reference API has the same "
-                    "integer-range restriction)")
+                    "integer-range restriction). For raw string ids, index "
+                    "them first — Pipeline(stages=[StringIndexer(inputCol="
+                    f"{c!r}, outputCol='{c}_idx', handleInvalid='skip'), "
+                    "ALS(...)]) mirrors the reference workflow "
+                    "(docs/migration.md)")
         if ratingCol == "":
             # reference semantic: empty ratingCol means unit ratings
             r = np.ones(len(frame), dtype=np.float32)

@@ -422,26 +422,38 @@ def _ab_log_path(mode, spec, ab_dir):
 
 
 # the flags a banked variant's canonical name encodes; when --ab-dir is
-# set, every one of these must sit at its canonical default so the ONLY
-# thing distinguishing variants is the spec name itself
+# set, every one of these must sit at its canonical value so the ONLY
+# thing distinguishing variants is the spec name itself.  Model/scale
+# flags (rank, iteration counts, reg) are guarded too: a rank-64 or
+# 3-iter run banked under headline_cg2 would read as full-scale rank-128
+# evidence downstream — the exact mislabeling this check exists to stop.
+# Canonical values follow scripts/sweep_resume.sh's step commands, not
+# argparse defaults (the sweep runs --iters 5 / --iters-rmse 12).
 _AB_BASE_DEFAULTS = {"cg_iters": 0, "cg_mode": "matfree",
                      "compute_dtype": "float32", "width_growth": 2.0,
-                     "solve_backend": "auto"}
+                     "solve_backend": "auto", "rank": 128}
+_AB_MODE_DEFAULTS = {"headline": {"iters": 5},
+                     "rmse": {"iters_rmse": 12, "reg": 0.02}}
 
 
-def _check_ab_bankable(args):
+def _check_ab_bankable(args, mode):
     """Banked evidence is keyed purely by spec name; a non-default base
     flag would leak into every non-overridden variant and file a
     measurement under a name that promises a different config (the
-    advisor's 'fabricated evidence' case).  Refuse up front."""
-    if not args.ab_dir:
+    advisor's 'fabricated evidence' case).  Refuse up front.
+
+    --small runs are exempt: _bank_variant never banks them, so no
+    mislabeled evidence is possible and a smoke run may use any
+    rank/iteration scale it likes."""
+    if not args.ab_dir or getattr(args, "small", False):
         return
-    off = {k: getattr(args, k, v) for k, v in _AB_BASE_DEFAULTS.items()
+    required = {**_AB_BASE_DEFAULTS, **_AB_MODE_DEFAULTS.get(mode, {})}
+    off = {k: getattr(args, k, v) for k, v in required.items()
            if getattr(args, k, v) != v}
     if off:
         raise SystemExit(
             f"--ab-dir banking requires canonical base flags; these are "
-            f"off-default: {off}.  Encode the config as an --ab spec "
+            f"off-canonical: {off}.  Encode the config as an --ab spec "
             "instead (e.g. cg2_bf16), or drop --ab-dir.")
 
 
@@ -467,13 +479,33 @@ def _already_banked(mode, spec, ab_dir):
     step for the same config — already banked this variant in its
     canonical log; a retry should spend its tunnel window only on the
     missing ones.  Small-scale smoke lines never count (their metric
-    carries the ``_small`` suffix)."""
+    carries the ``_small`` suffix), and neither does a line whose
+    recorded config contradicts the canonical one the file name promises
+    (a stale or mislabeled bank must not short-circuit a real retry)."""
     if not ab_dir:
         return None
     j = _last_json(_ab_log_path(mode, spec, ab_dir))
     ok = (j and j.get("value") is not None and not j.get("error")
           and not str(j.get("metric", "")).endswith("_small"))
-    return j if ok else None
+    if not ok:
+        return None
+    from tpu_als.io.movielens import ML25M_SHAPE
+
+    cfg = j.get("config", {}) or {}
+    canonical = {"rank": _AB_BASE_DEFAULTS["rank"],
+                 "users": ML25M_SHAPE[0], "items": ML25M_SHAPE[1]}
+    if mode == "rmse":
+        # the rmse config block records its iteration count and reg
+        # under these keys; a short-iteration or off-reg line must not
+        # stand in for the canonical 12-iter quality gate
+        canonical.update(iters=_AB_MODE_DEFAULTS["rmse"]["iters_rmse"],
+                         reg_param=_AB_MODE_DEFAULTS["rmse"]["reg"])
+    mismatch = {k: cfg[k] for k, v in canonical.items()
+                if cfg.get(k) is not None and cfg[k] != v}
+    if mismatch:
+        log(f"banked {spec} line ignored: config mismatch {mismatch}")
+        return None
+    return j
 
 
 def _run_ab(specs, measure, mode, metric, args, summary_key):
@@ -483,7 +515,7 @@ def _run_ab(specs, measure, mode, metric, args, summary_key):
     field: the sweep runner's done-check then retries the step instead of
     silently parking the lost variants (the banked ones are skipped on
     that retry, so a flap costs only the missing measurements)."""
-    _check_ab_bankable(args)
+    _check_ab_bankable(args, mode)
     primary, ab, failed = None, {}, []
     for spec, overrides in specs:
         # a --small smoke must actually RUN its variants — full-scale
@@ -1211,6 +1243,11 @@ def main():
         if picked is not None:
             for k, v in picked.items():
                 setattr(args, k, v)
+
+    if args.ab and args.ab_dir:
+        # refuse un-bankable base configs BEFORE burning a tunnel probe
+        # (the _run_ab-time call stays as the backstop for direct callers)
+        _check_ab_bankable(args, args.mode)
 
     metric, unit = {
         "headline": ("als_iters_per_sec_rank128_ml25m_implicit",

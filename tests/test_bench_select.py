@@ -288,15 +288,80 @@ def test_ab_banking_requires_canonical_base_flags():
     args = argparse.Namespace(ab="cg2", ab_dir="sweep_logs", small=False,
                               cg_iters=0, cg_mode="matfree",
                               compute_dtype="bfloat16", width_growth=2.0,
-                              solve_backend="auto")
+                              solve_backend="auto", rank=128, iters=5,
+                              iters_rmse=12, reg=0.02)
     try:
-        bench._check_ab_bankable(args)
+        bench._check_ab_bankable(args, "headline")
     except SystemExit as e:
         assert "compute_dtype" in str(e)
     else:
         raise AssertionError("off-default base flag must refuse banking")
     args.compute_dtype = "float32"
-    bench._check_ab_bankable(args)   # canonical defaults pass
+    bench._check_ab_bankable(args, "headline")   # canonical flags pass
     args.ab_dir = ""
     args.cg_iters = 2
-    bench._check_ab_bankable(args)   # no banking -> no constraint
+    bench._check_ab_bankable(args, "headline")   # no banking -> no check
+
+
+def test_ab_banking_guards_model_and_scale_flags():
+    """A rank-64 or short-iteration run banked under a canonical name
+    would read downstream as full-scale rank-128 evidence (advisor r4,
+    medium): every model/scale flag the name doesn't encode must sit at
+    the sweep's canonical value."""
+    import argparse
+
+    def mk(**kw):
+        base = dict(ab="cg2", ab_dir="d", small=False, cg_iters=0,
+                    cg_mode="matfree", compute_dtype="float32",
+                    width_growth=2.0, solve_backend="auto", rank=128,
+                    iters=5, iters_rmse=12, reg=0.02)
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    for mode, bad in [("headline", {"rank": 64}),
+                      ("headline", {"iters": 3}),
+                      ("rmse", {"rank": 64}),
+                      ("rmse", {"iters_rmse": 8}),
+                      ("rmse", {"reg": 0.1})]:
+        try:
+            bench._check_ab_bankable(mk(**bad), mode)
+        except SystemExit as e:
+            (key,) = bad
+            assert key in str(e)
+        else:
+            raise AssertionError(f"{mode} {bad} must refuse banking")
+    # iters is headline-only: an rmse run may carry any --iters value
+    bench._check_ab_bankable(mk(iters=3), "rmse")
+
+
+def test_already_banked_rejects_config_mismatch(tmp_path):
+    """A stale or mislabeled banked line (wrong rank or non-ML-25M
+    shape) must not short-circuit a real retry (advisor r4, low)."""
+    full = {"rank": 128, "users": 162541, "items": 59047}
+    _write(tmp_path, "headline_cg2",
+           {"value": 2.0, "metric": "m", "config": {**full, "rank": 64}})
+    assert bench._already_banked("headline", "cg2", str(tmp_path)) is None
+    _write(tmp_path, "headline_cg2",
+           {"value": 2.0, "metric": "m",
+            "config": {**full, "users": 6501, "items": 2361}})
+    assert bench._already_banked("headline", "cg2", str(tmp_path)) is None
+    _write(tmp_path, "headline_cg2",
+           {"value": 2.0, "metric": "m", "config": full})
+    got = bench._already_banked("headline", "cg2", str(tmp_path))
+    assert got is not None and got["value"] == 2.0
+    # a legacy line with no config fields cannot contradict -> accepted
+    _write(tmp_path, "headline_cg3", {"value": 3.0, "metric": "m"})
+    assert bench._already_banked(
+        "headline", "cg3", str(tmp_path))["value"] == 3.0
+    # rmse mode additionally pins its iteration count and reg: a short
+    # 8-iter (or off-reg) line must not stand in for the 12-iter gate
+    rcfg = {"rank": 128, "users": 162541, "items": 59047,
+            "iters": 12, "reg_param": 0.02}
+    for bad in ({"iters": 8}, {"reg_param": 0.1}):
+        _write(tmp_path, "rmse_cg2",
+               {"value": 0.44, "metric": "m", "config": {**rcfg, **bad}})
+        assert bench._already_banked("rmse", "cg2", str(tmp_path)) is None
+    _write(tmp_path, "rmse_cg2",
+           {"value": 0.44, "metric": "m", "config": rcfg})
+    assert bench._already_banked(
+        "rmse", "cg2", str(tmp_path))["value"] == 0.44

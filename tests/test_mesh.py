@@ -121,6 +121,8 @@ def test_multi_slice_training_matches_flat_mesh(_flat_baseline, n_slices,
     U1, V1 = train_sharded(mesh, upart, ipart, ush, ish, cfg)
     np.testing.assert_allclose(np.asarray(U1), U0, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(V1), V0, rtol=1e-5, atol=1e-5)
+
+
 def test_make_mesh_rejects_overask():
     import pytest
 
@@ -128,58 +130,3 @@ def test_make_mesh_rejects_overask():
 
     with pytest.raises(ValueError, match="silently smaller mesh"):
         make_mesh(99)
-
-
-def test_four_slice_mesh_orders_and_bounds():
-    # 4 slices x 2 devices: more DCN boundaries than the 2-slice case,
-    # and a reversed/shuffled enumeration — slice-major regrouping must
-    # still produce contiguous slices with exactly slice_count-1
-    # boundaries, each at a multiple of the slice size
-    import jax
-
-    from tpu_als.parallel.mesh import simulated_slice_of
-
-    pool = jax.devices()[:8]
-    slice_of = simulated_slice_of(4, pool)
-    shuffled = [pool[k] for k in (7, 2, 5, 0, 3, 6, 1, 4)]
-    mesh = make_mesh(devices=shuffled, slice_of=slice_of)
-    order = [slice_of(d) for d in mesh.devices.flat]
-    assert order == [0, 0, 1, 1, 2, 2, 3, 3], order
-    assert slice_boundaries(list(mesh.devices.flat), slice_of) == [2, 4, 6]
-
-
-def test_four_slice_training_matches_flat_mesh(rng):
-    """The §5.8 equivalence pin at 4 simulated slices: every gather
-    strategy's collectives cross 3 DCN boundaries and the result must
-    still equal the flat mesh's."""
-    import jax
-    import numpy as np
-
-    from tpu_als.core.als import AlsConfig
-    from tpu_als.parallel.data import partition_balanced, shard_csr
-    from tpu_als.parallel.mesh import simulated_slice_of
-    from tpu_als.parallel.trainer import train_sharded
-
-    nU, nI, nnz, D = 40, 30, 500, 8
-    u = rng.integers(0, nU, nnz)
-    i = rng.integers(0, nI, nnz)
-    r = np.abs(rng.normal(size=nnz)).astype(np.float32) + 0.1
-    upart = partition_balanced(np.bincount(u, minlength=nU), D)
-    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
-    ush = shard_csr(upart, ipart, u, i, r, min_width=4)
-    ish = shard_csr(ipart, upart, i, u, r, min_width=4)
-    cfg = AlsConfig(rank=4, max_iter=2, reg_param=0.05,
-                    implicit_prefs=True, alpha=2.0, seed=0)
-
-    flat = make_mesh(D)
-    U0, V0 = train_sharded(flat, upart, ipart, ush, ish, cfg)
-
-    pool = jax.devices()[:D]
-    shuffled = [pool[k] for k in (7, 2, 5, 0, 3, 6, 1, 4)]
-    mesh4 = make_mesh(devices=shuffled,
-                      slice_of=simulated_slice_of(4, pool))
-    U1, V1 = train_sharded(mesh4, upart, ipart, ush, ish, cfg)
-    np.testing.assert_allclose(np.asarray(U1), np.asarray(U0),
-                               rtol=1e-5, atol=1e-5)
-    np.testing.assert_allclose(np.asarray(V1), np.asarray(V0),
-                               rtol=1e-5, atol=1e-5)

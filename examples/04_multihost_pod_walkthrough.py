@@ -1,0 +1,118 @@
+"""Multi-host pod walkthrough: per-host streaming ingest -> agreed entity
+space -> cross-process training -> sharded factors.
+
+What a real TPU pod deployment looks like, runnable on one machine: this
+script SPAWNS two worker processes that rendezvous over gloo (exactly how
+pod hosts rendezvous over DCN — same `jax.distributed` contract, same
+`tpu_als` code path; on a pod you simply run the worker body on every
+host and delete the spawning).
+
+The flow each "host" runs (the config-3 data plane, SURVEY.md §6 row 3):
+
+1. `stream_ingest(path, host_index, num_hosts)` — stream ONLY its byte
+   range of a shared string-id ratings csv, in bounded chunks, through
+   the native interner.  No host ever parses another host's rows.
+2. `global_vocab_union(labels)` — one collective agrees the global
+   (lexicographic) entity space from the per-host vocabularies; the
+   local->global remap is a `searchsorted` + gather.
+3. `train_multihost(u, i, r, ...)` — per-host triples redistribute to
+   their owning shards and ALS trains with XLA collectives crossing the
+   process boundary; every host ends with its addressable factor shards.
+4. Each host writes ITS shard of the model (`save_factors` shard-per-
+   process checkpoints work the same way).
+
+Run:  python examples/04_multihost_pod_walkthrough.py
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def worker():
+    """The body every pod host runs."""
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    import numpy as np
+
+    from tpu_als.core.als import AlsConfig
+    from tpu_als.io.stream import stream_ingest
+    from tpu_als.parallel.mesh import make_mesh
+    from tpu_als.parallel.multihost import (
+        global_vocab_union, init_distributed, train_multihost)
+
+    pid, pcount = init_distributed()   # rendezvous (env-var contract)
+    mesh = make_mesh()                 # all devices, slice-major order
+
+    # 1. stream my byte range only
+    u_loc, i_loc, r, ul, il = stream_ingest(
+        os.environ["POD_CSV"], pid, pcount, require_cols=4,
+        skip_header=1, chunk_bytes=1 << 20)
+    print(f"[host {pid}] streamed {len(r):,} rows, "
+          f"{len(ul):,} local users, {len(il):,} local items", flush=True)
+
+    # 2. agree the global entity space (labels move, ratings never do)
+    g_ul, g_il = global_vocab_union(ul), global_vocab_union(il)
+    u = np.searchsorted(g_ul, ul)[u_loc]
+    i = np.searchsorted(g_il, il)[i_loc]
+    print(f"[host {pid}] global space: {len(g_ul):,} users x "
+          f"{len(g_il):,} items", flush=True)
+
+    # 3. train across processes
+    cfg = AlsConfig(rank=16, max_iter=5, reg_param=0.02,
+                    implicit_prefs=True, alpha=10.0, seed=0)
+    U, V, upart, ipart = train_multihost(
+        u, i, r, len(g_ul), len(g_il), cfg, mesh=mesh)
+
+    # 4. my addressable shards ARE my part of the model
+    mine = [s.index[0] for s in U.addressable_shards]
+    print(f"[host {pid}] owns U row-slices "
+          f"{[(sl.start or 0, sl.stop) for sl in mine]}", flush=True)
+
+
+def main():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    nU, nI, nnz = 600, 200, 20_000
+    with tempfile.TemporaryDirectory() as td:
+        csv = os.path.join(td, "ratings.csv")
+        with open(csv, "w") as f:
+            f.write("user_id,parent_asin,rating,timestamp\n")
+            for k in range(nnz):
+                f.write(f"A{rng.integers(nU):09X},"
+                        f"B{rng.integers(nI):07X},"
+                        f"{rng.integers(1, 11) / 2.0},1600000000\n")
+        print(f"shared ratings file: {nnz:,} rows, string ids")
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = []
+        for pid in range(2):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env.update(JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                       JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
+                       POD_ROLE="worker", POD_CSV=csv)
+            procs.append(subprocess.Popen([sys.executable, __file__],
+                                          env=env))
+        rc = [p.wait(timeout=600) for p in procs]
+        if any(rc):
+            raise SystemExit(f"worker failed: {rc}")
+        print("both hosts done — factors live sharded across processes")
+
+
+if __name__ == "__main__":
+    if os.environ.get("POD_ROLE") == "worker":
+        worker()
+    else:
+        main()

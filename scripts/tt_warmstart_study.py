@@ -1,0 +1,158 @@
+"""Two-tower warm-start study: where (if anywhere) does ALS warm-start win?
+
+VERDICT r4 #3: at full bench scale the raw warm−cold gap is a wash
+(−0.03…+0.004), while round 2's small-scale run showed warm +0.154 at
+1 epoch — suggesting a low-data / few-epoch operating regime.  This
+study sweeps that regime directly:
+
+  data fraction × variant {cold, warm, warm_slow(0.1), warm_frozen}
+  with recall evaluated at several epoch checkpoints per run (one
+  training run per cell via the epoch callback — no retrain per point).
+
+Every variant of a cell sees the SAME subsampled train pairs and the
+same filtered-protocol eval (train items banned per user); ALS warm
+factors are trained on the cell's subsample only (the warm start may
+not peek at data the tower can't see).  Reported recall is the deployed
+configuration (serving-time popularity prior from the cell's counts) —
+the raw no-prior number rides along for reference.
+
+Usage:
+  python scripts/tt_warmstart_study.py                    # full sweep
+  python scripts/tt_warmstart_study.py --fractions 0.05 --epochs 2
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fractions", type=float, nargs="+",
+                    default=[0.05, 0.15, 0.4])
+    ap.add_argument("--epochs", type=int, default=5,
+                    help="train this many; evaluate at --eval-epochs")
+    ap.add_argument("--eval-epochs", type=int, nargs="+",
+                    default=[1, 2, 3, 5])
+    ap.add_argument("--users", type=int, default=20000)
+    ap.add_argument("--items", type=int, default=4000)
+    ap.add_argument("--nnz", type=int, default=800_000)
+    ap.add_argument("--out", default="tt_warmstart_study.json")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_als.core.als import AlsConfig, train
+    from tpu_als.core.ratings import build_csr_buckets
+    from tpu_als.io.movielens import synthetic_movielens
+    from tpu_als.models.two_tower import (
+        TwoTowerConfig,
+        recall_at_k,
+        serving_bias,
+        train_two_tower,
+    )
+
+    nU, nI = args.users, args.items
+    frame, _, _ = synthetic_movielens(nU, nI, args.nnz, seed=0,
+                                      return_factors=True)
+    u = np.asarray(frame["user"])
+    i = np.asarray(frame["item"])
+    r = np.asarray(frame["rating"])
+    pos = r >= 3.5
+    u, i, r = u[pos], i[pos], r[pos]
+    rng = np.random.default_rng(2)
+    test = rng.random(len(u)) < 0.1
+    ut, it_ = u[test], i[test]
+    u2, i2, r2 = u[~test], i[~test], r[~test]
+
+    results = []
+    for frac in args.fractions:
+        keep = rng.random(len(u2)) < frac
+        su, si, sr = u2[keep], i2[keep], r2[keep]
+        # filtered protocol vs THIS cell's train set; drop test pairs
+        # duplicated in it (banned item = structural miss)
+        key = ut.astype(np.int64) * nI + it_
+        train_key = np.unique(su.astype(np.int64) * nI + si)
+        fresh = ~np.isin(key, train_key)
+        eu, ei = ut[fresh], it_[fresh]
+        counts = np.bincount(si, minlength=nI).astype(np.float64)
+        bias = serving_bias(counts, temperature=0.1)
+
+        # ALS warm factors from the subsample only
+        ucsr = build_csr_buckets(su, si, sr, nU)
+        icsr = build_csr_buckets(si, su, sr, nI)
+        t0 = time.time()
+        U, V = train(ucsr, icsr, AlsConfig(
+            rank=32, max_iter=8, reg_param=0.02, implicit_prefs=True,
+            alpha=40.0, seed=0))
+        als_seconds = time.time() - t0
+        U, V = np.asarray(U), np.asarray(V)
+
+        variants = {
+            "cold": dict(warm=False, scale=1.0),
+            "warm": dict(warm=True, scale=1.0),
+            "warm_slow": dict(warm=True, scale=0.1),
+            "warm_frozen": dict(warm=True, scale=0.0),
+        }
+        for name, v in variants.items():
+            cfg = TwoTowerConfig(epochs=args.epochs, seed=0,
+                                 embed_lr_scale=v["scale"])
+            curve = {}
+
+            def snap(epoch, loss, params, curve=curve, bias=bias,
+                     eu=eu, ei=ei, su=su, si=si):
+                if epoch in args.eval_epochs:
+                    curve[epoch] = {
+                        "prior": round(recall_at_k(
+                            params, eu, ei, k=10, exclude=(su, si),
+                            item_bias=bias), 4),
+                        "raw": round(recall_at_k(
+                            params, eu, ei, k=10, exclude=(su, si)), 4),
+                    }
+
+            t0 = time.time()
+            train_two_tower(
+                su, si, nU, nI, cfg,
+                als_user_factors=U if v["warm"] else None,
+                als_item_factors=V if v["warm"] else None,
+                callback=snap)
+            row = {"fraction": frac, "variant": name,
+                   "train_pairs": int(len(su)),
+                   "eval_pairs": int(len(eu)),
+                   "als_seconds": round(als_seconds, 1),
+                   "train_seconds": round(time.time() - t0, 1),
+                   "recall_by_epoch": curve}
+            results.append(row)
+            print(json.dumps(row), flush=True)
+
+    # headline: largest (warm* − cold) prior-config gap at any
+    # (fraction, epoch), which is the candidate operating point
+    best = None
+    by_cell = {(r0["fraction"], r0["variant"]): r0 for r0 in results}
+    for frac in args.fractions:
+        cold = by_cell[(frac, "cold")]["recall_by_epoch"]
+        for name in ("warm", "warm_slow", "warm_frozen"):
+            wcur = by_cell[(frac, name)]["recall_by_epoch"]
+            for ep in wcur:
+                gap = wcur[ep]["prior"] - cold[ep]["prior"]
+                if best is None or gap > best["gap"]:
+                    best = {"gap": round(gap, 4), "fraction": frac,
+                            "variant": name, "epoch": ep,
+                            "warm_prior": wcur[ep]["prior"],
+                            "cold_prior": cold[ep]["prior"]}
+    out = {"results": results, "best_warm_gap": best}
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"best_warm_gap": best}))
+
+
+if __name__ == "__main__":
+    main()

@@ -88,8 +88,45 @@ def main_serve():
     print(f"serve worker {pid} ok", flush=True)
 
 
+def main_stream_vocab():
+    """The full config-3 flow across REAL processes: each host streams
+    its byte range of a shared STRING-id csv (io/stream.py), the
+    vocabularies are agreed with global_vocab_union, and the remapped
+    per-host triples train through train_multihost — no host ever parses
+    the other's rows."""
+    pid, pcount = init_distributed()
+    assert pcount == 2, pcount
+    mesh = make_mesh()
+
+    from tpu_als.io.stream import stream_ingest
+    from tpu_als.parallel.multihost import global_vocab_union
+
+    u_loc, i_loc, r, ul, il = stream_ingest(
+        os.environ["MH_CSV"], pid, pcount, chunk_bytes=97)
+    g_ul = global_vocab_union(ul)
+    g_il = global_vocab_union(il)
+    # lexicographic global space -> remap is one searchsorted per side
+    u = np.searchsorted(g_ul, ul)[u_loc]
+    i = np.searchsorted(g_il, il)[i_loc]
+    cfg = AlsConfig(rank=4, max_iter=2, reg_param=0.05,
+                    implicit_prefs=True, alpha=3.0, seed=0)
+    U, V, upart, ipart = train_multihost(
+        u, i, r, len(g_ul), len(g_il), cfg, mesh=mesh, min_width=4)
+    out = {"g_ul": g_ul.astype("S16"), "g_il": g_il.astype("S16"),
+           "rows": np.array([len(u_loc)])}
+    for name, arr, rps in (("U", U, upart.rows_per_shard),
+                           ("V", V, ipart.rows_per_shard)):
+        for s in arr.addressable_shards:
+            pos = s.index[0].start // rps if s.index[0].start else 0
+            out[f"{name}{pos}"] = np.asarray(s.data)
+    np.savez(os.environ["MH_OUT"] + f".{pid}.npz", **out)
+    print(f"stream-vocab worker {pid} ok", flush=True)
+
+
 if __name__ == "__main__":
     if os.environ.get("MH_MODE") == "serve":
         main_serve()
+    elif os.environ.get("MH_MODE") == "stream_vocab":
+        main_stream_vocab()
     else:
         main()

@@ -26,7 +26,8 @@ def _run_example(name, extra_env=None, timeout=500):
 
 @pytest.mark.parametrize("name", ["01_movielens_basic.py",
                                   "02_pipeline_string_ids.py",
-                                  "03_distributed_and_streaming.py"])
+                                  "03_distributed_and_streaming.py",
+                                  "04_multihost_pod_walkthrough.py"])
 def test_example_compiles(name):
     import py_compile
 
@@ -52,3 +53,12 @@ def test_distributed_example_runs_on_forced_mesh():
     assert p.returncode == 0, p.stderr[-2000:]
     assert "mesh: 8" in p.stdout
     assert "ring strategy" in p.stdout and "no refit" in p.stdout
+
+
+def test_multihost_pod_walkthrough_runs_end_to_end():
+    """examples/04: two spawned gloo processes, per-host streaming
+    ingest, vocab-union, cross-process training."""
+    p = _run_example("04_multihost_pod_walkthrough.py", timeout=540)
+    assert p.returncode == 0, (p.stdout[-1000:], p.stderr[-2000:])
+    assert "global space: 600 users x 200 items" in p.stdout
+    assert "both hosts done" in p.stdout

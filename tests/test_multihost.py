@@ -560,3 +560,68 @@ def test_two_process_sharded_serving_matches_single(tmp_path):
         assert not np.isnan(got_s).any(), f"{strategy}: missing rows"
         np.testing.assert_allclose(got_s, ref_s, rtol=1e-5, atol=1e-6)
         np.testing.assert_array_equal(got_i, ref_i)
+
+
+def test_two_process_streaming_string_ingest_matches_single(tmp_path):
+    """The whole config-3 flow across REAL processes: byte-range
+    streaming ingest of a STRING-id csv per host, global_vocab_union to
+    agree the entity space, train_multihost over gloo — the factors must
+    equal a single-process fit of the whole file (SURVEY.md §6 row 3)."""
+    import os
+
+    from tpu_als.core.als import AlsConfig
+    from tpu_als.parallel.multihost import train_multihost
+
+    rng = np.random.default_rng(5)
+    nU, nI, nnz = 40, 25, 500
+    uu = rng.integers(0, nU, nnz)
+    ii = rng.integers(0, nI, nnz)
+    # half-star ratings: exact in float32, so the worker's strtof and
+    # the reference's python-float path cannot differ by an ulp
+    rr = (rng.integers(1, 10, nnz) / 2.0).astype(np.float32)
+    lines = [f"user_{uu[k]:03d},B{ii[k]:04d},{rr[k]}" for k in range(nnz)]
+    csv = tmp_path / "pod.csv"
+    csv.write_text("\n".join(lines) + "\n")
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_worker.py")
+    out = str(tmp_path / "sv")
+    _spawn_two_procs(worker, {"MH_OUT": out, "MH_MODE": "stream_vocab",
+                              "MH_CSV": str(csv)})
+
+    # single-process reference: trivial whole-file parse, same
+    # (lexicographic) global id space, same trainer on a 4-device mesh
+    g_ul = np.unique(np.array([f"user_{k:03d}" for k in uu], dtype="S"))
+    g_il = np.unique(np.array([f"B{k:04d}" for k in ii], dtype="S"))
+    u = np.searchsorted(g_ul, np.array(
+        [f"user_{k:03d}" for k in uu], dtype="S"))
+    i = np.searchsorted(g_il, np.array(
+        [f"B{k:04d}" for k in ii], dtype="S"))
+    cfg = AlsConfig(rank=4, max_iter=2, reg_param=0.05,
+                    implicit_prefs=True, alpha=3.0, seed=0)
+    U, V, upart, ipart = train_multihost(
+        u, i, rr, len(g_ul), len(g_il), cfg, mesh=make_mesh(4),
+        min_width=4)
+    U, V = np.asarray(U), np.asarray(V)
+
+    rps_u, rps_i = upart.rows_per_shard, ipart.rows_per_shard
+    seen, rows_total = set(), 0
+    for pid in range(2):
+        dat = np.load(f"{out}.{pid}.npz")
+        # both processes computed the identical global vocabularies
+        np.testing.assert_array_equal(
+            dat["g_ul"], g_ul.astype("S16"))
+        np.testing.assert_array_equal(
+            dat["g_il"], g_il.astype("S16"))
+        rows_total += int(dat["rows"][0])
+        for kname in dat.files:
+            if kname[0] not in "UV" or not kname[1:].isdigit():
+                continue
+            side, pos = kname[0], int(kname[1:])
+            seen.add((side, pos))
+            ref = (U[pos * rps_u:(pos + 1) * rps_u] if side == "U"
+                   else V[pos * rps_i:(pos + 1) * rps_i])
+            np.testing.assert_allclose(dat[kname], ref, rtol=2e-5,
+                                       atol=2e-5, err_msg=kname)
+    assert rows_total == nnz  # every line landed on exactly one host
+    assert seen == {(s, p) for s in "UV" for p in range(4)}

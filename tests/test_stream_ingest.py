@@ -1,0 +1,215 @@
+"""Config-3 streaming data plane: chunked string-id ingest correctness.
+
+The protocol under test (io/stream.py): host byte-ranges with
+straddling-line ownership, chunk re-stitching, native interning, and
+cross-host vocabulary merge — every rating lands exactly once with a
+globally consistent id, for ANY host count and chunk size (SURVEY.md §6
+row 3; VERDICT r4 next-round #4).
+"""
+
+import numpy as np
+import pytest
+
+from tpu_als.io.stream import (
+    host_byte_range,
+    ingest_per_host,
+    merge_vocabularies,
+    stream_ingest,
+)
+
+
+def _reference_rows(text, require_cols=3, skip_header=0):
+    rows = []
+    for k, line in enumerate(text.split("\n")):
+        line = line.rstrip("\r")
+        if k < skip_header or not line.strip():
+            continue
+        parts = line.split(",")
+        assert len(parts) == require_cols
+        rows.append((parts[0], parts[1], float(parts[2])))
+    return rows
+
+
+def _make_file(tmp_path, n=3000, seed=0, header=False, cols=3):
+    rng = np.random.default_rng(seed)
+    users = [f"u{chr(97 + k % 7)}_{k % 211}" for k in range(n)]
+    items = [f"B{k % 83:07d}" for k in range(n)]
+    rng.shuffle(users)
+    lines = []
+    if header:
+        lines.append("user_id,parent_asin,rating,timestamp"[:None])
+    for k in range(n):
+        tail = ",1609459200" if cols == 4 else ""
+        lines.append(f"{users[k]},{items[k]},{(k % 9) / 2 + 0.5}{tail}")
+    path = tmp_path / "ratings.csv"
+    path.write_text("\n".join(lines) + "\n")
+    return str(path), "\n".join(lines) + "\n"
+
+
+def _assemble(splits, user_labels, item_labels):
+    rows = []
+    for u, i, r in splits:
+        for k in range(len(u)):
+            rows.append((user_labels[u[k]].decode(),
+                         item_labels[i[k]].decode(),
+                         float(np.float32(r[k]))))
+    return rows
+
+
+@pytest.mark.parametrize("num_hosts", [1, 2, 3, 5, 8])
+def test_every_rating_lands_exactly_once(tmp_path, num_hosts):
+    path, text = _make_file(tmp_path, n=1200)
+    ref = _reference_rows(text)
+    splits, ul, il = ingest_per_host(path, num_hosts,
+                                     chunk_bytes=257)
+    got = _assemble(splits, ul, il)
+    assert got == [(u, i, float(np.float32(r))) for u, i, r in ref]
+
+
+def test_tiny_chunks_stitch_lines(tmp_path):
+    # chunk smaller than a line: every line crosses >=1 chunk boundary
+    path, text = _make_file(tmp_path, n=200)
+    ref = _reference_rows(text)
+    splits, ul, il = ingest_per_host(path, 3, chunk_bytes=7)
+    assert _assemble(splits, ul, il) == [
+        (u, i, float(np.float32(r))) for u, i, r in ref]
+
+
+def test_amazon_schema_four_cols_and_header(tmp_path):
+    path, text = _make_file(tmp_path, n=400, header=True, cols=4)
+    ref = _reference_rows(text, require_cols=4, skip_header=1)
+    splits, ul, il = ingest_per_host(path, 4, require_cols=4,
+                                     skip_header=1, chunk_bytes=101)
+    assert _assemble(splits, ul, il) == [
+        (u, i, float(np.float32(r))) for u, i, r in ref]
+
+
+def test_more_hosts_than_bytes(tmp_path):
+    path = tmp_path / "tiny.csv"
+    path.write_text("a,b,1.0\n")
+    splits, ul, il = ingest_per_host(str(path), 64)
+    got = _assemble(splits, ul, il)
+    assert got == [("a", "b", 1.0)]
+
+
+def test_crlf_and_missing_final_newline(tmp_path):
+    path = tmp_path / "crlf.csv"
+    path.write_bytes(b"ux,iy,2.5\r\nuz,iw,3.0")
+    for hosts in (1, 2, 3):
+        splits, ul, il = ingest_per_host(str(path), hosts)
+        assert _assemble(splits, ul, il) == [("ux", "iy", 2.5),
+                                             ("uz", "iw", 3.0)]
+
+
+def test_unicode_ids_roundtrip(tmp_path):
+    path = tmp_path / "uni.csv"
+    path.write_text("amélie,書籍B01,4.5\namélie,ítem-2,1.0\n",
+                    encoding="utf-8")
+    from tpu_als.io.stream import decode_labels
+
+    (u, i, r, ul, il) = stream_ingest(str(path))
+    assert decode_labels(ul) == ["amélie"]
+    assert decode_labels(il) == ["書籍B01", "ítem-2"]
+    assert u.tolist() == [0, 0] and i.tolist() == [0, 1]
+
+
+@pytest.mark.parametrize("bad", [
+    '"quoted",item,3.0',          # quoted id
+    "user,,3.0",                  # empty item id
+    ",item,3.0",                  # empty user id
+    "user,item,notafloat",        # unparseable rating
+    "user,item,nan",              # non-finite rating
+    "user,item,3.0,extra",        # too many columns (require_cols=3)
+    "user,item",                  # too few columns
+])
+def test_malformed_lines_raise(tmp_path, bad):
+    path = tmp_path / "bad.csv"
+    path.write_text(f"ok_user,ok_item,2.0\n{bad}\n")
+    with pytest.raises(ValueError, match="malformed ratings line"):
+        stream_ingest(str(path))
+
+
+def test_too_few_columns_for_amazon_schema(tmp_path):
+    path = tmp_path / "bad4.csv"
+    path.write_text("u,i,3.0\n")
+    with pytest.raises(ValueError, match="malformed ratings line"):
+        stream_ingest(str(path), require_cols=4)
+
+
+def test_empty_file(tmp_path):
+    path = tmp_path / "empty.csv"
+    path.write_text("")
+    u, i, r, ul, il = stream_ingest(str(path))
+    assert len(u) == len(i) == len(r) == len(ul) == len(il) == 0
+
+
+def test_host_byte_range_partitions_exactly():
+    for size in (0, 1, 99, 100, 101):
+        for hosts in (1, 2, 3, 7):
+            ranges = [host_byte_range(size, k, hosts)
+                      for k in range(hosts)]
+            assert ranges[0][0] == 0 and ranges[-1][1] == size
+            for (a, b), (c, d) in zip(ranges, ranges[1:]):
+                assert b == c
+
+
+def test_merge_vocabularies_lexicographic_and_remap():
+    labels, remaps = merge_vocabularies(
+        [["a", "bb"], ["bb", "c", "a"], [], ["d"]])
+    assert labels.tolist() == [b"a", b"bb", b"c", b"d"]
+    assert remaps[0].tolist() == [0, 1]
+    assert remaps[1].tolist() == [1, 2, 0]
+    assert remaps[2].tolist() == []
+    assert remaps[3].tolist() == [3]
+
+
+def test_streamed_ids_feed_string_indexer_model(tmp_path):
+    from tpu_als import ColumnarFrame
+    from tpu_als.api.pipeline import StringIndexerModel
+    from tpu_als.io.stream import decode_labels
+
+    path, text = _make_file(tmp_path, n=300)
+    splits, ul, il = ingest_per_host(path, 2, chunk_bytes=64)
+    m = StringIndexerModel.from_labels(decode_labels(ul),
+                                       inputCol="user_id",
+                                       outputCol="user")
+    # the model's transform must agree with the streaming dense ids
+    ref = _reference_rows(text)
+    frame = ColumnarFrame(
+        {"user_id": np.array([u for u, _, _ in ref], dtype=object)})
+    out = m.transform(frame)
+    merged_u = np.concatenate([s[0] for s in splits])
+    np.testing.assert_array_equal(
+        np.asarray(out["user"], dtype=np.int64), merged_u)
+
+
+def test_per_host_splits_train_like_the_whole_file(tmp_path, rng):
+    """End-to-end config-3 plumbing: streamed per-host splits with
+    globally-merged ids produce the same fit as the whole file parsed at
+    once (single-process dataMode='per_host' degenerates to one split —
+    the equivalence pin is on ids and ratings, trained to convergence)."""
+    from tpu_als import ALS, ColumnarFrame
+
+    n = 600
+    path, text = _make_file(tmp_path, n=n, seed=3)
+    splits, ul, il = ingest_per_host(path, 3, chunk_bytes=128)
+    u = np.concatenate([s[0] for s in splits])
+    i = np.concatenate([s[1] for s in splits])
+    r = np.concatenate([s[2] for s in splits])
+    ref = _reference_rows(text)
+    # dense ids must cover [0, n_labels) with no gaps
+    assert set(u.tolist()) == set(range(len(ul)))
+    assert set(i.tolist()) == set(range(len(il)))
+    als = ALS(rank=4, maxIter=3, regParam=0.05, seed=0,
+              dataMode="per_host")
+    m1 = als.fit(ColumnarFrame({"user": u, "item": i, "rating": r}))
+    # same data, parsed trivially
+    lab_u = {s.decode(): k for k, s in enumerate(ul.tolist())}
+    lab_i = {s.decode(): k for k, s in enumerate(il.tolist())}
+    u2 = np.array([lab_u[a] for a, _, _ in ref], dtype=np.int64)
+    i2 = np.array([lab_i[b] for _, b, _ in ref], dtype=np.int64)
+    r2 = np.array([c for _, _, c in ref], dtype=np.float32)
+    m2 = ALS(rank=4, maxIter=3, regParam=0.05, seed=0).fit(
+        ColumnarFrame({"user": u2, "item": i2, "rating": r2}))
+    np.testing.assert_allclose(m1._U, m2._U, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(m1._V, m2._V, rtol=1e-5, atol=1e-6)

@@ -221,3 +221,27 @@ def test_two_tower_save_load_roundtrip(rng, tmp_path):
     r1 = recall_at_k(params, u[:100], i[:100], k=5)
     r2 = recall_at_k(p2, u[:100], i[:100], k=5)
     assert r1 == r2
+
+
+def test_embed_lr_scale_freezes_and_slows_tables(rng):
+    import numpy as np
+
+    u, i, Ustar, Vstar = _interactions(rng)
+    base = dict(embed_dim=4, hidden=(16,), out_dim=4, epochs=3,
+                batch_size=256, seed=0)
+    frozen = train_two_tower(
+        u, i, 60, 40, TwoTowerConfig(**base, embed_lr_scale=0.0),
+        als_user_factors=Ustar, als_item_factors=Vstar)
+    # frozen: tables still exactly the warm start, towers trained
+    np.testing.assert_array_equal(
+        np.asarray(frozen["user_embed"])[:, :4], Ustar)
+    assert np.abs(np.asarray(frozen["user_tower"][0]["w"])).sum() > 0
+    slow = train_two_tower(
+        u, i, 60, 40, TwoTowerConfig(**base, embed_lr_scale=0.1),
+        als_user_factors=Ustar, als_item_factors=Vstar)
+    full = train_two_tower(
+        u, i, 60, 40, TwoTowerConfig(**base),
+        als_user_factors=Ustar, als_item_factors=Vstar)
+    drift = lambda p: float(  # noqa: E731
+        np.abs(np.asarray(p["user_embed"])[:, :4] - Ustar).mean())
+    assert 0 < drift(slow) < drift(full)

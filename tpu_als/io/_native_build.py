@@ -1,0 +1,35 @@
+"""Shared lazy g++ build for the native IO libraries.
+
+One implementation of the build-if-stale pattern (fastcsv, fastbucket,
+streamcsv): compile to a private temp file and ``os.rename`` into place,
+so two processes racing to build (e.g. both pod workers of
+``examples/04`` starting on a clean checkout) can never dlopen a
+partially written .so — rename is atomic within a directory, and the
+loser's rename simply replaces the winner's identical artifact.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+
+def build_native(src, lib, extra_flags=()):
+    """Build ``src`` -> ``lib`` with g++ if missing or stale."""
+    if (os.path.exists(lib)
+            and os.path.getmtime(lib) >= os.path.getmtime(src)):
+        return
+    fd, tmp = tempfile.mkstemp(
+        suffix=".so", prefix=os.path.basename(lib) + ".",
+        dir=os.path.dirname(lib))
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", *extra_flags, src,
+             "-o", tmp],
+            check=True, capture_output=True)
+        os.rename(tmp, lib)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)

@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
+
 
 import numpy as np
+
+from tpu_als.io._native_build import build_native
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _SRC = os.path.join(_NATIVE_DIR, "bucketize.cc")
@@ -26,27 +28,11 @@ _I32P = ctypes.POINTER(ctypes.c_int32)
 _F32P = ctypes.POINTER(ctypes.c_float)
 
 
-def _build():
-    # compile to a temp name + atomic rename: a concurrent builder or a
-    # killed g++ must never expose a partial .so at the final path (which
-    # would also poison the mtime staleness check)
-    tmp = f"{_LIB}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(tmp, _LIB)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-
-
 def load():
     global _lib
     if _lib is not None:
         return _lib
-    if (not os.path.exists(_LIB)
-            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
-        _build()
+    build_native(_SRC, _LIB, extra_flags=("-pthread",))
     lib = ctypes.CDLL(_LIB)
     lib.bucketize_count.restype = None
     lib.bucketize_count.argtypes = [

@@ -10,9 +10,10 @@ from __future__ import annotations
 import ctypes
 import mmap
 import os
-import subprocess
 
 import numpy as np
+
+from tpu_als.io._native_build import build_native
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _SRC = os.path.join(_NATIVE_DIR, "fastcsv.cc")
@@ -21,18 +22,11 @@ _LIB = os.path.join(_NATIVE_DIR, "libfastcsv.so")
 _lib = None
 
 
-def _build():
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", _LIB]
-    subprocess.run(cmd, check=True, capture_output=True)
-
-
 def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if (not os.path.exists(_LIB)
-            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
-        _build()
+    build_native(_SRC, _LIB, extra_flags=("-pthread",))
     lib = ctypes.CDLL(_LIB)
     lib.fastcsv_count.restype = ctypes.c_int64
     lib.fastcsv_count.argtypes = [ctypes.c_char_p, ctypes.c_int64,

@@ -1,0 +1,258 @@
+"""Chunked/streaming string-id ratings ingest — the config-3 data plane.
+
+The Amazon-Reviews-2023-shaped workload (SURVEY.md §6 row 3) is a ratings
+file with STRING user/item ids at a scale (~570M rows) where no single
+host may materialize the whole rating set.  This module is the host-side
+plane that feeds ``ALS(dataMode='per_host')``:
+
+- :func:`stream_ingest` — ONE host's view: stream the host's byte range
+  of the file in bounded chunks through the native interner
+  (``native/streamcsv.cc``), producing dense local int64 ids + the local
+  vocabulary in first-seen order.  Peak memory is one chunk buffer plus
+  this host's output arrays — never the full file, never another host's
+  rows.
+- :func:`merge_vocabularies` — union per-host vocabularies into a global
+  id space (lexicographic — a pure function of the label SET, so every
+  host computes the identical map) and the per-host ``local id ->
+  global id`` gathers.
+- :func:`ingest_per_host` — the single-process harness that runs every
+  host's stream (used by tests and the ingest benchmark; a real pod runs
+  one :func:`stream_ingest` per process and exchanges only vocabularies,
+  which are ~|distinct ids|, not ~|ratings|).
+
+Byte-range protocol (the classic split-reader contract): host ``k`` owns
+the lines whose first byte falls in its range.  A line straddling a range
+boundary belongs to the host where it STARTS; the next host skips through
+the first newline at-or-after its range start.  Chunk reads within a host
+re-stitch the partial line left at each chunk's tail, so the native layer
+only ever sees whole lines.
+
+The string labels feed the standard indexer surface:
+``StringIndexerModel.from_labels(decode_labels(user_labels))`` gives the
+same transform/inverse path a small-data ``StringIndexer().fit`` would
+(SURVEY.md §2.D pipeline parity), without ever running a full-file
+``np.unique`` — labels stay as numpy bytes arrays until a consumer
+actually needs Python strings.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from tpu_als.io._native_build import build_native
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SRC = os.path.join(_NATIVE_DIR, "streamcsv.cc")
+_LIB = os.path.join(_NATIVE_DIR, "libstreamcsv.so")
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    build_native(_SRC, _LIB)
+    lib = ctypes.CDLL(_LIB)
+    lib.sc_create.restype = ctypes.c_void_p
+    lib.sc_destroy.argtypes = [ctypes.c_void_p]
+    lib.sc_count_lines.restype = ctypes.c_int64
+    lib.sc_count_lines.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.sc_ingest.restype = ctypes.c_int64
+    lib.sc_ingest.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_float)]
+    lib.sc_num_keys.restype = ctypes.c_int64
+    lib.sc_num_keys.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.sc_key_bytes.restype = ctypes.c_int64
+    lib.sc_key_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.sc_export_keys.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.sc_max_key_len.restype = ctypes.c_int64
+    lib.sc_max_key_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.sc_export_keys_padded.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+def host_byte_range(size, host_index, num_hosts):
+    """Even byte split; the line-ownership protocol (see module doc)
+    turns it into an exact, non-overlapping line split."""
+    if not 0 <= host_index < num_hosts:
+        raise ValueError(f"host_index {host_index} not in [0, {num_hosts})")
+    per = size // num_hosts
+    start = host_index * per
+    end = size if host_index == num_hosts - 1 else (host_index + 1) * per
+    return start, end
+
+
+def _export_labels(lib, handle, which):
+    """This host's vocabulary as a numpy ``S(width)`` array in dense-id
+    order — no per-key Python objects (at ~1M distinct ids per host the
+    decode loop would dominate the whole ingest)."""
+    n = lib.sc_num_keys(handle, which)
+    width = max(1, lib.sc_max_key_len(handle, which))
+    out = np.empty(n, dtype=f"S{width}")
+    if n:
+        lib.sc_export_keys_padded(
+            handle, which, width,
+            out.ctypes.data_as(ctypes.c_char_p))
+    return out
+
+
+def decode_labels(labels):
+    """Bytes vocabulary -> list[str] (for the StringIndexerModel surface
+    and other user-facing label consumers; deliberately lazy — decoding
+    a million labels costs more than parsing ten million rows)."""
+    return [s.decode("utf-8") for s in labels.tolist()]
+
+
+def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
+                  require_cols=3, skip_header=0, chunk_bytes=32 << 20):
+    """Stream this host's byte range into (users, items, ratings, vocab).
+
+    Returns ``(u_local, i_local, ratings, user_labels, item_labels)``
+    where ``u_local``/``i_local`` are dense int64 ids into the label
+    arrays (numpy ``S``-dtype, first-seen order within this host's
+    stream; :func:`decode_labels` converts to ``list[str]`` on demand).
+
+    ``require_cols`` is the exact delimited column count per line; the
+    first three are ``user,item,rating`` and the rest are skipped
+    unparsed (Amazon-2023 csv: ``user_id,parent_asin,rating,timestamp``
+    -> ``require_cols=4``).  A malformed line raises ``ValueError`` (the
+    fastcsv strictness contract: no silent zero/merged rows).
+    """
+    lib = _load()
+    size = os.path.getsize(path)
+    start, end = host_byte_range(size, host_index, num_hosts)
+    handle = lib.sc_create()
+    out_u, out_i, out_r = [], [], []
+    try:
+        with open(path, "rb") as f:
+            pos = start
+            f.seek(pos)
+            if start == end:
+                pass  # degenerate split (more hosts than bytes): no rows
+            elif host_index == 0:
+                for _ in range(skip_header):
+                    header = f.readline()
+                    pos += len(header)
+            elif pos > 0:
+                # a line straddling `start` belongs to the previous
+                # host: skip through the first newline at-or-after start
+                skipped = f.readline()
+                pos += len(skipped)
+            carry = b""
+            while pos < end:
+                want = min(chunk_bytes, end - pos)
+                block = f.read(want)
+                if not block:
+                    break
+                pos += len(block)
+                buf = carry + block
+                cut = buf.rfind(b"\n")
+                if cut < 0:
+                    carry = buf
+                    continue
+                carry, buf = buf[cut + 1:], buf[:cut + 1]
+                _ingest_chunk(lib, handle, buf, delim, require_cols,
+                              out_u, out_i, out_r, path)
+            # finish the line straddling `end` (ours: it starts in-range)
+            # — or, when the range ends exactly at a line start, take the
+            # next host's first line (it skips through its first newline,
+            # so exactly-once either way).  `pos == end` excludes both a
+            # skip that overshot the whole range (those lines belong to a
+            # later host) and a degenerate empty range.
+            tail = f.readline() if (start != end and pos == end
+                                    and pos < size) else b""
+            last = carry + tail
+            if last.strip():
+                _ingest_chunk(lib, handle, last, delim, require_cols,
+                              out_u, out_i, out_r, path)
+        user_labels = _export_labels(lib, handle, 0)
+        item_labels = _export_labels(lib, handle, 1)
+    finally:
+        lib.sc_destroy(handle)
+    cat = (lambda xs, dt: np.concatenate(xs) if xs
+           else np.empty(0, dtype=dt))
+    return (cat(out_u, np.int64), cat(out_i, np.int64),
+            cat(out_r, np.float32), user_labels, item_labels)
+
+
+def _ingest_chunk(lib, handle, buf, delim, require_cols,
+                  out_u, out_i, out_r, path):
+    n = lib.sc_count_lines(buf, len(buf))
+    if n == 0:
+        return
+    u = np.empty(n, dtype=np.int64)
+    i = np.empty(n, dtype=np.int64)
+    r = np.empty(n, dtype=np.float32)
+    wrote = lib.sc_ingest(
+        handle, buf, len(buf), delim.encode()[0], require_cols,
+        u.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        i.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        r.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    if wrote == -2:
+        raise ValueError(
+            f"malformed ratings line in {path}: every data line must be "
+            f"str{delim}str{delim}float with exactly {require_cols} "
+            "columns (no quotes; ids non-empty; rating finite)")
+    if wrote != n:
+        raise IOError(f"streamcsv parsed {wrote} rows, expected {n}")
+    out_u.append(u)
+    out_i.append(i)
+    out_r.append(r)
+
+
+def merge_vocabularies(per_host_labels):
+    """Union per-host vocabularies into one global id space.
+
+    Inputs are the ``S``-dtype label arrays from :func:`stream_ingest`.
+    Global order is LEXICOGRAPHIC (``np.unique`` over the stacked
+    vocabularies — fully vectorized, and a pure function of the per-host
+    vocabularies, so in a real deployment every process computes the
+    identical mapping from the all-gathered small vocabularies).
+    Returns ``(global_labels, remaps)`` where ``global_labels`` is an
+    ``S``-dtype array and ``remaps[k][local_id] == global_id``.
+    """
+    arrays = [np.asarray(a, dtype="S") for a in per_host_labels]
+    width = max([a.dtype.itemsize for a in arrays] + [1])
+    stacked = np.concatenate([a.astype(f"S{width}") for a in arrays]) \
+        if arrays else np.empty(0, dtype="S1")
+    global_labels, inverse = np.unique(stacked, return_inverse=True)
+    remaps, at = [], 0
+    for a in arrays:
+        remaps.append(inverse[at:at + len(a)].astype(np.int64))
+        at += len(a)
+    return global_labels, remaps
+
+
+def ingest_per_host(path, num_hosts, *, delim=",", require_cols=3,
+                    skip_header=0, chunk_bytes=32 << 20):
+    """Run every host's stream (single-process harness) and return
+    globally-consistent per-host COO splits.
+
+    Returns ``(splits, user_labels, item_labels)`` with ``splits[k] =
+    (u_gid, i_gid, ratings)`` — exactly what each process passes to
+    ``ALS(dataMode='per_host').fit`` (ids already integer and globally
+    agreed, so the estimator's id-union collective sees int64 arrays).
+    """
+    per_host = [stream_ingest(path, k, num_hosts, delim=delim,
+                              require_cols=require_cols,
+                              skip_header=skip_header,
+                              chunk_bytes=chunk_bytes)
+                for k in range(num_hosts)]
+    user_labels, u_remaps = merge_vocabularies(
+        [h[3] for h in per_host])
+    item_labels, i_remaps = merge_vocabularies(
+        [h[4] for h in per_host])
+    splits = [(u_remaps[k][per_host[k][0]],
+               i_remaps[k][per_host[k][1]],
+               per_host[k][2]) for k in range(num_hosts)]
+    return splits, user_labels, item_labels

@@ -42,6 +42,13 @@ class TwoTowerConfig:
     # power-law data this is the difference between learning preferences
     # and learning an inverted-popularity table.
     popularity_correction: bool = True
+    # learning-rate multiplier for the embedding TABLES only (towers
+    # always train at learning_rate).  The warm-start preservation knob:
+    # 0.0 freezes ALS-warm-started tables outright (only the towers
+    # adapt), values in (0, 1) slow table drift so few-epoch training
+    # can't wash out the CF signal it started from.  1.0 = one optimizer
+    # for everything (identical to the pre-knob behavior).
+    embed_lr_scale: float = 1.0
 
 
 def init_params(key, num_users, num_items, cfg: TwoTowerConfig,
@@ -135,7 +142,20 @@ def train_two_tower(u_idx, i_idx, num_users, num_items,
     key, kinit = jax.random.split(key)
     params = init_params(kinit, num_users, num_items, cfg,
                          als_user_factors, als_item_factors)
-    tx = optax.adam(cfg.learning_rate)
+    if cfg.embed_lr_scale == 1.0:
+        tx = optax.adam(cfg.learning_rate)
+    else:
+        emb_tx = (optax.set_to_zero() if cfg.embed_lr_scale == 0.0
+                  else optax.adam(cfg.learning_rate * cfg.embed_lr_scale))
+        tx = optax.multi_transform(
+            {"embed": emb_tx, "tower": optax.adam(cfg.learning_rate)},
+            param_labels=lambda p: {
+                "user_embed": "embed", "item_embed": "embed",
+                "user_tower": jax.tree.map(lambda _: "tower",
+                                           p["user_tower"]),
+                "item_tower": jax.tree.map(lambda _: "tower",
+                                           p["item_tower"]),
+            })
     opt_state = tx.init(params)
 
     log_q = None

@@ -435,6 +435,38 @@ def global_id_union(local_ids):
     return np.unique(_ragged_allgather(uniq.astype(np.int64)))
 
 
+def global_vocab_union(labels):
+    """Sorted union of every process's STRING vocabulary — the entity
+    agreement for per-host streaming ingest (io/stream.py) whose raw ids
+    are strings (config 3's Amazon-2023 schema, SURVEY.md §6 row 3).
+
+    Same contract as :func:`global_id_union` but over an ``S``-dtype
+    label array: each host contributes O(local distinct) label bytes,
+    never its ratings.  Labels are padded to the globally-agreed width,
+    moved as uint8 rows through the ragged allgather, and uniqued —
+    deterministic (lexicographic) on every process.  Labels must not
+    contain NUL bytes (the padding alphabet).  Single-process: plain
+    ``np.unique``.  The local->global remap is
+    ``np.searchsorted(global, local)``.
+    """
+    labels = np.asarray(labels, dtype="S")
+    if jax.process_count() == 1:
+        return np.unique(labels)
+    from jax.experimental import multihost_utils as mhu
+
+    w = int(np.asarray(mhu.process_allgather(
+        np.array([max(labels.dtype.itemsize, 1)], dtype=np.int64))).max())
+    rows = np.zeros((len(labels), w), dtype=np.uint8)
+    if len(labels):
+        loc_w = labels.dtype.itemsize
+        rows[:, :loc_w] = (labels.view(np.uint8)
+                           .reshape(len(labels), loc_w))
+    flat = _ragged_allgather(rows.ravel())
+    gathered = np.ascontiguousarray(
+        flat.reshape(-1, w)).view(f"S{w}").ravel()
+    return np.unique(gathered)
+
+
 def gather_entity_factors(arr, part, mesh):
     """Host-replicated entity-space factors from a slot-space global array.
 

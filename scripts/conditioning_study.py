@@ -1,0 +1,123 @@
+"""Numerical-conditioning study: rank-256 float32 normal equations.
+
+SURVEY.md §7 hard-part 6 / VERDICT r4 #9: config 3 solves rank-256
+normal equations A = Yg^T C Yg + lambda*n*I in float32 on the MXU.  This
+study quantifies, against float64 ground truth, (a) how kappa(A) scales
+with entity degree n and regularization lambda, (b) the f32 Cholesky
+solve's forward error across that (n, lambda) grid, and (c) what the
+framework's jitter floor (solve_spd's default 1e-6) contributes in the
+ill-conditioned corner — answering "is f32 + weighted-lambda + jitter
+enough at rank 256, and where does it stop being enough?".
+
+Factor entries follow the trained-model scale (~N(0, 1/sqrt(r))), with a
+worst-case variant whose gathered rows are nearly collinear (a popular
+item rated by users with correlated tastes — the spectrum that actually
+hurts: A's effective rank collapses to ~1 while its trace stays large).
+
+Writes docs/conditioning_rank256.json and prints a summary table.
+CPU-only, float64 reference via numpy; no TPU needed.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RANK = 256
+BATCH = 64
+
+
+def build_normal_eq(rng, n, rank, collinear=0.0, dtype=np.float64):
+    """A = Yg^T Yg + lambda*n*I ingredients for one entity of degree n.
+
+    ``collinear`` in [0,1): fraction of each row that is a shared
+    direction — drives the gathered rows toward rank-1.
+    """
+    Y = rng.normal(0, 1 / np.sqrt(rank), (n, rank))
+    if collinear > 0:
+        shared = rng.normal(0, 1 / np.sqrt(rank), rank)
+        Y = (1 - collinear) * Y + collinear * shared[None, :]
+    return Y.astype(dtype)
+
+
+def solve_err(Y, reg, jitter, rng):
+    """f32 einsum+cholesky solve vs f64 reference; returns (kappa,
+    rel_err, failed)."""
+    n = len(Y)
+    b64 = Y.T @ rng.normal(0, 1, n)
+    A64 = Y.T @ Y + reg * n * np.eye(RANK)
+    kappa = float(np.linalg.cond(A64))
+    x64 = np.linalg.solve(A64, b64)
+
+    Y32 = Y.astype(np.float32)
+    A32 = (Y32.T @ Y32 + np.float32(reg * n + jitter)
+           * np.eye(RANK, dtype=np.float32))
+    b32 = b64.astype(np.float32)  # same rhs, f32-rounded
+    try:
+        # solve THROUGH the Cholesky factor (the framework's path)
+        L = np.linalg.cholesky(A32).astype(np.float32)
+        x32 = np.linalg.solve(
+            L.T.astype(np.float32),
+            np.linalg.solve(L, b32).astype(np.float32))
+        failed = False
+    except np.linalg.LinAlgError:
+        x32 = np.zeros(RANK, np.float32)
+        failed = True
+    rel = float(np.linalg.norm(x32 - x64) / max(np.linalg.norm(x64),
+                                                1e-30))
+    return kappa, rel, failed
+
+
+def main():
+    rng = np.random.default_rng(0)
+    degrees = [8, 64, 512, 4096, 32768]
+    lambdas = [1e-4, 1e-3, 1e-2, 1e-1]   # reg_param (x n inside)
+    jitters = [0.0, 1e-6]
+    scenarios = {"typical": 0.0, "collinear_0.9": 0.9,
+                 "collinear_0.99": 0.99}
+
+    rows = []
+    for scen, coll in scenarios.items():
+        for n in degrees:
+            Y = build_normal_eq(rng, n, RANK, collinear=coll)
+            for lam in lambdas:
+                for jit in jitters:
+                    kap, rel, failed = solve_err(Y, lam, jit, rng)
+                    rows.append({
+                        "scenario": scen, "degree": n, "reg": lam,
+                        "jitter": jit, "kappa64": kap,
+                        "rel_err_f32": rel, "chol_failed": failed})
+    # digest: worst rel err per (scenario, reg) with the default jitter
+    digest = {}
+    for scen in scenarios:
+        for lam in lambdas:
+            sel = [r for r in rows if r["scenario"] == scen
+                   and r["reg"] == lam and r["jitter"] == 1e-6]
+            digest[f"{scen}|reg={lam}"] = {
+                "max_rel_err_f32": max(r["rel_err_f32"] for r in sel),
+                "max_kappa": max(r["kappa64"] for r in sel),
+                "any_chol_failure": any(r["chol_failed"] for r in sel),
+            }
+    out = {"rank": RANK, "rows": rows, "digest": digest}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "conditioning_rank256.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"{'scenario':16} {'reg':>6} {'max kappa':>12} "
+          f"{'max f32 rel err':>16} fail")
+    for k, v in digest.items():
+        scen, lam = k.split("|reg=")
+        print(f"{scen:16} {lam:>6} {v['max_kappa']:12.3e} "
+              f"{v['max_rel_err_f32']:16.3e} "
+              f"{'YES' if v['any_chol_failure'] else 'no'}")
+    print(json.dumps({"metric": "conditioning_rank256_max_rel_err",
+                      "value": max(v["max_rel_err_f32"]
+                                   for v in digest.values()),
+                      "unit": "relative_error", "vs_baseline": None}))
+
+
+if __name__ == "__main__":
+    main()

@@ -4,6 +4,7 @@ synthetic low-rank ground truth, train, assert held-out RMSE below threshold
 """
 
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 
@@ -140,6 +141,7 @@ def test_resolve_path_agrees_with_dispatch(rng):
     )["resolved_solve_path"] == "einsum+nnls"
 
 
+@pytest.mark.slow
 def test_reg_grid_shares_one_compiled_step(rng):
     """regParam is a traced scalar stripped from the step's static cache
     key: a tuning grid over regParam at fixed rank/data must reuse ONE

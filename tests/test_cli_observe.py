@@ -1,5 +1,6 @@
 """CLI + observability tests (CPU mesh)."""
 
+import pytest
 import json
 
 import numpy as np
@@ -8,6 +9,7 @@ from tpu_als.cli import main as cli_main
 from tpu_als.utils.observe import IterationLogger
 
 
+@pytest.mark.slow
 def test_cli_train_evaluate_recommend(tmp_path, capsys):
     model_dir = str(tmp_path / "m")
     cli_main(["train", "--data", "synthetic:200x80x4000", "--rank", "4",
@@ -75,6 +77,7 @@ def test_iteration_logger(tmp_path, rng):
     assert all("seconds" in x for x in recs)
 
 
+@pytest.mark.slow
 def test_cli_tune(tmp_path, capsys):
     import json
 
@@ -94,6 +97,7 @@ def test_cli_tune(tmp_path, capsys):
     assert int(loaded.bestModel._params["rank"]) == res["best_rank"]
 
 
+@pytest.mark.slow
 def test_cli_train_profile_dir(tmp_path, capsys):
     prof = str(tmp_path / "prof")
     cli_main(["train", "--data", "synthetic:100x40x1500", "--rank", "3",
@@ -174,6 +178,7 @@ def test_cli_recommend_with_item_foldin(tmp_path, capsys):
     assert new_item in items  # the folded item is in the candidate set
 
 
+@pytest.mark.slow
 def test_cli_tune_alpha_grid(tmp_path, capsys):
     cli_main(["tune", "--data", "synthetic:100x40x2000",
               "--ranks", "3", "--reg-params", "0.02", "--implicit",
@@ -183,6 +188,7 @@ def test_cli_tune_alpha_grid(tmp_path, capsys):
     assert line["best_alpha"] in (1.0, 20.0)
 
 
+@pytest.mark.slow
 def test_cli_evaluate_ranking_metrics(tmp_path, capsys):
     model_dir = str(tmp_path / "m")
     cli_main(["train", "--data", "synthetic:150x60x4000", "--rank", "6",
@@ -201,6 +207,7 @@ def test_cli_evaluate_ranking_metrics(tmp_path, capsys):
     assert out["ranking_users"] > 0
 
 
+@pytest.mark.slow
 def test_cli_tt_train(tmp_path, capsys):
     out_dir = str(tmp_path / "towers")
     cli_main(["tt-train", "--data", "synthetic:300x100x8000",

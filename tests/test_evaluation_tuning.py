@@ -232,6 +232,7 @@ def test_tuned_load_rejects_foreign_model_class(tmp_path):
         TrainValidationSplitModel.load(str(p))
 
 
+@pytest.mark.slow
 def test_cv_respects_larger_is_better(rng):
     """With an isLargerBetter metric (r2), CV must pick the HIGHEST
     score — an argmin over r2 would select the worst model and this

@@ -34,18 +34,21 @@ def test_example_compiles(name):
     py_compile.compile(os.path.join(ROOT, "examples", name), doraise=True)
 
 
+@pytest.mark.slow
 def test_basic_example_runs_end_to_end():
     p = _run_example("01_movielens_basic.py")
     assert p.returncode == 0, p.stderr[-2000:]
     assert "held-out RMSE" in p.stdout and "top-10" in p.stdout
 
 
+@pytest.mark.slow
 def test_pipeline_example_runs_end_to_end():
     p = _run_example("02_pipeline_string_ids.py")
     assert p.returncode == 0, p.stderr[-2000:]
     assert "grid RMSE" in p.stdout and "top-5" in p.stdout
 
 
+@pytest.mark.slow
 def test_distributed_example_runs_on_forced_mesh():
     p = _run_example(
         "03_distributed_and_streaming.py",
@@ -55,6 +58,7 @@ def test_distributed_example_runs_on_forced_mesh():
     assert "ring strategy" in p.stdout and "no refit" in p.stdout
 
 
+@pytest.mark.slow
 def test_multihost_pod_walkthrough_runs_end_to_end():
     """examples/04: two spawned gloo processes, per-host streaming
     ingest, vocab-union, cross-process training."""

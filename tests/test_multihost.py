@@ -3,6 +3,7 @@ contract and the shard-ownership math every host uses to block only its
 local ratings."""
 
 import numpy as np
+import pytest
 
 from tpu_als.parallel.data import partition_balanced
 from tpu_als.parallel.mesh import make_mesh
@@ -121,6 +122,7 @@ def _spawn_two_procs(worker, env_extra, timeout=300):
     return outs
 
 
+@pytest.mark.slow
 def test_two_process_sharded_step_matches_single_process(tmp_path):
     """REAL multi-process run: 2 spawned processes x 2 CPU devices, gloo
     collectives over a 4-device global mesh, per-host blocking — the
@@ -186,6 +188,7 @@ def test_two_process_sharded_step_matches_single_process(tmp_path):
     assert seen == {(s, p) for s in "UV" for p in range(4)}
 
 
+@pytest.mark.slow
 def test_two_process_cli_train(tmp_path):
     """The CLI's multi-process branch end-to-end: two spawned processes
     run the same `train` command; process 0 evaluates and saves a model
@@ -216,11 +219,10 @@ def test_two_process_cli_train(tmp_path):
     assert np.isfinite(preds).all() and len(preds) > 0
 
 
-import pytest
-
 
 @pytest.mark.parametrize("strategy", ["all_gather", "ring",
                                       "all_to_all"])
+@pytest.mark.slow
 def test_two_process_estimator_fit_matches_single_process(tmp_path,
                                                           strategy):
     """Multi-process ALS.fit == single-process mesh fit, exactly the same
@@ -274,6 +276,7 @@ def test_two_process_estimator_fit_matches_single_process(tmp_path,
     np.testing.assert_allclose(dat["V"], ref._V, rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_two_process_per_host_files_fit_matches_replicated(tmp_path):
     """dataMode='per_host': each worker writes and loads a DISJOINT csv
     (row-parity halves of one dataset), fit agrees the entity space via
@@ -301,6 +304,7 @@ def test_two_process_per_host_files_fit_matches_replicated(tmp_path):
     np.testing.assert_allclose(dat["V"], ref._V, rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_two_process_cli_per_host_data(tmp_path):
     """`cli train --per-host-data --data csv:...part-{proc}.csv`: each
     process loads only its split; process 0 reports holdout RMSE and
@@ -327,6 +331,7 @@ def test_two_process_cli_per_host_data(tmp_path):
     assert np.isfinite(preds).any() and len(preds) > 0
 
 
+@pytest.mark.slow
 def test_two_process_divergent_config_fails_fast(tmp_path):
     """A fit knob that differs across processes (here fitCallbackInterval)
     must raise the config-gate ValueError on every process instead of
@@ -342,6 +347,7 @@ def test_two_process_divergent_config_fails_fast(tmp_path):
         assert "gate worker caught divergence" in o, o[-1500:]
 
 
+@pytest.mark.slow
 def test_two_process_divergent_gather_strategy_fails_fast(tmp_path):
     """gatherStrategy is the knob that picks WHICH collectives the step
     compiles (ring=ppermute vs all_gather) — a cross-process divergence
@@ -357,6 +363,7 @@ def test_two_process_divergent_gather_strategy_fails_fast(tmp_path):
         assert "gate worker caught divergence" in o, o[-1500:]
 
 
+@pytest.mark.slow
 def test_two_process_nan_ratings_raise_on_every_host(tmp_path):
     """nan ratings on ONE host: the collective finite check must raise
     on BOTH processes instead of stranding the clean host in the next
@@ -503,6 +510,7 @@ def test_sharded_checkpoint_roundtrip(rng, tmp_path):
 
 
 @pytest.mark.parametrize("mode", ["fit_ckpt", "fit_ckpt_sharded"])
+@pytest.mark.slow
 def test_two_process_checkpoint_resume(tmp_path, mode):
     """Multi-process fit writes checkpoints and a resumed run reproduces
     the uninterrupted one — for both formats: replicated (collective
@@ -519,6 +527,7 @@ def test_two_process_checkpoint_resume(tmp_path, mode):
     np.testing.assert_allclose(dat["Vr"], dat["Vs"], rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_two_process_sharded_serving_matches_single(tmp_path):
     """REAL multi-process serving: topk_sharded's all_gather AND ring
     collectives across two spawned gloo processes == the single-device
@@ -562,6 +571,7 @@ def test_two_process_sharded_serving_matches_single(tmp_path):
         np.testing.assert_array_equal(got_i, ref_i)
 
 
+@pytest.mark.slow
 def test_two_process_streaming_string_ingest_matches_single(tmp_path):
     """The whole config-3 flow across REAL processes: byte-range
     streaming ingest of a STRING-id csv per host, global_vocab_union to

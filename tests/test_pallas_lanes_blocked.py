@@ -14,6 +14,8 @@ from tpu_als.ops.pallas_lanes_blocked import (
     supported_rank,
 )
 
+pytestmark = pytest.mark.slow
+
 
 def _spd_problem(rng, N, r):
     M = rng.normal(size=(N, r, r)).astype(np.float32) / np.sqrt(r)

@@ -279,6 +279,7 @@ def test_pipeline_copy_routes_grid_params(rng):
         pipe.copy({ev.getParam("metricName"): "mae"})
 
 
+@pytest.mark.slow
 def test_crossvalidator_over_pipeline(rng):
     """CrossValidator(estimator=Pipeline) — the reference tuning idiom."""
     df = _string_ratings(rng, n_users=24, n_items=16, density=0.7)

@@ -48,6 +48,7 @@ def dataset(rng):
     return u, i, r
 
 
+@pytest.mark.slow
 def test_crash_then_resume_matches_uninterrupted(dataset, tmp_path):
     u, i, r = dataset
     frame = {"user": u, "item": i, "rating": r}

@@ -19,6 +19,8 @@ from tpu_als.parallel.trainer import (
     train_sharded,
 )
 
+pytestmark = pytest.mark.slow
+
 
 def _random_case(rng):
     nU = int(rng.integers(9, 80))

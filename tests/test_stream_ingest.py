@@ -92,6 +92,15 @@ def test_more_hosts_than_bytes(tmp_path):
     assert got == [("a", "b", 1.0)]
 
 
+def test_more_hosts_than_bytes_with_header(tmp_path):
+    # degenerate split: the LAST host owns (0, size); the header skip
+    # must follow byte-0 ownership, not host index 0
+    path = tmp_path / "tiny_hdr.csv"
+    path.write_text("user,item,rating\na,b,1.0\n")
+    splits, ul, il = ingest_per_host(str(path), 64, skip_header=1)
+    assert _assemble(splits, ul, il) == [("a", "b", 1.0)]
+
+
 def test_crlf_and_missing_final_newline(tmp_path):
     path = tmp_path / "crlf.csv"
     path.write_bytes(b"ux,iy,2.5\r\nuz,iw,3.0")

@@ -10,6 +10,7 @@ from tpu_als.models.two_tower import (
 )
 
 from conftest import make_ratings
+import pytest
 
 
 def _interactions(rng, nU=60, nI=40):
@@ -18,6 +19,7 @@ def _interactions(rng, nU=60, nI=40):
     return u[pos], i[pos], Ustar, Vstar
 
 
+@pytest.mark.slow
 def test_training_beats_random_init_recall(rng):
     u, i, _, _ = _interactions(rng)
     cfg = TwoTowerConfig(embed_dim=8, hidden=(16,), out_dim=8, epochs=0,
@@ -43,6 +45,7 @@ def test_als_warm_start(rng):
     assert r_warm > r_cold, (r_warm, r_cold)
 
 
+@pytest.mark.slow
 def test_popularity_correction_changes_loss_and_stays_finite(rng):
     # one dominant item: the logQ correction must shift the logits (loss
     # differs from the uncorrected run) and training must stay finite
@@ -100,6 +103,7 @@ def test_filtered_recall_excludes_train_items(rng):
     assert r_plain == 0.0 and r_filt == 1.0, (r_plain, r_filt)
 
 
+@pytest.mark.slow
 def test_filtered_recall_matches_plain_when_no_overlap(rng):
     u, i, _, _ = _interactions(rng)
     cfg = TwoTowerConfig(embed_dim=8, hidden=(16,), out_dim=8, epochs=2,
@@ -187,6 +191,7 @@ def test_from_fitted_als_model(rng):
     assert 0.0 <= rec <= 1.0
 
 
+@pytest.mark.slow
 def test_two_tower_save_load_roundtrip(rng, tmp_path):
     """Config-5 model persistence: save -> load reproduces the exact
     serving behavior (representations and retrieval top-k)."""

@@ -139,7 +139,11 @@ def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
             f.seek(pos)
             if start == end:
                 pass  # degenerate split (more hosts than bytes): no rows
-            elif host_index == 0:
+            elif start == 0:
+                # the header belongs to whichever host owns byte 0 —
+                # normally host 0, but in the degenerate split above the
+                # LAST host can own (0, size) while earlier hosts are
+                # empty (reviewer, round 5)
                 for _ in range(skip_header):
                     header = f.readline()
                     pos += len(header)

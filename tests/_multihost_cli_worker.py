@@ -107,6 +107,19 @@ if __name__ == "__main__":
               "--max-iter", "3", "--reg-param", "0.02", "--seed", "0",
               "--output", base + ".model"])
         print("cli perhost worker done", flush=True)
+    elif os.environ.get("MH_MODE") == "cli_stream":
+        # the config-3 CLI one-liner: ONE shared string-id csv, each
+        # process streams only its byte range (--per-host-data with a
+        # stream: spec needs no {proc} file splits), ids agreed
+        # collectively, process 0 saves the model + label sidecar
+        from tpu_als.cli import main
+
+        base = os.environ["MH_OUT"]
+        main(["train", "--data", "stream:" + os.environ["MH_CSV"],
+              "--per-host-data", "--devices", "0", "--rank", "4",
+              "--max-iter", "3", "--reg-param", "0.02", "--seed", "0",
+              "--output", base + ".model"])
+        print("cli stream worker done", flush=True)
     elif os.environ.get("MH_MODE") == "gate_diverge":
         # processes deliberately disagree on a fit knob: the config gate
         # (fit's FIRST collective) must turn what would be a distributed

@@ -463,3 +463,206 @@ def test_cli_recommend_rejects_pipeline_save_with_direction(tmp_path,
     pipe.fit(raw).save(d)
     with pytest.raises(SystemExit, match="PipelineModel save"):
         cli_main(["recommend", "--model", d, "--k", "3"])
+
+
+def test_cli_train_stream_spec(tmp_path, capsys):
+    """Single-process `train --data stream:PATH`: string-id csv streams
+    through the config-3 loader; the saved model carries the
+    stream_labels sidecar mapping dense ids back to strings."""
+    import numpy as np
+
+    from tpu_als.cli import main
+
+    rng = np.random.default_rng(3)
+    csv = tmp_path / "s.csv"
+    with open(csv, "w") as f:
+        f.write("user_id,parent_asin,rating,timestamp\n")
+        for k in range(1500):
+            f.write(f"rev_{rng.integers(40):02d},"
+                    f"B{rng.integers(25):03d},"
+                    f"{rng.integers(1, 10) / 2.0},1600\n")
+    out = tmp_path / "m"
+    main(["train", "--data", f"stream:{csv}", "--rank", "4",
+          "--max-iter", "3", "--reg-param", "0.02", "--seed", "0",
+          "--output", str(out)])
+    assert "holdout_rmse" in capsys.readouterr().out
+    side = np.load(out / "stream_labels.npz")
+    assert len(side["users"]) == 40 and len(side["items"]) == 25
+    assert side["users"][0].item().decode().startswith("rev_")
+
+
+def test_cli_evaluate_stream_uses_model_vocab(tmp_path, capsys):
+    """evaluate --data stream: must densify in the MODEL's id space via
+    the stream_labels sidecar — and drop ids the model never saw."""
+    import numpy as np
+
+    from tpu_als.cli import main
+
+    rng = np.random.default_rng(5)
+    train_csv = tmp_path / "tr.csv"
+    with open(train_csv, "w") as f:
+        f.write("user_id,parent_asin,rating,timestamp\n")
+        for k in range(2000):
+            f.write(f"rev_{rng.integers(30):02d},"
+                    f"B{rng.integers(20):02d},"
+                    f"{rng.integers(1, 10) / 2.0},1600\n")
+    out = tmp_path / "m"
+    main(["train", "--data", f"stream:{train_csv}", "--rank", "4",
+          "--max-iter", "4", "--reg-param", "0.02", "--seed", "0",
+          "--holdout", "0.0", "--output", str(out)])
+    capsys.readouterr()
+
+    # eval file: SUBSET of users (lexicographic positions differ from a
+    # fresh vocab of this file) + one unknown user the model never saw
+    ev_csv = tmp_path / "ev.csv"
+    with open(ev_csv, "w") as f:
+        f.write("user_id,parent_asin,rating,timestamp\n")
+        for k in range(300):
+            f.write(f"rev_{20 + (k % 10):02d},B{k % 20:02d},3.0,1600\n")
+        f.write("rev_UNSEEN,B00,3.0,1600\n")
+    main(["evaluate", "--model", str(out), "--data", f"stream:{ev_csv}"])
+    text = capsys.readouterr()
+    assert "rmse" in text.out
+    # the unknown-id row was dropped with a notice, not mis-scored
+    assert "dropped 1/301" in text.err
+
+    # a model without the sidecar refuses stream eval data
+    import shutil
+
+    bare = tmp_path / "bare"
+    shutil.copytree(out, bare)
+    (bare / "stream_labels.npz").unlink()
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit, match="stream_labels"):
+        main(["evaluate", "--model", str(bare),
+              "--data", f"stream:{ev_csv}"])
+
+
+def test_load_train_data_stream_host_policy(tmp_path):
+    """stream: byte-range policy — a {proc} placeholder means per-host
+    FILES (streamed whole); a shared file + per-host-data byte-splits;
+    replicated streams whole."""
+    import argparse
+
+    import numpy as np
+
+    from tpu_als.cli import _load_train_data
+
+    shared = tmp_path / "all.csv"
+    with open(shared, "w") as f:
+        f.write("user_id,parent_asin,rating,timestamp\n")
+        for k in range(400):
+            f.write(f"u{k % 19:02d},B{k % 11:02d},2.5,1600\n")
+    for p in range(2):
+        part = tmp_path / f"part{p}.csv"
+        with open(part, "w") as f:
+            f.write("user_id,parent_asin,rating,timestamp\n")
+            for k in range(100):
+                f.write(f"u{k % 19:02d},B{k % 11:02d},2.5,1600\n")
+
+    mk = lambda data, ph: argparse.Namespace(  # noqa: E731
+        data=data, per_host_data=ph)
+    # shared + per-host-data: byte-split -> halves sum to the whole
+    n0 = len(_load_train_data(mk(f"stream:{shared}", True), 0, 2)[0])
+    n1 = len(_load_train_data(mk(f"stream:{shared}", True), 1, 2)[0])
+    assert n0 + n1 == 400 and 0 < n0 < 400
+    # {proc} placeholder: per-host FILES, each streamed WHOLE even with
+    # per-host-data (byte-splitting on top would drop half of each)
+    spec = f"stream:{tmp_path}/part{{proc}}.csv"
+    assert len(_load_train_data(mk(spec, True), 0, 2)[0]) == 100
+    assert len(_load_train_data(mk(spec, True), 1, 2)[0]) == 100
+    # replicated: every host streams the whole shared file
+    assert len(_load_train_data(mk(f"stream:{shared}", False), 1, 2)[0]) == 400
+
+
+def test_cli_recommend_stream_foldin_new_string_user(tmp_path, capsys):
+    """The config-3 serving loop: stream-trained model + --foldin-data
+    with a NEVER-SEEN string user id + --users by string — the new user
+    gets a fresh dense id, is served, and the output maps both sides
+    back to the original string ids."""
+    import numpy as np
+
+    from tpu_als.cli import main
+
+    rng = np.random.default_rng(7)
+    csv = tmp_path / "tr.csv"
+    with open(csv, "w") as f:
+        f.write("user_id,parent_asin,rating,timestamp\n")
+        for k in range(1500):
+            f.write(f"rev_{rng.integers(30):02d},"
+                    f"B{rng.integers(20):02d},"
+                    f"{rng.integers(1, 10) / 2.0},1600\n")
+    out = tmp_path / "m"
+    main(["train", "--data", f"stream:{csv}", "--rank", "4",
+          "--max-iter", "3", "--reg-param", "0.02", "--seed", "0",
+          "--holdout", "0.0", "--output", str(out)])
+    capsys.readouterr()
+
+    new = tmp_path / "new.csv"
+    with open(new, "w") as f:
+        f.write("user_id,parent_asin,rating,timestamp\n")
+        f.write("rev_FRESH,B00,5.0,1600\n")
+        f.write("rev_FRESH,B01,4.5,1600\n")
+        f.write("rev_FRESH,UNKNOWN_ITEM,4.0,1600\n")  # dropped
+    main(["recommend", "--model", str(out),
+          "--foldin-data", f"stream:{new}",
+          "--users", "rev_FRESH,rev_00", "--k", "3"])
+    text = capsys.readouterr()
+    assert "dropped 1/3" in text.err          # unknown item
+    assert "1 new user ids" in text.err
+    import json as _json
+
+    rows = {r["user_id"]: r for r in
+            (_json.loads(ln) for ln in text.out.splitlines()
+             if ln.startswith("{"))}
+    assert set(rows) == {"rev_FRESH", "rev_00"}
+    fresh = rows["rev_FRESH"]
+    assert fresh["user"] == 30                # dense id after the model
+    assert all(isinstance(s, str) and s.startswith("B")
+               for s in fresh["item_ids"])
+    scores = [s for _, s in fresh["items"]]
+    assert scores == sorted(scores, reverse=True)
+    assert np.isfinite(scores).all()
+
+
+def test_stream_foldin_ghost_user_gets_no_fresh_id(tmp_path, capsys):
+    """A fold-in user whose EVERY row references unknown items must not
+    receive a fresh dense id (it has no folded factor row to serve)."""
+    import pytest as _pytest
+
+    from tpu_als.cli import main
+
+    csv = tmp_path / "tr.csv"
+    with open(csv, "w") as f:
+        f.write("user_id,parent_asin,rating,timestamp\n")
+        for k in range(600):
+            f.write(f"rev_{k % 20:02d},B{k % 15:02d},3.0,1600\n")
+    out = tmp_path / "m"
+    main(["train", "--data", f"stream:{csv}", "--rank", "3",
+          "--max-iter", "2", "--reg-param", "0.02", "--seed", "0",
+          "--holdout", "0.0", "--output", str(out)])
+    capsys.readouterr()
+
+    new = tmp_path / "ghost.csv"
+    with open(new, "w") as f:
+        f.write("user_id,parent_asin,rating,timestamp\n")
+        f.write("rev_GHOST,NOPE1,4.0,1600\n")
+        f.write("rev_GHOST,NOPE2,4.0,1600\n")
+    with _pytest.raises(SystemExit, match="unknown user id 'rev_GHOST'"):
+        main(["recommend", "--model", str(out),
+              "--foldin-data", f"stream:{new}",
+              "--users", "rev_GHOST", "--k", "3"])
+    assert "new user ids" not in capsys.readouterr().err
+
+
+def test_proc_placeholder_is_literal_single_process(tmp_path):
+    """Single-process train must NOT expand {proc}: expanding to 0 would
+    silently train on one split of N."""
+    import pytest as _pytest
+
+    from tpu_als.cli import main
+
+    with _pytest.raises(FileNotFoundError):
+        main(["train", "--data", f"csv:{tmp_path}/part-{{proc}}.csv",
+              "--rank", "3", "--max-iter", "1"])

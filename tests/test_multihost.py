@@ -635,3 +635,42 @@ def test_two_process_streaming_string_ingest_matches_single(tmp_path):
                                        atol=2e-5, err_msg=kname)
     assert rows_total == nnz  # every line landed on exactly one host
     assert seen == {(s, p) for s in "UV" for p in range(4)}
+
+
+@pytest.mark.slow
+def test_two_process_cli_stream_shared_file(tmp_path):
+    """`cli train --per-host-data --data stream:one_shared.csv`: the
+    config-3 one-liner — byte-range split of a single string-id file,
+    collective vocab agreement, model + stream_labels sidecar saved."""
+    import os
+
+    rng = np.random.default_rng(9)
+    nnz = 3000
+    uu = rng.integers(0, 50, nnz)
+    ii = rng.integers(0, 30, nnz)
+    rr = (rng.integers(1, 10, nnz) / 2.0)
+    csv = tmp_path / "shared.csv"
+    with open(csv, "w") as f:
+        f.write("user_id,parent_asin,rating,timestamp\n")
+        for k in range(nnz):
+            f.write(f"rev_{uu[k]:03d},B{ii[k]:04d},{rr[k]},160{k % 10}\n")
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_cli_worker.py")
+    out = str(tmp_path / "cls")
+    outs = _spawn_two_procs(worker, {"MH_OUT": out,
+                                     "MH_MODE": "cli_stream",
+                                     "MH_CSV": str(csv)})
+    assert any("cli stream worker done" in t for t in outs), outs
+
+    from tpu_als import ALSModel
+
+    model = ALSModel.load(out + ".model")
+    side = np.load(out + ".model/stream_labels.npz")
+    assert len(side["users"]) == 50 and len(side["items"]) == 30
+    # dense ids in the model line up with the sorted label space
+    assert sorted(side["users"].tolist()) == side["users"].tolist()
+    preds = model.transform({
+        "user": np.arange(10), "item": np.arange(10),
+        "rating": np.ones(10, np.float32)})["prediction"]
+    assert np.isfinite(np.asarray(preds, dtype=np.float64)).any()

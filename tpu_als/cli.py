@@ -6,7 +6,10 @@ that surface for the TPU framework:
     python -m tpu_als.cli train --data ml-100k:/path/u.data --rank 16 \\
         --max-iter 10 --output /tmp/model
     python -m tpu_als.cli train --data synthetic:10000x2000x500000 ...
-    (data specs: ml-100k:PATH | csv:PATH | dat:PATH | synthetic:UxIxN)
+    (data specs: ml-100k:PATH | csv:PATH | dat:PATH | stream:PATH |
+     synthetic:UxIxN; stream: = STRING-id csv with header, byte-range
+     streamed — under --per-host-data each pod host reads only its own
+     range of the ONE shared file and ids are agreed collectively)
     python -m tpu_als.cli evaluate --model /tmp/model --data ...
     python -m tpu_als.cli recommend --model /tmp/model --users 1,2,3 --k 10
     python -m tpu_als.cli foldin-bench --model /tmp/model
@@ -20,6 +23,199 @@ import math
 import sys
 
 import numpy as np
+
+
+def _vocab_lookup(labels, g):
+    """Positions of ``labels`` in the sorted vocabulary ``g`` plus a
+    known-mask, width-normalized once per array (shared by the eval and
+    fold-in loaders — one definition, reviewer r5)."""
+    import numpy as np
+
+    w = max(labels.dtype.itemsize, g.dtype.itemsize, 1)
+    lw = labels.astype(f"S{w}")
+    gw = g.astype(f"S{w}")
+    pos = np.searchsorted(gw, lw)
+    known = np.zeros(len(labels), dtype=bool)
+    inb = pos < len(g)
+    known[inb] = gw[pos[inb]] == lw[inb]
+    return pos, known
+
+
+def _load_stream(path, host_index=0, num_hosts=1, vocab=None):
+    """config-3-scale loader (``stream:PATH``): STRING-id ratings csv
+    (``user_id,item_id,rating,timestamp`` with a header — the
+    Amazon-2023 shape) streamed through the bounded-memory byte-range
+    reader; ids densified into the globally-agreed (lexicographic)
+    entity space.  Multi-process: each host streams only its byte range
+    and the vocabularies are agreed with one collective — no ``{proc}``
+    file splits needed.  Returns ``(frame, user_labels, item_labels)``
+    (labels are numpy ``S``-dtype arrays, saved beside the model).
+
+    ``vocab``: optional ``(user_labels, item_labels)`` from a trained
+    model's ``stream_labels.npz`` sidecar.  Eval/serving data MUST be
+    densified in the MODEL's id space — re-deriving a vocabulary from
+    the eval file would silently score user b with user a's factors
+    (reviewer, round 5).  Rows whose labels the model never saw are
+    dropped (the cold-start ``'drop'`` semantics) with a stderr count.
+    """
+    import numpy as np
+
+    from tpu_als.io.stream import stream_ingest
+    from tpu_als.parallel.multihost import global_vocab_union
+    from tpu_als.utils.frame import ColumnarFrame
+
+    u_loc, i_loc, r, ul, il = stream_ingest(
+        path, host_index, num_hosts, require_cols=4, skip_header=1)
+
+    if vocab is None:
+        g_ul, g_il = global_vocab_union(ul), global_vocab_union(il)
+        u = np.searchsorted(g_ul, ul)[u_loc]
+        i = np.searchsorted(g_il, il)[i_loc]
+    else:
+        g_ul, g_il = vocab
+        pu, ku = _vocab_lookup(ul, g_ul)
+        pi, ki = _vocab_lookup(il, g_il)
+        keep = ku[u_loc] & ki[i_loc]
+        dropped = int(len(u_loc) - keep.sum())
+        if dropped:
+            print(f"stream eval: dropped {dropped:,}/{len(u_loc):,} "
+                  "rows with user/item ids unknown to the model",
+                  file=sys.stderr)
+        u = pu[u_loc][keep]
+        i = pi[i_loc][keep]
+        r = r[keep]
+    return (ColumnarFrame({"user": u, "item": i, "rating": r}),
+            g_ul, g_il)
+
+
+def _load_train_data(args, pid=0, pcount=1):
+    """The one stream-aware loader both train paths share (reviewer,
+    round 5 — the spec dispatch must not live in three places).
+    Returns ``(frame, stream_labels_or_None)``.
+
+    ``stream:`` byte-range policy: a ``{proc}`` placeholder means the
+    files are ALREADY per-host splits, so each host streams its whole
+    expanded file (byte-splitting on top would silently drop
+    (pcount-1)/pcount of every split); otherwise ``--per-host-data``
+    byte-splits the one shared file, and replicated mode streams it
+    whole on every host.  Vocabularies are agreed collectively in every
+    multi-process case.
+
+    ``{proc}`` expands ONLY under a real multi-process deployment: a
+    single process expanding it to 0 would silently train on 1/N of the
+    data where the literal path used to fail loudly (reviewer r5)."""
+    spec = (args.data.replace("{proc}", str(pid)) if pcount > 1
+            else args.data)
+    kind, _, arg = spec.partition(":")
+    if kind != "stream":
+        return _load_data(spec), None
+    if spec != args.data:
+        host, hosts = 0, 1     # per-host FILES: stream each one whole
+    elif getattr(args, "per_host_data", False):
+        host, hosts = pid, pcount
+    else:
+        host, hosts = 0, 1
+    frame, g_ul, g_il = _load_stream(arg, host, hosts)
+    return frame, (g_ul, g_il)
+
+
+def _model_vocab(model_dir):
+    import os
+
+    import numpy as np
+
+    side = os.path.join(model_dir, "stream_labels.npz")
+    if not os.path.exists(side):
+        raise SystemExit(
+            "stream: eval data needs the model's stream_labels.npz "
+            "sidecar (present when the model was trained with "
+            "--data stream:...); this model has none")
+    z = np.load(side)
+    return z["users"], z["items"]
+
+
+def _load_eval_data(spec, model_dir):
+    """Eval/serving-side loader: a ``stream:`` spec is densified in the
+    MODEL's id space via its ``stream_labels.npz`` sidecar."""
+    kind, _, arg = spec.partition(":")
+    if kind != "stream":
+        return _load_data(spec)
+    frame, _, _ = _load_stream(arg, vocab=_model_vocab(model_dir))
+    return frame
+
+
+def _load_foldin_data(spec, model_dir, new_side):
+    """Fold-in loader: the whole POINT of fold-in is ids the model has
+    never seen, so the ``new_side`` ("user" for --foldin-data, "item"
+    for --foldin-items-data) maps known labels through the sidecar and
+    assigns FRESH dense ids (after the model's space, first-seen order)
+    to new ones; the opposite side must be known (its factors do the
+    folding) and unknown rows there are dropped with a count.
+
+    Returns ``(frame, new_labels)`` — new_labels[j] is the original
+    string id behind dense id ``len(model_side) + j``.
+    """
+    import numpy as np
+
+    kind, _, arg = spec.partition(":")
+    if kind != "stream":
+        return _load_data(spec), []
+    g_ul, g_il = _model_vocab(model_dir)
+    from tpu_als.io.stream import stream_ingest
+    from tpu_als.utils.frame import ColumnarFrame
+
+    u_loc, i_loc, r, ul, il = stream_ingest(
+        arg, require_cols=4, skip_header=1)
+
+    pu, ku = _vocab_lookup(ul, g_ul)
+    pi, ki = _vocab_lookup(il, g_il)
+    # the keep-filter (opposite side known) runs FIRST: a new-side
+    # entity whose every row is dropped must get NO fresh id — a fresh
+    # id without a folded factor row would later resolve in --users and
+    # serve a row the FoldInServer never solved (reviewer r5)
+    if new_side == "user":
+        keep = ki[i_loc]
+        loc, base, labels_side = u_loc, g_ul, ul
+        pos = pu
+        unknown = ~ku
+    else:
+        keep = ku[u_loc]
+        loc, base, labels_side = i_loc, g_il, il
+        pos = pi
+        unknown = ~ki
+    surviving = np.zeros(len(labels_side), dtype=bool)
+    surviving[np.unique(loc[keep])] = True
+    fresh = unknown & surviving
+    pos[fresh] = len(base) + np.arange(int(fresh.sum()))
+    new_labels = [s.decode() for s in labels_side[fresh].tolist()]
+    dropped = int(len(u_loc) - keep.sum())
+    if dropped:
+        opp = "item" if new_side == "user" else "user"
+        print(f"stream fold-in: dropped {dropped:,}/{len(u_loc):,} "
+              f"rows with {opp} ids unknown to the model (the known "
+              f"{opp} factors are what fold the new {new_side}s in)",
+              file=sys.stderr)
+    frame = ColumnarFrame({"user": pu[u_loc][keep],
+                           "item": pi[i_loc][keep], "rating": r[keep]})
+    if new_labels:
+        print(f"stream fold-in: {len(new_labels)} new {new_side} ids "
+              f"-> dense {len(g_ul if new_side == 'user' else g_il)}+"
+              f" (first-seen): {new_labels[:5]}"
+              f"{'...' if len(new_labels) > 5 else ''}",
+              file=sys.stderr)
+    return frame, new_labels
+
+
+def _save_stream_labels(out_dir, user_labels, item_labels):
+    """Sidecar mapping dense ids -> original string ids, next to the
+    model manifest (the stream loader's analog of persisting the fitted
+    StringIndexerModels)."""
+    import os
+
+    import numpy as np
+
+    np.savez(os.path.join(out_dir, "stream_labels.npz"),
+             users=user_labels, items=item_labels)
 
 
 def _load_data(spec):
@@ -37,12 +233,15 @@ def _load_data(spec):
         return load_movielens_csv(arg)
     if kind == "dat":
         return load_movielens_dat(arg)
+    if kind == "stream":
+        return _load_stream(arg)[0]
     if kind == "synthetic":
         nu, ni, nnz = (int(x) for x in arg.split("x"))
         return synthetic_movielens(nu, ni, nnz)
     raise SystemExit(f"unknown data spec {spec!r} "
                      "(use ml-100k:PATH | csv:PATH | dat:PATH (ml-1m/10m "
-                     "ratings.dat) | synthetic:UxIxN)")
+                     "ratings.dat) | stream:PATH (string-id csv with "
+                     "header, streamed) | synthetic:UxIxN)")
 
 
 def cmd_train(args):
@@ -69,7 +268,7 @@ def cmd_train(args):
             "--per-host-data is multi-process only (each process loads "
             "its own split); launch under a JAX distributed rendezvous "
             "with --devices 0 — single-process runs load one dataset")
-    frame = _load_data(args.data)
+    frame, stream_labels = _load_train_data(args)
     train, test = frame.randomSplit([1 - args.holdout, args.holdout],
                                     seed=args.seed)
     logger = IterationLogger(path=args.log_file) if args.log_file else None
@@ -102,6 +301,8 @@ def cmd_train(args):
         # CLI --output semantics: replace (atomically) — a rerun must not
         # crash after the whole training finished
         model.write().overwrite().save(args.output)
+        if stream_labels is not None:
+            _save_stream_labels(args.output, *stream_labels)
         print(f"model saved to {args.output}", file=sys.stderr)
     return model
 
@@ -139,13 +340,15 @@ def _train_multiprocess(args):
             f"({visible} devices); pass --devices 0")
 
     spec = args.data.replace("{proc}", str(pid))
-    if args.per_host_data and args.data == spec and pcount > 1:
+    if (args.per_host_data and args.data == spec and pcount > 1
+            and spec.partition(":")[0] != "stream"):
+        # a stream: spec needs no placeholder — it splits by byte range
         print(f"[proc {pid}] warning: --per-host-data without a {{proc}} "
               "placeholder in --data — every host loads the same path "
               "(valid only for host-LOCAL disks holding different "
               "splits; identical content is rejected at train time)",
               file=sys.stderr)
-    frame = _load_data(spec)
+    frame, stream_labels = _load_train_data(args, pid, pcount)
     # the split seed is deliberately IDENTICAL across hosts: per-host
     # data is disjoint anyway, and a per-pid seed would decorrelate the
     # splits of an accidentally-shared file, defeating the trainer's
@@ -189,6 +392,8 @@ def _train_multiprocess(args):
         print(json.dumps({"holdout_rmse": round(rmse, 4)}))
     if args.output:
         model.write().overwrite().save(args.output)
+        if stream_labels is not None:
+            _save_stream_labels(args.output, *stream_labels)
         print(f"model saved to {args.output}", file=sys.stderr)
     return model
 
@@ -216,7 +421,7 @@ def cmd_evaluate(args):
             "runs recommendForUserSubset on raw ids); evaluate the "
             "pipeline's ALS stage directly, or drop --ranking-k for "
             "regression metrics through the full pipeline")
-    frame = _load_data(args.data)
+    frame = _load_eval_data(args.data, args.model)
     out = model.transform(frame)
     result = {}
     for metric in ("rmse", "mae", "r2"):
@@ -283,6 +488,7 @@ def cmd_recommend(args):
             "(PipelineModel.load(path).stages[-1]), mapping indices "
             "back with IndexToString — see "
             "examples/02_pipeline_string_ids.py")
+    new_user_labels, new_item_labels = [], []
     if (getattr(args, "foldin_data", None)
             or getattr(args, "foldin_items_data", None)):
         # the full serving flow in one command (SURVEY.md §3.5): fold the
@@ -293,12 +499,14 @@ def cmd_recommend(args):
 
         srv = FoldInServer(model)
         if getattr(args, "foldin_items_data", None):
-            batch = _load_data(args.foldin_items_data)
+            batch, new_item_labels = _load_foldin_data(
+                args.foldin_items_data, args.model, "item")
             touched = srv.update_items(batch)
             print(f"folded in {len(batch)} ratings touching "
                   f"{len(touched)} items", file=sys.stderr)
         if getattr(args, "foldin_data", None):
-            batch = _load_data(args.foldin_data)
+            batch, new_user_labels = _load_foldin_data(
+                args.foldin_data, args.model, "user")
             touched = srv.update(batch)
             print(f"folded in {len(batch)} ratings touching "
                   f"{len(touched)} users", file=sys.stderr)
@@ -320,8 +528,28 @@ def cmd_recommend(args):
 
         mesh = make_mesh(devices if devices > 0 else None)
     strategy = getattr(args, "gather_strategy", "all_gather")
+    stream_names = None   # (user dense->label, item labels) for output
     if args.users:
-        ids = np.array([int(x) for x in args.users.split(",")])
+        toks = args.users.split(",")
+        try:
+            ids = np.array([int(x) for x in toks])
+        except ValueError:
+            # string ids: resolve via the stream-trained model's label
+            # sidecar, plus any users just folded in this invocation
+            g_ul, g_il = _model_vocab(args.model)
+            index = {s.decode(): k for k, s in enumerate(g_ul.tolist())}
+            for j, lab in enumerate(new_user_labels):
+                index.setdefault(lab, len(g_ul) + j)
+
+            def resolve(t):
+                if t not in index:
+                    raise SystemExit(
+                        f"unknown user id {t!r} (not in the model's "
+                        "stream_labels sidecar nor in --foldin-data)")
+                return index[t]
+
+            ids = np.array([resolve(t) for t in toks])
+            stream_names = ({v: k for k, v in index.items()}, g_il)
         recs = model.recommendForUserSubset(
             ColumnarFrame({model._params["userCol"]: ids}), args.k,
             mesh=mesh, gatherStrategy=strategy)
@@ -334,6 +562,19 @@ def cmd_recommend(args):
         out = {"user": int(recs[key][row]),
                "items": [[int(i), round(float(s), 4)]
                          for i, s in recs["recommendations"][row]]}
+        if stream_names is not None:
+            rev_u, g_il = stream_names
+
+            def item_name(i):
+                if i < len(g_il):
+                    return g_il[i].decode()
+                j = i - len(g_il)   # freshly folded-in item this call
+                return (new_item_labels[j]
+                        if j < len(new_item_labels) else None)
+
+            out["user_id"] = rev_u.get(int(recs[key][row]))
+            out["item_ids"] = [item_name(int(i))
+                               for i, _ in recs["recommendations"][row]]
         if titles is not None:
             out["titles"] = [titles.get(int(i))
                              for i, _ in recs["recommendations"][row]]

@@ -556,3 +556,25 @@ def test_fitMultiple_snapshots_estimator_state(rng):
     als.setRank(9)  # mutate AFTER the iterator was created
     _, model = next(it)
     assert model.rank == 3  # snapshot, not live state
+
+
+def test_low_reg_rank256_conditioning_warning(rng):
+    """regParam below the measured f32 conditioning floor at rank>=256
+    warns (docs/conditioning_rank256.md) — including regParam=0, the
+    most ill-conditioned setting; normal configs stay silent."""
+    import warnings
+
+    import pytest
+
+    from conftest import make_ratings
+
+    from tpu_als import ALS, ColumnarFrame
+
+    u, i, r, _, _ = make_ratings(rng, 40, 30, rank=3, density=0.4)
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    for reg in (5e-5, 0.0):
+        with pytest.warns(UserWarning, match="conditioning floor"):
+            ALS(rank=256, maxIter=1, regParam=reg, seed=0).fit(frame)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        ALS(rank=256, maxIter=1, regParam=0.02, seed=0).fit(frame)

@@ -666,3 +666,26 @@ def test_proc_placeholder_is_literal_single_process(tmp_path):
     with _pytest.raises(FileNotFoundError):
         main(["train", "--data", f"csv:{tmp_path}/part-{{proc}}.csv",
               "--rank", "3", "--max-iter", "1"])
+
+
+@pytest.mark.slow
+def test_cli_tune_stream_saves_sidecar(tmp_path, capsys):
+    from tpu_als.cli import main
+
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    csv = tmp_path / "t.csv"
+    with open(csv, "w") as f:
+        f.write("user_id,parent_asin,rating,timestamp\n")
+        for k in range(1200):
+            f.write(f"rev_{rng.integers(25):02d},"
+                    f"B{rng.integers(15):02d},"
+                    f"{rng.integers(1, 10) / 2.0},1600\n")
+    out = tmp_path / "cv"
+    main(["tune", "--data", f"stream:{csv}", "--ranks", "2,4",
+          "--reg-params", "0.05", "--folds", "2", "--max-iter", "2",
+          "--seed", "0", "--output", str(out)])
+    assert "best_rank" in capsys.readouterr().out
+    side = np.load(out / "stream_labels.npz")
+    assert len(side["users"]) == 25 and len(side["items"]) == 15

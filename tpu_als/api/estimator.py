@@ -307,6 +307,19 @@ class ALS(_ALSParams, Estimator):
         # fit()/fitMultiple() param-map overloads come from the shared
         # api.params.Estimator base (reference python/pyspark/ml/base.py)
         self._validate()
+        _g = lambda n: self.getOrDefault(self.getParam(n))  # noqa: E731
+        if _g("rank") >= 256 and _g("regParam") < 1e-4:
+            # the round-5 conditioning study's measured boundary
+            # (docs/conditioning_rank256.md): below reg 1e-4 the f32
+            # normal equations lose their 3-significant-digit guarantee
+            # under adversarially collinear gathers — and at reg=0 they
+            # are outright singular for entities with degree < rank
+            import warnings
+
+            warnings.warn(
+                f"regParam={_g('regParam')} at rank {_g('rank')} is "
+                "below the measured float32 conditioning floor (1e-4) "
+                "— see docs/conditioning_rank256.md", stacklevel=3)
         frame = as_frame(dataset)
         ratingCol = self.getRatingCol()
         u_raw, i_raw, r, nonfinite = self._extract_columns(frame)

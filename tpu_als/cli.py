@@ -587,7 +587,7 @@ def cmd_tune(args):
     from tpu_als import ALS, RegressionEvaluator
     from tpu_als.api.tuning import CrossValidator, ParamGridBuilder
 
-    frame = _load_data(args.data)
+    frame, stream_labels = _load_train_data(args)
     als = ALS(maxIter=args.max_iter, implicitPrefs=args.implicit,
               alpha=args.alpha, seed=args.seed, coldStartStrategy="drop",
               cgIters=args.cg_iters)
@@ -622,6 +622,8 @@ def cmd_tune(args):
     print(json.dumps(out))
     if args.output:
         cv_model.write().overwrite().save(args.output)
+        if stream_labels is not None:
+            _save_stream_labels(args.output, *stream_labels)
         print(f"best model saved to {args.output}", file=sys.stderr)
 
 

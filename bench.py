@@ -229,9 +229,11 @@ _BUILDER_MEASURED = {
                            "(global-mean predictor = 1.0489)",
     },
     "foldin": {
-        "value": 0.102, "unit": "seconds_p50",
-        "measured_at": "round 1 (no prewarm; p95 1.13 s)",
-        "source_log": "BASELINE.md row 4",
+        "value": 0.0817, "unit": "seconds_p50",
+        "measured_at": "2026-07-31 (host CPU, post-prewarm; p95 0.0936 "
+                       "= 1.15x p50, prewarm 8.5 s reported separately; "
+                       "the on-chip foldin sweep step supersedes this)",
+        "source_log": "sweep_logs/foldin_cpu_r5.out",
         "resolved_config": "512 ratings/batch, 30 batches, rank 128, "
                            "59047-item catalog",
     },

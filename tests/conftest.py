@@ -46,17 +46,26 @@ def make_ratings(rng, num_users=60, num_items=40, rank=4, density=0.3, noise=0.0
 
 
 @pytest.fixture(autouse=True, scope="module")
-def _bound_live_executables_per_module():
-    """Drop jax's compiled-program caches between test modules.
+def _bound_live_executables_per_module(request):
+    """Drop jax's compiled-program caches after compile-heavy modules.
 
     The CPU harness compiles thousands of tiny executables in ONE
-    process across 30+ modules; jaxlib's CPU JIT segfaults inside
+    process across 35+ modules; jaxlib's CPU JIT segfaults inside
     ``backend_compile_and_load`` once too many live executables
     accumulate — reproducibly at the same compile in two full-suite
-    runs (test_stream_io's first fold-in jit), while every subset of
-    the suite passes.  Clearing at module boundaries keeps the count
-    bounded for the cost of per-module recompiles.  TPU/bench runs
-    never load this conftest and are unaffected.
+    runs (test_stream_io's first fold-in jit, test ~380 of 408), while
+    every subset of the suite passes.  Clearing after every module that
+    ran a ``slow``-marked test (the interpret-mode Pallas, spawned-
+    process, and e2e modules are where the executables pile up) keeps
+    the live count at fast-tier levels — which ran the whole history of
+    this repo without ever hitting the limit — while the fast tier
+    itself (``-m "not slow"``) pays no recompiles at all.  TPU/bench
+    runs never load this conftest and are unaffected.
     """
     yield
-    jax.clear_caches()
+    mod = request.node
+    for item in request.session.items:
+        if (item.getparent(pytest.Module) is mod
+                and item.get_closest_marker("slow") is not None):
+            jax.clear_caches()
+            return

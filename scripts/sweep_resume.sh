@@ -60,12 +60,11 @@ STEPS=(
   "ml100k|300|python bench.py --no-auto-config --mode ml100k --probe-attempts 1"
   "reconfirm_f32|580|python bench.py --no-auto-config --iters 5 --probe-attempts 1"
   "headline_ab|1200|python bench.py --no-auto-config --iters 5 --ab cg2,cg3,cg2_dense,bf16,cg2_bf16,wg15,bf16_wg15 --ab-dir sweep_logs --probe-attempts 1"
+  "rmse_ab|1500|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --ab bf16,cg2_bf16,cg2 --ab-dir sweep_logs --probe-attempts 1"
   "serve|420|python bench.py --no-auto-config --mode serve --probe-attempts 1"
   "serve_bf16|420|python bench.py --no-auto-config --mode serve --compute-dtype bfloat16 --probe-attempts 1"
   "foldin|580|python bench.py --no-auto-config --mode foldin --probe-attempts 1"
   "kernel_lab|580|python scripts/kernel_lab.py --panels 4 8 16"
-  "cg2_rmse|700|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --ab cg2 --ab-dir sweep_logs --probe-attempts 1"
-  "rmse_ab|1500|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --ab cg2,bf16,cg2_bf16 --ab-dir sweep_logs --probe-attempts 1"
   "rank256_proxy|900|python scripts/rank256_proxy.py"
   "kernel_lab_r256|580|python scripts/kernel_lab.py --rank 256 --n 8192 --panels 4 8 16"
   "ablate_full_cg2|900|python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2"

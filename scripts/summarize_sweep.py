@@ -40,6 +40,8 @@ def main():
     rows = []
     for path in outs:
         name = os.path.basename(path)[:-4]
+        if name == "nohup":  # watcher stdout, not step evidence
+            continue
         j = last_json_line(path)
         if j is None:
             tail = ""

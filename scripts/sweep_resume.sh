@@ -59,7 +59,7 @@ STEPS=(
   "cg2_headline|700|python bench.py --no-auto-config --iters 5 --ab cg2 --ab-dir sweep_logs --probe-attempts 1"
   "ml100k|300|python bench.py --no-auto-config --mode ml100k --probe-attempts 1"
   "reconfirm_f32|580|python bench.py --no-auto-config --iters 5 --probe-attempts 1"
-  "headline_ab|1200|python bench.py --no-auto-config --iters 5 --ab cg2,cg3,cg2_dense,bf16,cg2_bf16,wg15,bf16_wg15 --ab-dir sweep_logs --probe-attempts 1"
+  "headline_ab|1200|python bench.py --no-auto-config --iters 5 --ab bf16,wg15,bf16_wg15,cg2_bf16,cg3,cg2_dense,cg2 --ab-dir sweep_logs --probe-attempts 1"
   "rmse_ab|1500|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --ab bf16,cg2_bf16,cg2 --ab-dir sweep_logs --probe-attempts 1"
   "serve|420|python bench.py --no-auto-config --mode serve --probe-attempts 1"
   "serve_bf16|420|python bench.py --no-auto-config --mode serve --compute-dtype bfloat16 --probe-attempts 1"

@@ -837,6 +837,17 @@ def run_rmse(args):
         U.block_until_ready()
         train_s = time.time() - t0
         log(f"trained {cfg.max_iter} iters in {train_s:.1f}s")
+        warm_s = None
+        if args.mode == "ml100k":
+            # the cold fit above is compile-dominated on accelerators at
+            # this tiny shape; a second in-process fit (jit cache warm)
+            # is what a user iterating on hyperparameters experiences,
+            # and what CrossValidator cells pay after the first
+            t0 = time.time()
+            U2, _ = train(ucsr, icsr, cfg)
+            U2.block_until_ready()
+            warm_s = time.time() - t0
+            log(f"warm re-fit (compile cached): {warm_s:.1f}s")
 
         # chunked held-out scoring (test set can be >1M pairs)
         se, cnt = 0.0, 0
@@ -870,6 +881,8 @@ def run_rmse(args):
         if args.mode == "ml100k":
             config["heldout_rmse"] = round(rmse, 4)
             config["global_mean_rmse"] = round(base, 4)
+            if warm_s is not None:
+                config["train_seconds_warm"] = round(warm_s, 2)
             return {
                 "value": round(train_s, 2),
                 "unit": "seconds_fit_wallclock",

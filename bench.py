@@ -74,11 +74,17 @@ def call_with_timeout(fn, seconds, what):
 def tpu_ready(attempts=6, wait_s=90, probe_timeout_s=120):
     """Probe backend init in a subprocess (a hung tunnel cannot wedge us).
 
-    Returns (ok, error_string).  Retries ``attempts`` times, ``wait_s``
-    apart — the tunnel is known to recover on its own.
+    Returns ``(ok, error_string, events)``.  Retries ``attempts`` times,
+    ``wait_s`` apart — the tunnel is known to recover on its own.  Each
+    failed attempt is logged as ONE structured JSONL ``bench_retry``
+    event (the tpu_als.obs.schema shape, constructed inline: importing
+    tpu_als here would pull jax into THIS process ahead of the
+    subprocess probe, defeating the hang isolation) so a log scraper
+    gets attempt counts and wait reasons without parsing prose.
     """
     code = "import jax; d = jax.devices(); print(len(d), d[0].device_kind)"
     err = "unknown"
+    events = []
     for k in range(attempts):
         t0 = time.time()
         try:
@@ -89,17 +95,22 @@ def tpu_ready(attempts=6, wait_s=90, probe_timeout_s=120):
             if p.returncode == 0:
                 log(f"backend probe ok ({time.time()-t0:.0f}s): "
                     f"{p.stdout.strip()}")
-                return True, ""
+                return True, "", events
             tail = [ln for ln in (p.stderr or "").strip().splitlines()
                     if ln.strip()]
             err = tail[-1] if tail else f"probe rc={p.returncode}"
         except subprocess.TimeoutExpired:
             err = (f"backend init hung >{probe_timeout_s}s "
                    "(axon tunnel unresponsive)")
-        log(f"backend probe attempt {k + 1}/{attempts} failed: {err}")
+        ev = {"ts": round(time.time(), 6), "type": "bench_retry",
+              "attempt": k + 1, "attempts": attempts,
+              "elapsed_seconds": round(time.time() - t0, 3),
+              "reason": err}
+        events.append(ev)
+        log(json.dumps(ev))
         if k + 1 < attempts:
             time.sleep(wait_s)
-    return False, err
+    return False, err, events
 
 
 # headline sweep step -> the flag overrides it measured
@@ -315,8 +326,8 @@ def builder_measured_provenance(mode, sweep_dir="sweep_logs"):
     return best or _BUILDER_MEASURED.get(mode)
 
 
-def error_json(args, metric, unit, err):
-    return {
+def error_json(args, metric, unit, err, probe_events=None):
+    out = {
         "metric": metric, "value": None, "unit": unit,
         "vs_baseline": None,
         "error": err,
@@ -327,6 +338,9 @@ def error_json(args, metric, unit, err):
         # transports a number + where it came from
         "last_builder_measured": builder_measured_provenance(args.mode),
     }
+    if probe_events:
+        out["probe_events"] = probe_events
+    return out
 
 
 def synthetic_cached(nU, nI, nnz, seed=0):
@@ -1282,10 +1296,11 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
     else:
-        ok, err = tpu_ready(args.probe_attempts, args.probe_wait,
-                            args.probe_timeout)
+        ok, err, probe_events = tpu_ready(
+            args.probe_attempts, args.probe_wait, args.probe_timeout)
         if not ok:
-            print(json.dumps(error_json(args, metric, unit, err)))
+            print(json.dumps(error_json(args, metric, unit, err,
+                                        probe_events=probe_events)))
             return
         # a step retried in the next tunnel window skips its warmup
         # compile if the executable was cached before the tunnel died

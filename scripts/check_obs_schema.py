@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Static schema check over observability call sites.
+
+The registry validates metric/event names at call time
+(tpu_als.obs.schema), but a call site on a cold path — a checkpoint
+format branch, a multi-process-only event — may not execute under the
+test suite at all.  This script closes that gap statically: it greps
+every ``.counter( / .gauge( / .histogram( / .emit(`` call site (plus
+inline ``{"ts": ..., "type": "..."}`` event dicts, the shape bench.py
+builds because it must not import tpu_als before its subprocess backend
+probe) and fails when a LITERAL name is not declared in
+``tpu_als.obs.schema``, is used with the wrong kind, or when a name is
+non-literal outside ``tpu_als/obs/`` itself (a computed name defeats
+the static check — route it through a declared vocabulary instead).
+
+Run directly (exit 1 + file:line diagnostics on violation) or from the
+tier-1 suite (tests/test_obs.py).  ``--paths`` overrides the scanned
+tree (the negative test exercises the failure mode on a fixture file).
+
+Deliberately jax-free and import-light: only tpu_als.obs.schema is
+imported, which itself imports nothing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_als.obs import schema  # noqa: E402
+
+# a counter/gauge/histogram/emit call with either a literal first
+# argument (named groups q/name) or anything else (group expr)
+CALL_RE = re.compile(
+    r"\.(?P<method>counter|gauge|histogram|emit)\(\s*"
+    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<expr>[^)\s][^),]*))")
+
+# inline event dicts: a line carrying both a "ts" key and a literal
+# "type" value (the hand-built shape allowed where importing tpu_als is
+# off-limits)
+INLINE_RE = re.compile(r"['\"]type['\"]\s*:\s*['\"](?P<name>\w+)['\"]")
+INLINE_TS_RE = re.compile(r"['\"]ts['\"]\s*:")
+
+DEFAULT_ROOTS = ("tpu_als", "scripts", "bench.py")
+
+
+def _py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, _, files in os.walk(p):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def check_file(path):
+    errors = []
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    rel = os.path.relpath(path, REPO)
+    # the registry/schema themselves pass names through variables
+    in_obs = "tpu_als/obs/" in path.replace(os.sep, "/") \
+        or path.replace(os.sep, "/").endswith("scripts/check_obs_schema.py")
+
+    def line_of(pos):
+        return text.count("\n", 0, pos) + 1
+
+    for m in CALL_RE.finditer(text):
+        method, name = m.group("method"), m.group("name")
+        where = f"{rel}:{line_of(m.start())}"
+        if name is None:
+            if not in_obs:
+                errors.append(
+                    f"{where}: {method}() with a non-literal name "
+                    f"({m.group('expr').strip()!r}) — the static check "
+                    "cannot validate it; use a literal declared in "
+                    "tpu_als.obs.schema")
+            continue
+        if method == "emit":
+            if name not in schema.EVENTS:
+                errors.append(
+                    f"{where}: emit of undeclared event type {name!r} "
+                    "(declare it in tpu_als.obs.schema.EVENTS)")
+        else:
+            decl = schema.METRICS.get(name)
+            if decl is None:
+                errors.append(
+                    f"{where}: {method} of undeclared metric {name!r} "
+                    "(declare it in tpu_als.obs.schema.METRICS)")
+            elif decl[0] != method:
+                errors.append(
+                    f"{where}: metric {name!r} is declared as a "
+                    f"{decl[0]}, used as a {method}")
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not INLINE_TS_RE.search(line):
+            continue
+        for m in INLINE_RE.finditer(line):
+            name = m.group("name")
+            if name not in schema.EVENTS:
+                errors.append(
+                    f"{rel}:{lineno}: inline event dict with undeclared "
+                    f"type {name!r} (declare it in "
+                    "tpu_als.obs.schema.EVENTS)")
+    return errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="statically validate observability call sites "
+                    "against tpu_als.obs.schema")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="files/dirs to scan (default: tpu_als/, "
+                         "scripts/, bench.py under the repo root)")
+    args = ap.parse_args(argv)
+    paths = args.paths or [os.path.join(REPO, p) for p in DEFAULT_ROOTS]
+    errors = []
+    nfiles = 0
+    for path in _py_files(paths):
+        nfiles += 1
+        errors.extend(check_file(path))
+    if errors:
+        print("\n".join(errors), file=sys.stderr)
+        print(f"check_obs_schema: {len(errors)} violation(s) in "
+              f"{nfiles} files", file=sys.stderr)
+        return 1
+    print(f"check_obs_schema: OK ({nfiles} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

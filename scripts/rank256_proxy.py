@@ -55,7 +55,7 @@ def main():
     else:
         from bench import tpu_ready
 
-        ok, err = tpu_ready()
+        ok, err, _ = tpu_ready()
         if not ok:
             print(json.dumps({"metric": metric, "value": None,
                               "unit": "iters/sec", "vs_baseline": None,

@@ -139,11 +139,14 @@ def _shmap_psum_fn(mesh, branch_bytes_differ=False, while_pred=False):
     collective in a while predicate rejected)."""
     from functools import partial
 
-    from jax.experimental.shard_map import shard_map
+    from tpu_als.parallel.mesh import shard_map
 
     spec = P(AXIS)
-
-    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=P())
+    # check_vma=False: these programs put the psum inside cond/while, and
+    # older jax's replication inference can't see through control flow —
+    # the audit's own branch/predicate checks are what's under test here
+    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=P(),
+             check_vma=False)
     def equal_branches(x):
         return jax.lax.cond(
             x.sum() > 0,
@@ -151,7 +154,8 @@ def _shmap_psum_fn(mesh, branch_bytes_differ=False, while_pred=False):
             lambda v: jax.lax.psum(v.sum() * 2.0, AXIS),
             x)
 
-    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=P())
+    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=P(),
+             check_vma=False)
     def unequal_branches(x):
         return jax.lax.cond(
             x.sum() > 0,
@@ -159,7 +163,8 @@ def _shmap_psum_fn(mesh, branch_bytes_differ=False, while_pred=False):
             lambda v: jax.lax.psum(v.sum(), AXIS) * 0.0,
             x)
 
-    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=P())
+    @partial(shard_map, mesh=mesh, in_specs=(spec,), out_specs=P(),
+             check_vma=False)
     def psum_in_while_pred(x):
         return jax.lax.while_loop(
             lambda s: jax.lax.psum(s.sum(), AXIS) > 1.0,

@@ -422,12 +422,16 @@ class ALS(_ALSParams, Estimator):
             U, V = mode_fit(self, u_idx, i_idx, r, user_map, item_map,
                             cfg, init, start_iter)
         else:
+            from tpu_als import obs
+
             callback = self._checkpoint_callback(user_map, item_map)
-            ucsr = build_csr_buckets(u_idx, i_idx, r, len(user_map))
-            icsr = build_csr_buckets(i_idx, u_idx, r, len(item_map))
-            U, V = _train(ucsr, icsr, cfg, callback=callback, init=init,
-                          start_iter=start_iter)
-            U, V = np.asarray(U), np.asarray(V)
+            with obs.span("train.block"):
+                ucsr = build_csr_buckets(u_idx, i_idx, r, len(user_map))
+                icsr = build_csr_buckets(i_idx, u_idx, r, len(item_map))
+            with obs.span("train.fit"):
+                U, V = _train(ucsr, icsr, cfg, callback=callback,
+                              init=init, start_iter=start_iter)
+                U, V = np.asarray(U), np.asarray(V)
 
         return self._make_model(user_map, item_map, U, V)
 

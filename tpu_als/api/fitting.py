@@ -178,6 +178,7 @@ def fit_sharded(est, u_idx, i_idx, r, user_map, item_map, cfg,
 
     Returns entity-space ``(U, V)``.
     """
+    from tpu_als import obs
     from tpu_als.parallel.data import partition_balanced, shard_csr
     from tpu_als.parallel.trainer import (
         comm_bytes_per_iter,
@@ -187,38 +188,42 @@ def fit_sharded(est, u_idx, i_idx, r, user_map, item_map, cfg,
 
     callback = est._checkpoint_callback(user_map, item_map)
     D = est.mesh.devices.size
-    upart = partition_balanced(
-        np.bincount(u_idx, minlength=len(user_map)), D)
-    ipart = partition_balanced(
-        np.bincount(i_idx, minlength=len(item_map)), D)
+    obs.update_manifest(mesh_shape=list(est.mesh.devices.shape),
+                        mesh_devices=int(D))
+    with obs.span("train.partition"):
+        upart = partition_balanced(
+            np.bincount(u_idx, minlength=len(user_map)), D)
+        ipart = partition_balanced(
+            np.bincount(i_idx, minlength=len(item_map)), D)
     strategy = est.gatherStrategy
     ring_counts = None
-    if strategy == "ring":
-        from tpu_als.parallel.comm import shard_csr_grid
+    with obs.span("train.block", strategy=strategy):
+        if strategy == "ring":
+            from tpu_als.parallel.comm import shard_csr_grid
 
-        ush = shard_csr_grid(upart, ipart, u_idx, i_idx, r)
-        ish = shard_csr_grid(ipart, upart, i_idx, u_idx, r)
-        pos = cfg.implicit_prefs
-        ring_counts = (
-            stacked_counts(upart, u_idx, r, positive_only=pos),
-            stacked_counts(ipart, i_idx, r, positive_only=pos))
-    elif strategy == "all_to_all":
-        from tpu_als.parallel.a2a import build_a2a
+            ush = shard_csr_grid(upart, ipart, u_idx, i_idx, r)
+            ish = shard_csr_grid(ipart, upart, i_idx, u_idx, r)
+            pos = cfg.implicit_prefs
+            ring_counts = (
+                stacked_counts(upart, u_idx, r, positive_only=pos),
+                stacked_counts(ipart, i_idx, r, positive_only=pos))
+        elif strategy == "all_to_all":
+            from tpu_als.parallel.a2a import build_a2a
 
-        ush = build_a2a(upart, ipart, u_idx, i_idx, r,
-                        on_degenerate="stub")
-        ish = build_a2a(ipart, upart, i_idx, u_idx, r,
-                        on_degenerate="stub")
-        if ush.degenerate or ish.degenerate:
-            # one hot (src, dst) pair inflated the uniform request
-            # budget to >= all_gather traffic — use the strategy that
-            # actually bounds the bytes (build_a2a warned)
-            strategy = "all_gather"
+            ush = build_a2a(upart, ipart, u_idx, i_idx, r,
+                            on_degenerate="stub")
+            ish = build_a2a(ipart, upart, i_idx, u_idx, r,
+                            on_degenerate="stub")
+            if ush.degenerate or ish.degenerate:
+                # one hot (src, dst) pair inflated the uniform request
+                # budget to >= all_gather traffic — use the strategy that
+                # actually bounds the bytes (build_a2a warned)
+                strategy = "all_gather"
+                ush = shard_csr(upart, ipart, u_idx, i_idx, r)
+                ish = shard_csr(ipart, upart, i_idx, u_idx, r)
+        else:
             ush = shard_csr(upart, ipart, u_idx, i_idx, r)
             ish = shard_csr(ipart, upart, i_idx, u_idx, r)
-    else:
-        ush = shard_csr(upart, ipart, u_idx, i_idx, r)
-        ish = shard_csr(ipart, upart, i_idx, u_idx, r)
 
     # observability (SURVEY §5.5 "gather bytes"): per-device collective
     # traffic of the chosen strategy, readable after fit (the CLI prints
@@ -229,17 +234,21 @@ def fit_sharded(est, u_idx, i_idx, r, user_map, item_map, cfg,
         user_container=ush, item_container=ish,
         implicit=cfg.implicit_prefs)
     est.lastFitStrategy = strategy
+    obs.gauge("train.comm_bytes_per_iter", est.lastFitCommBytes,
+              strategy=strategy)
 
     sharded_cb = None
     if callback is not None:
         def sharded_cb(iteration, U, V):  # slot space -> entity space
-            callback(iteration,
-                     np.asarray(U)[upart.slot],
-                     np.asarray(V)[ipart.slot])
-    Us, Vs = train_sharded(est.mesh, upart, ipart, ush, ish, cfg,
-                           callback=sharded_cb, init=init,
-                           start_iter=start_iter, strategy=strategy,
-                           ring_counts=ring_counts)
-    U = np.asarray(Us)[upart.slot]
-    V = np.asarray(Vs)[ipart.slot]
+            with obs.span("train.fetch_factors"):
+                Ue = np.asarray(U)[upart.slot]
+                Ve = np.asarray(V)[ipart.slot]
+            callback(iteration, Ue, Ve)
+    with obs.span("train.fit", strategy=strategy):
+        Us, Vs = train_sharded(est.mesh, upart, ipart, ush, ish, cfg,
+                               callback=sharded_cb, init=init,
+                               start_iter=start_iter, strategy=strategy,
+                               ring_counts=ring_counts)
+        U = np.asarray(Us)[upart.slot]
+        V = np.asarray(Vs)[ipart.slot]
     return U, V

@@ -244,8 +244,48 @@ def _load_data(spec):
                      "header, streamed) | synthetic:UxIxN)")
 
 
+def _train_probe(train, test, max_rows=100_000):
+    """Held-out (u_idx, i_idx, rating) triple in the DENSE id space the
+    fitted model will use (``remap_ids`` over the train columns — the
+    same first-seen order ``fit`` derives), for per-iteration probe RMSE.
+    Test rows whose user/item never appears in train are dropped (they
+    have no factors to score with); the probe is subsampled to a bounded
+    size so the per-iteration host transfer stays O(1) in dataset size.
+    Returns None when nothing survives."""
+    from tpu_als.core.ratings import remap_ids
+
+    if not len(test):
+        return None
+    _, umap = remap_ids(np.asarray(train["user"]))
+    _, imap = remap_ids(np.asarray(train["item"]))
+    u = umap.to_dense(np.asarray(test["user"]))
+    i = imap.to_dense(np.asarray(test["item"]))
+    keep = (u >= 0) & (i >= 0)
+    u, i = u[keep], i[keep]
+    r = np.asarray(test["rating"], dtype=np.float32)[keep]
+    if not len(u):
+        return None
+    if len(u) > max_rows:
+        step = len(u) // max_rows + 1
+        u, i, r = u[::step], i[::step], r[::step]
+    return u, i, r
+
+
+def _iteration_cb(logger):
+    """Wrap an IterationLogger so each record also lands in the metrics
+    registry as an ``iteration`` event (what ``observe summarize`` reads)."""
+    from tpu_als import obs
+
+    def cb(iteration, U, V):
+        logger(iteration, U, V)
+        rec = logger.records[-1]
+        obs.emit("iteration",
+                 **{k: v for k, v in rec.items() if k != "tag"})
+    return cb
+
+
 def cmd_train(args):
-    from tpu_als import ALS, RegressionEvaluator
+    from tpu_als import ALS, RegressionEvaluator, obs
     from tpu_als.utils.observe import IterationLogger
 
     # resolve the multi-process branch BEFORE loading data: every pod host
@@ -268,27 +308,41 @@ def cmd_train(args):
             "--per-host-data is multi-process only (each process loads "
             "its own split); launch under a JAX distributed rendezvous "
             "with --devices 0 — single-process runs load one dataset")
-    frame, stream_labels = _load_train_data(args)
+    with obs.span("data.load"):
+        frame, stream_labels = _load_train_data(args)
     train, test = frame.randomSplit([1 - args.holdout, args.holdout],
                                     seed=args.seed)
-    logger = IterationLogger(path=args.log_file) if args.log_file else None
+    # per-iteration logging when asked for (--log-file) OR when a metrics
+    # run dir is live (--output/--obs-dir): the run dir's iteration
+    # events are what `observe summarize` renders as the convergence
+    # table, so an observed run always records them
+    logger = fit_cb = None
+    if args.log_file or obs.active():
+        logger = IterationLogger(
+            probe=_train_probe(train, test), path=args.log_file,
+            stream=sys.stderr if args.log_file else None)
+        fit_cb = _iteration_cb(logger)
     als = ALS(rank=args.rank, maxIter=args.max_iter, regParam=args.reg_param,
               implicitPrefs=args.implicit, alpha=args.alpha,
               nonnegative=args.nonnegative, seed=args.seed,
-              coldStartStrategy="drop", fitCallback=logger,
+              coldStartStrategy="drop", fitCallback=fit_cb,
               mesh=mesh, gatherStrategy=args.gather_strategy,
               cgIters=args.cg_iters)
     print(f"training on {len(train):,} ratings "
           f"({len(test):,} held out)", file=sys.stderr)
-    if args.profile_dir:
-        from tpu_als.utils.observe import trace
+    try:
+        if args.profile_dir:
+            from tpu_als.utils.observe import trace
 
-        with trace(args.profile_dir):
+            with trace(args.profile_dir):
+                model = als.fit(train)
+            print(f"profiler trace written to {args.profile_dir}",
+                  file=sys.stderr)
+        else:
             model = als.fit(train)
-        print(f"profiler trace written to {args.profile_dir}",
-              file=sys.stderr)
-    else:
-        model = als.fit(train)
+    finally:
+        if logger is not None:
+            logger.close()
     if getattr(als, "lastFitCommBytes", None):
         print(f"collective traffic: {als.lastFitCommBytes / 1e6:.3g} "
               f"MB/device/iteration ({als.lastFitStrategy})",
@@ -360,10 +414,13 @@ def _train_multiprocess(args):
     # per-iteration factor gather it triggers is collective); only
     # process 0's is ever invoked, so peers get an inert stand-in rather
     # than an IterationLogger that would open the shared log file
-    logger = None
+    logger = fit_cb = None
     if args.log_file:
-        logger = (IterationLogger(path=args.log_file) if pid == 0
-                  else (lambda iteration, U, V: None))
+        if pid == 0:
+            logger = IterationLogger(path=args.log_file)
+            fit_cb = _iteration_cb(logger)
+        else:
+            fit_cb = (lambda iteration, U, V: None)
     print(f"[proc {pid}/{pcount}] training {len(train):,} ratings "
           f"({'per-host' if args.per_host_data else 'replicated'} load) "
           f"over {mesh.devices.size} devices", file=sys.stderr)
@@ -371,7 +428,7 @@ def _train_multiprocess(args):
               regParam=args.reg_param, implicitPrefs=args.implicit,
               alpha=args.alpha, nonnegative=args.nonnegative,
               seed=args.seed, coldStartStrategy="drop", mesh=mesh,
-              gatherStrategy=args.gather_strategy, fitCallback=logger,
+              gatherStrategy=args.gather_strategy, fitCallback=fit_cb,
               dataMode="per_host" if args.per_host_data else "replicated",
               cgIters=args.cg_iters)
     ctx = contextlib.nullcontext()
@@ -379,10 +436,14 @@ def _train_multiprocess(args):
         from tpu_als.utils.observe import trace
 
         ctx = trace(f"{args.profile_dir}/proc{pid}")
-    with ctx:
-        # fit's multi-process branch: per-host blocking, cross-host
-        # collectives, replicated model on every host
-        model = als.fit(train)
+    try:
+        with ctx:
+            # fit's multi-process branch: per-host blocking, cross-host
+            # collectives, replicated model on every host
+            model = als.fit(train)
+    finally:
+        if logger is not None:
+            logger.close()
 
     if pid != 0:
         return None
@@ -717,11 +778,35 @@ def cmd_tt_train(args):
     print(json.dumps(out))
 
 
+def cmd_observe(args):
+    """Inspect a run directory written by the other subcommands — the
+    analog of pointing the Spark UI at an event-log directory."""
+    from tpu_als.obs import report
+
+    try:
+        if args.action == "summarize":
+            print(report.cmd_summarize(args.run_dir, as_json=args.as_json))
+        else:
+            print(report.cmd_tail(args.run_dir, n=args.lines))
+    except FileNotFoundError as err:
+        raise SystemExit(str(err))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="tpu_als")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    t = sub.add_parser("train", help="fit an ALS model")
+    # every run-producing subcommand can write a metrics/events run dir;
+    # default (when only --output is given) is <output>/obs
+    obs_common = argparse.ArgumentParser(add_help=False)
+    obs_common.add_argument(
+        "--obs-dir", default=None,
+        help="write metrics/tracing events for this run here "
+             "(default: <--output>/obs when --output is set; "
+             "inspect with `tpu_als observe summarize DIR`)")
+
+    t = sub.add_parser("train", help="fit an ALS model",
+                       parents=[obs_common])
     t.add_argument("--data", required=True)
     t.add_argument("--rank", type=int, default=10)
     t.add_argument("--max-iter", type=int, default=10)
@@ -753,7 +838,8 @@ def main(argv=None):
                         "batched Cholesky)")
     t.set_defaults(fn=cmd_train)
 
-    e = sub.add_parser("evaluate", help="score a dataset with a saved model")
+    e = sub.add_parser("evaluate", help="score a dataset with a saved model",
+                       parents=[obs_common])
     e.add_argument("--model", required=True)
     e.add_argument("--data", required=True)
     e.add_argument("--ranking-k", type=int, default=0,
@@ -763,7 +849,8 @@ def main(argv=None):
     e.add_argument("--positive-threshold", type=float, default=3.5)
     e.set_defaults(fn=cmd_evaluate)
 
-    r = sub.add_parser("recommend", help="top-k recommendations")
+    r = sub.add_parser("recommend", help="top-k recommendations",
+                       parents=[obs_common])
     r.add_argument("--model", required=True)
     r.add_argument("--users", default=None,
                    help="comma-separated original user ids (default: all)")
@@ -792,7 +879,8 @@ def main(argv=None):
                         "device's HBM)")
     r.set_defaults(fn=cmd_recommend)
 
-    g = sub.add_parser("tune", help="cross-validated grid search")
+    g = sub.add_parser("tune", help="cross-validated grid search",
+                       parents=[obs_common])
     g.add_argument("--data", required=True)
     g.add_argument("--ranks", default="8,16,32",
                    help="comma-separated rank grid")
@@ -816,7 +904,8 @@ def main(argv=None):
 
     tt = sub.add_parser("tt-train",
                         help="train + persist the two-tower retrieval "
-                             "model (ALS warm start by default)")
+                             "model (ALS warm start by default)",
+                        parents=[obs_common])
     tt.add_argument("--data", required=True)
     tt.add_argument("--output", default=None,
                     help="save the trained towers here")
@@ -832,11 +921,28 @@ def main(argv=None):
     tt.add_argument("--seed", type=int, default=0)
     tt.set_defaults(fn=cmd_tt_train)
 
-    f = sub.add_parser("foldin-bench", help="fold-in latency micro-benchmark")
+    f = sub.add_parser("foldin-bench", help="fold-in latency micro-benchmark",
+                       parents=[obs_common])
     f.add_argument("--model", required=True)
     f.add_argument("--batches", type=int, default=20)
     f.add_argument("--batch-size", type=int, default=512)
     f.set_defaults(fn=cmd_foldin_bench)
+
+    o = sub.add_parser("observe",
+                       help="inspect a run directory's metrics/events")
+    osub = o.add_subparsers(dest="action", required=True)
+    os1 = osub.add_parser("summarize",
+                          help="per-phase timings, per-iteration RMSE, "
+                               "comm-bytes gauges, throughput")
+    os1.add_argument("run_dir",
+                     help="run dir (--output / --obs-dir of a past run)")
+    os1.add_argument("--json", dest="as_json", action="store_true",
+                     help="emit the summary as one JSON object")
+    os1.set_defaults(fn=cmd_observe)
+    os2 = osub.add_parser("tail", help="print the last N raw events")
+    os2.add_argument("run_dir")
+    os2.add_argument("-n", "--lines", type=int, default=20)
+    os2.set_defaults(fn=cmd_observe)
 
     args = ap.parse_args(argv)
     if getattr(args, "nonnegative", False) and \
@@ -847,7 +953,39 @@ def main(argv=None):
         ap.error("--cg-iters cannot be combined with --nonnegative "
                  "(the NNLS solver takes precedence and the CG request "
                  "would be silently ignored)")
-    args.fn(args)
+    if args.cmd == "observe":
+        return args.fn(args)  # reading a run dir must not write one
+
+    from tpu_als import obs
+
+    run_dir = args.obs_dir
+    if run_dir is None and getattr(args, "output", None):
+        import os
+
+        run_dir = os.path.join(args.output, "obs")
+    if run_dir is not None:
+        obs.configure(
+            run_dir,
+            config={k: v for k, v in vars(args).items() if k != "fn"},
+            argv=list(argv) if argv is not None else sys.argv[1:])
+        obs.emit("command", cmd=args.cmd,
+                 argv=list(argv) if argv is not None else sys.argv[1:])
+    try:
+        with obs.span("cli." + args.cmd):
+            return args.fn(args)
+    finally:
+        if run_dir is not None:
+            # AFTER the command body: a train --output save atomically
+            # REPLACES the output dir, so the run dir under it must be
+            # written once the model is installed, not before.
+            # deconfigure so a process issuing several commands (tests,
+            # notebooks) never writes a later command's events here
+            out = obs.finalize()
+            obs.deconfigure()
+            if out is not None:
+                print(f"run metrics written to {out} "
+                      f"(tpu_als observe summarize {out})",
+                      file=sys.stderr)
 
 
 if __name__ == "__main__":

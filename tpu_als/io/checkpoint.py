@@ -13,8 +13,22 @@ from __future__ import annotations
 
 import json
 import os
+import time
 
 import numpy as np
+
+from tpu_als import obs
+
+
+def _tree_bytes(path):
+    total = 0
+    for root, _, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return total
 
 # 1 = replicated layout (user_factors.npz / item_factors.npz);
 # 2 = shard-per-process layout (user_shard_*.npz + slots.npz, written by
@@ -52,6 +66,7 @@ def save_factors(path, user_ids, user_factors, item_ids, item_factors,
     """Write a checkpoint/model directory (atomic via tmp+rename)."""
     import shutil
 
+    t0 = time.perf_counter()
     tmp = path + ".tmp"
     if os.path.exists(tmp):  # stale leftovers from a crashed attempt
         shutil.rmtree(tmp)
@@ -71,7 +86,13 @@ def save_factors(path, user_ids, user_factors, item_ids, item_factors,
     }
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
+    nbytes = _tree_bytes(tmp)  # before the install renames tmp away
     atomic_install(tmp, path)
+    dt = time.perf_counter() - t0
+    obs.histogram("checkpoint.save_seconds", dt)
+    obs.counter("checkpoint.save_bytes", nbytes)
+    obs.emit("checkpoint_save", path=str(path), seconds=round(dt, 6),
+             bytes=nbytes, iteration=iteration)
 
 
 def load_factors(path):
@@ -79,6 +100,18 @@ def load_factors(path):
 
     Returns (manifest, user_ids, user_factors, item_ids, item_factors).
     """
+    t0 = time.perf_counter()
+    out = _load_factors(path)
+    dt = time.perf_counter() - t0
+    nbytes = _tree_bytes(path)
+    obs.histogram("checkpoint.load_seconds", dt)
+    obs.counter("checkpoint.load_bytes", nbytes)
+    obs.emit("checkpoint_load", path=str(path), seconds=round(dt, 6),
+             bytes=nbytes)
+    return out
+
+
+def _load_factors(path):
     if not os.path.exists(os.path.join(path, "manifest.json")) and \
             os.path.exists(os.path.join(path + ".old", "manifest.json")):
         path = path + ".old"  # crash hit the save_factors swap window

@@ -39,9 +39,11 @@ from __future__ import annotations
 
 import ctypes
 import os
+import time
 
 import numpy as np
 
+from tpu_als import obs
 from tpu_als.io._native_build import build_native
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
@@ -133,6 +135,9 @@ def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
     start, end = host_byte_range(size, host_index, num_hosts)
     handle = lib.sc_create()
     out_u, out_i, out_r = [], [], []
+    t_start = time.perf_counter()
+    stall = 0.0          # time blocked in file reads (vs parse/intern)
+    nbytes = 0
     try:
         with open(path, "rb") as f:
             pos = start
@@ -155,10 +160,13 @@ def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
             carry = b""
             while pos < end:
                 want = min(chunk_bytes, end - pos)
+                t_io = time.perf_counter()
                 block = f.read(want)
+                stall += time.perf_counter() - t_io
                 if not block:
                     break
                 pos += len(block)
+                nbytes += len(block)
                 buf = carry + block
                 cut = buf.rfind(b"\n")
                 if cut < 0:
@@ -185,7 +193,18 @@ def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
         lib.sc_destroy(handle)
     cat = (lambda xs, dt: np.concatenate(xs) if xs
            else np.empty(0, dtype=dt))
-    return (cat(out_u, np.int64), cat(out_i, np.int64),
+    u_out = cat(out_u, np.int64)
+    rows = int(len(u_out))
+    seconds = time.perf_counter() - t_start
+    # one counter set + ONE event per call — never per chunk: the
+    # instrumented path must not scale its own cost with the file size
+    obs.counter("ingest.rows", rows)
+    obs.counter("ingest.bytes", nbytes)
+    obs.counter("ingest.stall_seconds", stall)
+    obs.emit("ingest", path=str(path), host_index=int(host_index),
+             num_hosts=int(num_hosts), rows=rows, bytes=nbytes,
+             seconds=round(seconds, 6), stall_seconds=round(stall, 6))
+    return (u_out, cat(out_i, np.int64),
             cat(out_r, np.float32), user_labels, item_labels)
 
 

@@ -1,0 +1,122 @@
+"""The declared observability vocabulary — every metric and event type.
+
+The registry (tpu_als.obs.metrics) validates names against these tables at
+call time, and ``scripts/check_obs_schema.py`` validates every *call site*
+statically, so an undeclared name fails a tier-1 test instead of silently
+minting a new time series nothing downstream knows how to read (the
+Codahale-metrics discipline the reference stack gets from its fixed
+MetricsSystem source names — SURVEY.md §5.5).
+
+Adding a metric or event type = add a row here + a row in the matching
+table of docs/observability.md.
+"""
+
+from __future__ import annotations
+
+# metric name -> (kind, unit, help text).  kind in {counter, gauge,
+# histogram}; a name used with a different kind than declared raises.
+METRICS = {
+    "train.comm_bytes_per_iter": (
+        "gauge", "bytes",
+        "modeled per-device collective traffic of one ALS iteration "
+        "(trainer.comm_bytes_per_iter, labeled by effective strategy)"),
+    "serve.request_seconds": (
+        "histogram", "seconds",
+        "wall-clock latency of one sharded top-k request "
+        "(parallel.serve.topk_sharded), labeled by strategy"),
+    "serve.requests": (
+        "counter", "requests", "sharded top-k requests served"),
+    "serve.rows": (
+        "counter", "rows", "query rows scored by sharded top-k"),
+    "ingest.rows": (
+        "counter", "rows", "rating rows parsed by stream_ingest"),
+    "ingest.bytes": (
+        "counter", "bytes", "file bytes read by stream_ingest"),
+    "ingest.stall_seconds": (
+        "counter", "seconds",
+        "time stream_ingest spent blocked in file reads (I/O stall, "
+        "as opposed to parse/intern time)"),
+    "foldin.update_seconds": (
+        "histogram", "seconds",
+        "FoldInServer micro-batch latency, labeled side=user|item"),
+    "foldin.ratings": (
+        "counter", "rows", "ratings folded in by FoldInServer"),
+    "checkpoint.save_seconds": (
+        "histogram", "seconds", "save_factors wall-clock duration"),
+    "checkpoint.save_bytes": (
+        "counter", "bytes", "bytes written by save_factors"),
+    "checkpoint.load_seconds": (
+        "histogram", "seconds", "load_factors wall-clock duration"),
+    "checkpoint.load_bytes": (
+        "counter", "bytes", "bytes read by load_factors"),
+}
+
+# event type -> (required fields beyond ts/type, help text).  Extra
+# fields are allowed (events are self-describing JSON); missing required
+# fields raise at emit time.
+EVENTS = {
+    "command": (
+        ("cmd", "argv"),
+        "one per CLI invocation: the subcommand and its argv"),
+    "span": (
+        ("name", "path", "seconds"),
+        "one per closed span(): wall-clock duration; path is the "
+        "'/'-joined stack of enclosing span names (the tree structure)"),
+    "metric": (
+        ("kind", "name", "value"),
+        "a gauge set (gauges are point-in-time, so each set is an "
+        "event; counters/histograms appear only in the final snapshot)"),
+    "iteration": (
+        ("iteration", "seconds", "total_seconds"),
+        "one per training iteration observed by the CLI's "
+        "IterationLogger (factor norms, optional probe_rmse)"),
+    "ingest": (
+        ("path", "rows", "bytes", "seconds", "stall_seconds"),
+        "one per stream_ingest call: this host's parsed totals"),
+    "checkpoint_save": (
+        ("path", "seconds", "bytes"),
+        "one per save_factors call"),
+    "checkpoint_load": (
+        ("path", "seconds", "bytes"),
+        "one per load_factors call"),
+    "bench_retry": (
+        ("attempt", "attempts", "elapsed_seconds", "reason"),
+        "one per failed bench.py backend probe attempt"),
+    "warning": (
+        ("what", "reason"),
+        "a degraded-but-continuing condition (e.g. profiler trace "
+        "skipped because one is already active)"),
+    "snapshot": (
+        ("counters", "gauges", "histograms"),
+        "final registry state, appended once by finalize() so the JSONL "
+        "alone reconstructs every counter/gauge/histogram"),
+}
+
+
+def check_metric(name, kind):
+    """Raise if ``name`` is undeclared or declared with another kind."""
+    decl = METRICS.get(name)
+    if decl is None:
+        raise KeyError(
+            f"metric {name!r} is not declared in tpu_als.obs.schema."
+            "METRICS — declare it there (and in docs/observability.md) "
+            "before emitting it")
+    if decl[0] != kind:
+        raise TypeError(
+            f"metric {name!r} is declared as a {decl[0]}, used as a "
+            f"{kind}")
+
+
+def check_event(etype, fields):
+    """Raise if ``etype`` is undeclared or missing a required field."""
+    decl = EVENTS.get(etype)
+    if decl is None:
+        raise KeyError(
+            f"event type {etype!r} is not declared in tpu_als.obs."
+            "schema.EVENTS — declare it there (and in "
+            "docs/observability.md) before emitting it")
+    missing = [f for f in decl[0] if f not in fields]
+    if missing:
+        raise ValueError(
+            f"event {etype!r} is missing required field(s) {missing} "
+            f"(declared: {list(decl[0])})")

@@ -28,16 +28,16 @@ per device); scores are always identical.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from tpu_als import obs
 from tpu_als.ops.topk import NEG_INF, chunked_topk_scores
-from tpu_als.parallel.mesh import AXIS
-
-shard_map = jax.shard_map
+from tpu_als.parallel.mesh import AXIS, shard_map
 
 STRATEGIES = ("all_gather", "ring")
 
@@ -119,12 +119,23 @@ def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown serving strategy {strategy!r} "
                          f"(expected one of {STRATEGIES})")
+    t0 = time.perf_counter()
+
+    def _record(nrows):
+        # latency histogram + throughput counters: dict writes under a
+        # lock, so instrumentation stays in the noise on the serve path
+        obs.histogram("serve.request_seconds",
+                      time.perf_counter() - t0, strategy=strategy)
+        obs.counter("serve.requests")
+        obs.counter("serve.rows", nrows)
+
     U = np.asarray(U, dtype=np.float32)
     V = np.asarray(V, dtype=np.float32)
     Nu, r = U.shape
     Ni = V.shape[0]
     if Ni == 0 or Nu == 0:
         kk = min(k, Ni)
+        _record(Nu)
         return (np.zeros((Nu, kk), np.float32),
                 np.zeros((Nu, kk), np.int32))
     valid = (np.ones(Ni, dtype=bool) if item_valid is None
@@ -146,13 +157,18 @@ def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
     from tpu_als.parallel.mesh import shard_leading
 
     spec = shard_leading(mesh)
-    s, ix = f(jax.device_put(Up, spec), jax.device_put(Vp, spec),
-              jax.device_put(validp, spec))
-    if jax.process_count() > 1:
-        # multi-process mesh: the result is a GLOBAL array whose shards
-        # live across hosts — np.asarray would fail on non-addressable
-        # shards.  Trim the query padding on device (every process
-        # executes the same op) and hand the global arrays back; the
-        # caller reads .addressable_shards for its own rows.
-        return s[:Nu], ix[:Nu]
-    return np.asarray(s)[:Nu], np.asarray(ix)[:Nu]
+    with obs.span("serve.topk", strategy=strategy):
+        s, ix = f(jax.device_put(Up, spec), jax.device_put(Vp, spec),
+                  jax.device_put(validp, spec))
+        if jax.process_count() > 1:
+            # multi-process mesh: the result is a GLOBAL array whose
+            # shards live across hosts — np.asarray would fail on
+            # non-addressable shards.  Trim the query padding on device
+            # (every process executes the same op) and hand the global
+            # arrays back; the caller reads .addressable_shards for its
+            # own rows.
+            _record(Nu)
+            return s[:Nu], ix[:Nu]
+        out = np.asarray(s)[:Nu], np.asarray(ix)[:Nu]
+    _record(Nu)
+    return out

@@ -25,12 +25,11 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tpu_als import obs
 from tpu_als.core.als import AlsConfig, init_factors, local_half_step
 from tpu_als.core.ratings import trainer_chunk
 from tpu_als.ops.solve import compute_yty
-from tpu_als.parallel.mesh import AXIS
-
-shard_map = jax.shard_map
+from tpu_als.parallel.mesh import AXIS, shard_map
 
 
 def _squeeze0(tree):
@@ -95,23 +94,25 @@ def make_sharded_step(mesh, user_sharded, item_sharded, cfg: AlsConfig):
         ubuckets = _squeeze0(ubuckets)
         ibuckets = _squeeze0(ibuckets)
         # --- item half-step: gather U, solve owned item rows ---
-        U_full = jax.lax.all_gather(U_loc, AXIS, axis=0, tiled=True)
-        if cfg.implicit_prefs:
-            YtY_u = jax.lax.psum(compute_yty(U_loc), AXIS)
-            V_new = local_half_step(U_full, ibuckets, per_i, cfg, YtY_u,
-                                    i_chunk, prev=V_loc)
-        else:
-            V_new = local_half_step(U_full, ibuckets, per_i, cfg,
-                                    chunk_elems=i_chunk, prev=V_loc)
+        with jax.named_scope("item_half_step"):
+            U_full = jax.lax.all_gather(U_loc, AXIS, axis=0, tiled=True)
+            if cfg.implicit_prefs:
+                YtY_u = jax.lax.psum(compute_yty(U_loc), AXIS)
+                V_new = local_half_step(U_full, ibuckets, per_i, cfg,
+                                        YtY_u, i_chunk, prev=V_loc)
+            else:
+                V_new = local_half_step(U_full, ibuckets, per_i, cfg,
+                                        chunk_elems=i_chunk, prev=V_loc)
         # --- user half-step: gather V, solve owned user rows ---
-        V_full = jax.lax.all_gather(V_new, AXIS, axis=0, tiled=True)
-        if cfg.implicit_prefs:
-            YtY_v = jax.lax.psum(compute_yty(V_new), AXIS)
-            U_new = local_half_step(V_full, ubuckets, per_u, cfg, YtY_v,
-                                    u_chunk, prev=U_loc)
-        else:
-            U_new = local_half_step(V_full, ubuckets, per_u, cfg,
-                                    chunk_elems=u_chunk, prev=U_loc)
+        with jax.named_scope("user_half_step"):
+            V_full = jax.lax.all_gather(V_new, AXIS, axis=0, tiled=True)
+            if cfg.implicit_prefs:
+                YtY_v = jax.lax.psum(compute_yty(V_new), AXIS)
+                U_new = local_half_step(V_full, ubuckets, per_u, cfg,
+                                        YtY_v, u_chunk, prev=U_loc)
+            else:
+                U_new = local_half_step(V_full, ubuckets, per_u, cfg,
+                                        chunk_elems=u_chunk, prev=U_loc)
         return U_new, V_new
 
     sharded = shard_map(
@@ -145,14 +146,16 @@ def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig):
         ibuckets = _squeeze0(ibuckets)
         ucounts = ucounts[0]
         icounts = icounts[0]
-        YtY_u = (jax.lax.psum(compute_yty(U_loc), AXIS)
-                 if cfg.implicit_prefs else None)
-        V_new = ring_half_step(U_loc, ibuckets, icounts, per_i, D, cfg,
-                               i_chunk, YtY_u, prev=V_loc)
-        YtY_v = (jax.lax.psum(compute_yty(V_new), AXIS)
-                 if cfg.implicit_prefs else None)
-        U_new = ring_half_step(V_new, ubuckets, ucounts, per_u, D, cfg,
-                               u_chunk, YtY_v, prev=U_loc)
+        with jax.named_scope("item_half_step"):
+            YtY_u = (jax.lax.psum(compute_yty(U_loc), AXIS)
+                     if cfg.implicit_prefs else None)
+            V_new = ring_half_step(U_loc, ibuckets, icounts, per_i, D,
+                                   cfg, i_chunk, YtY_u, prev=V_loc)
+        with jax.named_scope("user_half_step"):
+            YtY_v = (jax.lax.psum(compute_yty(V_new), AXIS)
+                     if cfg.implicit_prefs else None)
+            U_new = ring_half_step(V_new, ubuckets, ucounts, per_u, D,
+                                   cfg, u_chunk, YtY_v, prev=U_loc)
         return U_new, V_new
 
     sharded = shard_map(
@@ -188,14 +191,16 @@ def make_a2a_step(mesh, user_a2a, item_a2a, cfg: AlsConfig):
         # request lists; the item-side plan routes U rows and vice versa
         u_send = u_send[0]              # serves the U half-step (V rows)
         i_send = i_send[0]              # serves the V half-step (U rows)
-        YtY_u = (jax.lax.psum(compute_yty(U_loc), AXIS)
-                 if cfg.implicit_prefs else None)
-        V_new = a2a_half_step(U_loc, i_send, ibuckets, per_i, cfg, i_chunk,
-                              YtY_u, prev=V_loc)
-        YtY_v = (jax.lax.psum(compute_yty(V_new), AXIS)
-                 if cfg.implicit_prefs else None)
-        U_new = a2a_half_step(V_new, u_send, ubuckets, per_u, cfg, u_chunk,
-                              YtY_v, prev=U_loc)
+        with jax.named_scope("item_half_step"):
+            YtY_u = (jax.lax.psum(compute_yty(U_loc), AXIS)
+                     if cfg.implicit_prefs else None)
+            V_new = a2a_half_step(U_loc, i_send, ibuckets, per_i, cfg,
+                                  i_chunk, YtY_u, prev=V_loc)
+        with jax.named_scope("user_half_step"):
+            YtY_v = (jax.lax.psum(compute_yty(V_new), AXIS)
+                     if cfg.implicit_prefs else None)
+            U_new = a2a_half_step(V_new, u_send, ubuckets, per_u, cfg,
+                                  u_chunk, YtY_v, prev=U_loc)
         return U_new, V_new
 
     sharded = shard_map(
@@ -292,8 +297,9 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
     Resumes at ``start_iter``, running the remaining iterations.
     """
     leading = NamedSharding(mesh, P(AXIS))
-    ub = jax.device_put(user_sharded.device_buckets(), leading)
-    ib = jax.device_put(item_sharded.device_buckets(), leading)
+    with obs.span("train.stage", strategy=strategy):
+        ub = jax.device_put(user_sharded.device_buckets(), leading)
+        ib = jax.device_put(item_sharded.device_buckets(), leading)
 
     if init is not None:
         U0 = np.zeros((user_part.padded_rows, cfg.rank), dtype=np.float32)
@@ -318,27 +324,34 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
     if strategy not in ("all_gather", "ring", "all_to_all"):
         raise ValueError(f"unknown strategy {strategy!r} "
                          "(expected 'all_gather', 'ring' or 'all_to_all')")
-    if strategy == "all_to_all":
-        us = jax.device_put(user_sharded.send_idx, leading)
-        is_ = jax.device_put(item_sharded.send_idx, leading)
-        step = make_a2a_step(mesh, user_sharded, item_sharded, cfg)
-        args = (ub, ib, us, is_)
-    elif strategy == "ring":
-        if ring_counts is None:
-            raise ValueError("strategy='ring' requires ring_counts="
-                             "(user_counts, item_counts) from stacked_counts")
-        uc, ic = ring_counts
-        uc = jax.device_put(uc, leading)
-        ic = jax.device_put(ic, leading)
-        step = make_ring_step(mesh, user_sharded, item_sharded, cfg)
-        args = (ub, ib, uc, ic)
-    else:
-        step = make_sharded_step(mesh, user_sharded, item_sharded, cfg)
-        args = (ub, ib)
+    with obs.span("train.build_step", strategy=strategy):
+        if strategy == "all_to_all":
+            us = jax.device_put(user_sharded.send_idx, leading)
+            is_ = jax.device_put(item_sharded.send_idx, leading)
+            step = make_a2a_step(mesh, user_sharded, item_sharded, cfg)
+            args = (ub, ib, us, is_)
+        elif strategy == "ring":
+            if ring_counts is None:
+                raise ValueError(
+                    "strategy='ring' requires ring_counts="
+                    "(user_counts, item_counts) from stacked_counts")
+            uc, ic = ring_counts
+            uc = jax.device_put(uc, leading)
+            ic = jax.device_put(ic, leading)
+            step = make_ring_step(mesh, user_sharded, item_sharded, cfg)
+            args = (ub, ib, uc, ic)
+        else:
+            step = make_sharded_step(mesh, user_sharded, item_sharded, cfg)
+            args = (ub, ib)
     for it in range(start_iter, cfg.max_iter):
-        U, V = step(U, V, *args)
-        if callback is not None:
-            callback(it + 1, U, V)
+        # dispatch time unless the callback (or donation pressure)
+        # blocks — the per-iteration wall clock lives in the CLI's
+        # iteration events; this span pins compile+dispatch outliers
+        with obs.span("train.iteration", iteration=it + 1,
+                      strategy=strategy):
+            U, V = step(U, V, *args)
+            if callback is not None:
+                callback(it + 1, U, V)
     return U, V
 
 

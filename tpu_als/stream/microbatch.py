@@ -25,6 +25,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from tpu_als import obs
 from tpu_als.core.foldin import fold_in
 from tpu_als.core.ratings import IdMap, _next_pow2
 from tpu_als.ops.solve import compute_yty
@@ -165,7 +166,11 @@ class FoldInServer:
             self._V = jnp.asarray(m._V)
             if self._implicit:
                 self._YtY = compute_yty(self._V)
-        self.stats.append((len(solved_raw), n, time.perf_counter() - t0))
+        dt = time.perf_counter() - t0
+        self.stats.append((len(solved_raw), n, dt))
+        obs.histogram("foldin.update_seconds", dt,
+                      side="item" if items_side else "user")
+        obs.counter("foldin.ratings", len(solved_raw))
         return touched
 
     def _write_back(self, touched_raw_ids, new_rows, items_side=False):

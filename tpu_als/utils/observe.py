@@ -7,7 +7,8 @@ equivalents here:
 - :class:`IterationLogger` — a ``callback`` for the training loops that
   emits one structured JSON line per iteration (iteration, wall time,
   probe RMSE, factor norms) to a file and/or stderr, the analog of
-  per-stage metrics.
+  per-stage metrics.  The process-wide metrics/event registry lives in
+  :mod:`tpu_als.obs`; this logger is the per-fit convergence view.
 - :func:`trace` — context manager over ``jax.profiler.trace`` producing a
   TensorBoard/Perfetto trace of the jitted steps (the analog of the Spark
   UI's stage timeline).
@@ -31,6 +32,10 @@ class IterationLogger:
     probe: optional (u_idx, i_idx, ratings) triple of dense indices — RMSE
     on it is logged each iteration (the convergence signal the reference
     app reads off its evaluator).
+
+    Usable as a context manager (``with IterationLogger(path=p) as log:``);
+    the file is opened lazily on the first record, so constructing a
+    logger that never fires touches no filesystem state.
     """
 
     def __init__(self, probe=None, stream=sys.stderr, path=None, tag="als"):
@@ -38,9 +43,17 @@ class IterationLogger:
         self.stream = stream
         self.path = path
         self.tag = tag
-        self._t_last = time.perf_counter()
-        self._file = open(path, "a") if path else None
+        self._t_last = self._t0 = time.perf_counter()
+        self._file = None
+        self._closed = False
         self.records = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def __call__(self, iteration, U, V):
         now = time.perf_counter()
@@ -48,6 +61,7 @@ class IterationLogger:
             "tag": self.tag,
             "iteration": int(iteration),
             "seconds": round(now - self._t_last, 4),
+            "total_seconds": round(now - self._t0, 4),
             "u_norm": float(np.linalg.norm(np.asarray(U)) /
                             max(1, U.shape[0]) ** 0.5),
             "v_norm": float(np.linalg.norm(np.asarray(V)) /
@@ -62,23 +76,64 @@ class IterationLogger:
         line = json.dumps(rec)
         if self.stream is not None:
             print(line, file=self.stream, flush=True)
-        if self._file is not None:
+        if self.path is not None and not self._closed:
+            if self._file is None:
+                self._file = open(self.path, "a")
             self._file.write(line + "\n")
             self._file.flush()
 
     def close(self):
+        if self._closed:
+            return
+        self._closed = True
         if self._file is not None:
             self._file.close()
+            self._file = None
+
+
+_trace_active = False
+
+
+def _trace_warn(what, reason):
+    """Record a degraded-profiling condition without killing the run: one
+    structured warning event (when a registry is live) + a stderr line."""
+    from tpu_als import obs
+
+    obs.emit("warning", what=what, reason=str(reason))
+    print(f"observe.trace: {what}: {reason}", file=sys.stderr)
 
 
 @contextlib.contextmanager
 def trace(logdir):
     """Profile a block into ``logdir`` (TensorBoard / Perfetto readable) —
-    usage: ``with observe.trace('/tmp/trace'): step(U, V)``."""
-    import jax
+    usage: ``with observe.trace('/tmp/trace'): step(U, V)``.
 
-    jax.profiler.start_trace(logdir)
+    Degrades to a no-op (with a ``warning`` event) instead of raising
+    when a trace is already active in this process or the profiler
+    cannot start — a failed profiling request must never take down the
+    training run it was meant to observe.
+    """
+    global _trace_active
+
+    if _trace_active:
+        _trace_warn("trace_skipped",
+                    "a profiler trace is already active in this process")
+        yield
+        return
+    try:
+        import jax
+
+        jax.profiler.start_trace(logdir)
+    except Exception as err:
+        _trace_warn("trace_unavailable", err)
+        yield
+        return
+    _trace_active = True
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        _trace_active = False
+        try:
+            jax.profiler.stop_trace()
+        except Exception as err:
+            _trace_warn("trace_stop_failed", err)

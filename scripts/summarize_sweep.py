@@ -54,6 +54,11 @@ def main():
             rows.append((name, "-", "-", "-", "-", tail))
             continue
         cfgd = j.get("config") or {}
+        note = j.get("error") or cfgd.get("resolved_solve_path", "")
+        if not j.get("error") and cfgd.get("gather_strategy"):
+            # sharded A/B rows (overlap_ab step): the schedule is the
+            # variable under test, so lead the note with it
+            note = f"{cfgd['gather_strategy']} {note}".strip()
         rows.append((
             name,
             "ERR" if j.get("error") else f"{j.get('value')}",
@@ -61,7 +66,7 @@ def main():
             ("-" if j.get("vs_baseline") is None
              else f"{j.get('vs_baseline')}"),
             f"{cfgd.get('seconds_per_iter', '-')}",
-            (j.get("error") or cfgd.get("resolved_solve_path", ""))[:60],
+            note[:60],
         ))
     w = [max(len(r[k]) for r in rows + [("step", "value", "unit",
                                          "vs_base", "s/iter", "note")])

@@ -48,6 +48,13 @@ DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
 # flagship its run-to-run spread (data+compile caches warm), then
 # headline_ab (already-banked variants are skipped by the A/B driver),
 # serving, fold-in, kernels, and the long tail.)
+#   Round-6 additions: overlap_ab A/Bs the two overlapped sharded
+#   schedules (ring_overlap double-buffer, chunked all_gather) against
+#   the banked exact headline — on a single TPU core the sharded path
+#   measures the step body, so this is a schedule-overhead check, not a
+#   scaling claim; retime_rmse re-measures rmse with the warmup/steady
+#   split (the banked 11.235 s/iter divided compile-inclusive wall-clock
+#   by max_iter — see docs/roofline.md).
 #   NOTE: step names must NOT collide with bench.py's canonical bank
 #   paths (headline_<spec>.out / rmse_<spec>.out): the runner's stdout
 #   redirect truncates sweep_logs/<name>.out at step start, which would
@@ -60,6 +67,8 @@ STEPS=(
   "ml100k|300|python bench.py --no-auto-config --mode ml100k --probe-attempts 1"
   "reconfirm_f32|580|python bench.py --no-auto-config --iters 5 --probe-attempts 1"
   "headline_ab|1200|python bench.py --no-auto-config --iters 5 --ab bf16,wg15,bf16_wg15,cg2_bf16,cg3,cg2_dense,cg2 --ab-dir sweep_logs --probe-attempts 1"
+  "overlap_ab|1200|python bench.py --no-auto-config --iters 5 --ab ringdb,agchunk --ab-dir sweep_logs --probe-attempts 1"
+  "retime_rmse|1500|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --probe-attempts 1"
   "rmse_ab|1500|python bench.py --no-auto-config --mode rmse --iters-rmse 12 --ab bf16,cg2_bf16,cg2 --ab-dir sweep_logs --probe-attempts 1"
   "serve|420|python bench.py --no-auto-config --mode serve --probe-attempts 1"
   "serve_bf16|420|python bench.py --no-auto-config --mode serve --compute-dtype bfloat16 --probe-attempts 1"

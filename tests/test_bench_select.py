@@ -334,6 +334,43 @@ def test_ab_banking_guards_model_and_scale_flags():
     bench._check_ab_bankable(mk(iters=3), "rmse")
 
 
+def test_bank_variant_stamps_absolute_banked_at(tmp_path):
+    bench._bank_variant("headline", "cg2", str(tmp_path),
+                        {"value": 0.9, "config": {}}, "m")
+    line = bench._last_json(str(tmp_path / "headline_cg2.out"))
+    banked_at = line["banked_at"]
+    # absolute ISO-8601 UTC instant, never a relative phrase
+    import datetime as dt
+
+    parsed = dt.datetime.fromisoformat(banked_at)
+    assert parsed.tzinfo is not None
+    assert "round" not in banked_at and "sweep" not in banked_at
+
+
+def test_provenance_transports_banked_at_verbatim(tmp_path):
+    """A number banked in one round and transported into a later round's
+    provenance block must keep its ORIGINAL bank-time stamp (VERDICT r5
+    weak #1: relative phrases like 'this round (sweep)' go stale)."""
+    stamp = "2026-08-01T08:32:10+00:00"
+    _write(tmp_path, "headline_cg2",
+           {"value": 2.4, "unit": "iters/sec", "banked_at": stamp})
+    _write(tmp_path, "rmse_cg2", {"value": 0.44, "unit": "rmse_stars"})
+    p = bench.builder_measured_provenance("headline", str(tmp_path))
+    assert p["measured_at"] == stamp
+    assert p["banked_at"] == stamp
+    assert "this round" not in json.dumps(p)
+
+
+def test_provenance_mtime_fallback_is_labeled(tmp_path):
+    # legacy banked lines (no banked_at) fall back to the log file's
+    # mtime, explicitly labeled so it can't be mistaken for a bank stamp
+    _write(tmp_path, "headline_cg2", {"value": 2.4, "unit": "iters/sec"})
+    _write(tmp_path, "rmse_cg2", {"value": 0.44, "unit": "rmse_stars"})
+    p = bench.builder_measured_provenance("headline", str(tmp_path))
+    assert p["measured_at"].endswith("(sweep log mtime)")
+    assert p["banked_at"] is None
+
+
 def test_already_banked_rejects_config_mismatch(tmp_path):
     """A stale or mislabeled banked line (wrong rank or non-ML-25M
     shape) must not short-circuit a real retry (advisor r4, low)."""

@@ -99,6 +99,69 @@ def test_ring_model_matches_traced_bytes_with_tiling(rng):
     assert traced == model, (traced, model, breakdown)
 
 
+def test_ring_overlap_model_matches_traced_bytes_with_tiling(rng):
+    from tpu_als.parallel.comm import shard_csr_grid
+
+    u, i, r, upart, ipart = _problem(rng)
+    rank = 8
+    cfg = AlsConfig(rank=rank, max_iter=1, reg_param=0.1,
+                    implicit_prefs=True, alpha=4.0, seed=0)
+    chunk = 512
+    ugrid = shard_csr_grid(upart, ipart, u, i, r, min_width=4,
+                           chunk_elems=chunk)
+    igrid = shard_csr_grid(ipart, upart, i, u, r, min_width=4,
+                           chunk_elems=chunk)
+    mesh = make_mesh(D)
+    U, V, leading = _factors(mesh, upart, ipart, rank)
+    ub = jax.device_put(ugrid.device_buckets(), leading)
+    ib = jax.device_put(igrid.device_buckets(), leading)
+    uc = jax.device_put(
+        jnp.asarray(stacked_counts(upart, u, r, positive_only=True)),
+        leading)
+    ic = jax.device_put(
+        jnp.asarray(stacked_counts(ipart, i, r, positive_only=True)),
+        leading)
+    step = make_ring_step(mesh, ugrid, igrid, cfg, overlap=True)
+    traced, breakdown = collective_bytes(step, U, V, ub, ib, uc, ic,
+                                         axis_size=D)
+    # the double-buffered schedule prefetches shard k+1 while shard k
+    # accumulates, but moves the SAME bytes in the SAME collectives as
+    # the serial ring — the model is shared and must still match exactly
+    model = comm_bytes_per_iter("ring_overlap", upart, ipart, rank,
+                                user_container=ugrid, item_container=igrid,
+                                implicit=True)
+    assert model == comm_bytes_per_iter(
+        "ring", upart, ipart, rank, user_container=ugrid,
+        item_container=igrid, implicit=True)
+    assert breakdown.get("ppermute") and breakdown.get("psum")
+    assert traced == model, (traced, model, breakdown)
+
+
+def test_chunked_gather_model_matches_traced_bytes(rng):
+    from tpu_als.parallel.trainer import make_chunked_gather_step
+
+    u, i, r, upart, ipart = _problem(rng)
+    rank = 8
+    cfg = AlsConfig(rank=rank, max_iter=1, reg_param=0.1,
+                    implicit_prefs=True, alpha=4.0, seed=0)
+    # chunk budget forces ntiles > 1 (scan-scaled gathers) and
+    # n_blocks=3 leaves a ragged last block — the byte model must be
+    # independent of BOTH because the column blocks partition the shard
+    ush = shard_csr(upart, ipart, u, i, r, min_width=4, chunk_elems=512)
+    ish = shard_csr(ipart, upart, i, u, r, min_width=4, chunk_elems=512)
+    mesh = make_mesh(D)
+    U, V, leading = _factors(mesh, upart, ipart, rank)
+    ub = jax.device_put(ush.device_buckets(), leading)
+    ib = jax.device_put(ish.device_buckets(), leading)
+    step = make_chunked_gather_step(mesh, ush, ish, cfg, n_blocks=3)
+    traced, breakdown = collective_bytes(step, U, V, ub, ib, axis_size=D)
+    model = comm_bytes_per_iter("all_gather_chunked", upart, ipart, rank,
+                                user_container=ush, item_container=ish,
+                                implicit=True)
+    assert breakdown.get("all_gather") and breakdown.get("psum")
+    assert traced == model, (traced, model, breakdown)
+
+
 def test_a2a_model_matches_traced_bytes():
     from tpu_als.parallel.a2a import build_a2a
 

@@ -17,7 +17,8 @@ from tpu_als.parallel.trainer import train_sharded
 from conftest import make_ratings
 
 
-def _both(rng, cfg, num_users=50, num_items=35, implicit=False, n_dev=8):
+def _both(rng, cfg, num_users=50, num_items=35, implicit=False, n_dev=8,
+          strategy="all_gather", gather_blocks=4):
     u, i, r, _, _ = make_ratings(rng, num_users, num_items, rank=3, density=0.4)
     if implicit:
         r = np.abs(r) * 4 + 0.1
@@ -29,9 +30,22 @@ def _both(rng, cfg, num_users=50, num_items=35, implicit=False, n_dev=8):
     mesh = make_mesh(n_dev)
     upart = partition_balanced(np.bincount(u, minlength=num_users), n_dev)
     ipart = partition_balanced(np.bincount(i, minlength=num_items), n_dev)
-    ush = shard_csr(upart, ipart, u, i, r, min_width=4)
-    ish = shard_csr(ipart, upart, i, u, r, min_width=4)
-    Us, Vs = train_sharded(mesh, upart, ipart, ush, ish, cfg)
+    if strategy in ("ring", "ring_overlap"):
+        from tpu_als.parallel.comm import shard_csr_grid
+        from tpu_als.parallel.trainer import stacked_counts
+
+        ush = shard_csr_grid(upart, ipart, u, i, r, min_width=4)
+        ish = shard_csr_grid(ipart, upart, i, u, r, min_width=4)
+        rc = (stacked_counts(upart, u, r, positive_only=implicit),
+              stacked_counts(ipart, i, r, positive_only=implicit))
+        Us, Vs = train_sharded(mesh, upart, ipart, ush, ish, cfg,
+                               strategy=strategy, ring_counts=rc)
+    else:
+        ush = shard_csr(upart, ipart, u, i, r, min_width=4)
+        ish = shard_csr(ipart, upart, i, u, r, min_width=4)
+        Us, Vs = train_sharded(mesh, upart, ipart, ush, ish, cfg,
+                               strategy=strategy,
+                               gather_blocks=gather_blocks)
     # slot space -> entity space
     U8 = np.asarray(Us)[upart.slot]
     V8 = np.asarray(Vs)[ipart.slot]
@@ -44,6 +58,22 @@ def test_sharded_equals_single_device(rng, implicit):
     cfg = AlsConfig(rank=3, max_iter=4, reg_param=0.05,
                     implicit_prefs=implicit, alpha=8.0, seed=11)
     (U1, V1), (U8, V8) = _both(np.random.default_rng(1), cfg, implicit=implicit)
+    np.testing.assert_allclose(U8, U1, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(V8, V1, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("implicit", [False, True])
+@pytest.mark.parametrize("strategy", ["ring_overlap", "all_gather_chunked"])
+def test_overlap_variants_equal_single_device(rng, strategy, implicit):
+    """Both overlapped schedules (double-buffered ring, column-blocked
+    gather) are pure reorderings of the same math — they must reproduce
+    the single-device result to the same tolerance as the base paths.
+    gather_blocks=3 leaves a ragged last block on purpose."""
+    cfg = AlsConfig(rank=3, max_iter=4, reg_param=0.05,
+                    implicit_prefs=implicit, alpha=8.0, seed=11)
+    (U1, V1), (U8, V8) = _both(np.random.default_rng(1), cfg,
+                               implicit=implicit, strategy=strategy,
+                               gather_blocks=3)
     np.testing.assert_allclose(U8, U1, rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(V8, V1, rtol=2e-3, atol=2e-3)
 
@@ -111,6 +141,24 @@ def test_comm_bytes_per_iter_model(rng):
     ring = comm_bytes_per_iter("ring", upart, ipart, r,
                                user_container=ug, item_container=ig)
     assert ring >= ag * D // (D - 1)
+
+    # ring_overlap: identical bytes to ring — double-buffering reorders
+    # the schedule, it does not change what moves
+    assert comm_bytes_per_iter("ring_overlap", upart, ipart, r,
+                               user_container=ug, item_container=ig) == ring
+    assert comm_bytes_per_iter("ring_overlap", upart, ipart, r) == \
+        ag * D // (D - 1)
+
+    # all_gather_chunked: same bytes as all_gather at 1 tile (the column
+    # blocks partition the shard, so block count never changes bytes);
+    # with containers it scales by the row-tile count since each tile
+    # pass re-gathers its blocks
+    assert comm_bytes_per_iter("all_gather_chunked", upart, ipart, r) == ag
+    ush = shard_csr(upart, ipart, u, i, vals, min_width=4)
+    ish = shard_csr(ipart, upart, i, u, vals, min_width=4)
+    agc = comm_bytes_per_iter("all_gather_chunked", upart, ipart, r,
+                              user_container=ush, item_container=ish)
+    assert agc >= ag
 
     # a2a: 2*(D-1)*R*r*4 per half-step from the built plans
     ua = build_a2a(upart, ipart, u, i, vals, min_width=4)

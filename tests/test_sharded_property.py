@@ -43,6 +43,8 @@ def _random_case(rng):
 
 @pytest.mark.parametrize("case_seed", [101, 202, 303, 404])
 def test_random_case_sharded_equals_single(case_seed):
+    from tpu_als.parallel.comm import shard_csr_grid
+
     rng = np.random.default_rng(case_seed)
     nU, nI, u, i, r, cfg, n_dev = _random_case(rng)
     ucsr = build_csr_buckets(u, i, r, nU, min_width=4)
@@ -54,13 +56,29 @@ def test_random_case_sharded_equals_single(case_seed):
     ipart = partition_balanced(np.bincount(i, minlength=nI), n_dev)
     ush = shard_csr(upart, ipart, u, i, r, min_width=4)
     ish = shard_csr(ipart, upart, i, u, r, min_width=4)
-    Us, Vs = train_sharded(mesh, upart, ipart, ush, ish, cfg)
-    np.testing.assert_allclose(
-        np.asarray(Us)[upart.slot], np.asarray(U1), rtol=5e-3, atol=5e-3,
-        err_msg=f"case {case_seed}: {nU}x{nI} r{cfg.rank} "
-                f"D{n_dev} implicit={cfg.implicit_prefs} cg={cfg.cg_iters}")
-    np.testing.assert_allclose(
-        np.asarray(Vs)[ipart.slot], np.asarray(V1), rtol=5e-3, atol=5e-3)
+    ugrid = shard_csr_grid(upart, ipart, u, i, r, min_width=4)
+    igrid = shard_csr_grid(ipart, upart, i, u, r, min_width=4)
+    rc = (stacked_counts(upart, u, r, positive_only=cfg.implicit_prefs),
+          stacked_counts(ipart, i, r, positive_only=cfg.implicit_prefs))
+    # every random case runs the base gather AND both overlapped
+    # schedules — a shape that breaks the ragged gather blocks or the
+    # ring prefetch shows up here, not on a pod
+    runs = [("all_gather", ush, ish, {}),
+            ("all_gather_chunked", ush, ish,
+             {"gather_blocks": int(rng.integers(1, 6))}),
+            ("ring_overlap", ugrid, igrid, {"ring_counts": rc})]
+    for strategy, us_, is_, kw in runs:
+        Us, Vs = train_sharded(mesh, upart, ipart, us_, is_, cfg,
+                               strategy=strategy, **kw)
+        np.testing.assert_allclose(
+            np.asarray(Us)[upart.slot], np.asarray(U1),
+            rtol=5e-3, atol=5e-3,
+            err_msg=f"case {case_seed} [{strategy}]: {nU}x{nI} "
+                    f"r{cfg.rank} D{n_dev} implicit={cfg.implicit_prefs} "
+                    f"cg={cfg.cg_iters}")
+        np.testing.assert_allclose(
+            np.asarray(Vs)[ipart.slot], np.asarray(V1),
+            rtol=5e-3, atol=5e-3, err_msg=f"case {case_seed} [{strategy}]")
 
 
 def test_single_device_mesh_all_strategies(rng):
@@ -95,4 +113,16 @@ def test_single_device_mesh_all_strategies(rng):
     Ur, Vr = train_sharded(mesh, upart, ipart, ugrid, igrid, cfg,
                            strategy="ring", ring_counts=rc)
     np.testing.assert_allclose(np.asarray(Ur)[upart.slot], np.asarray(U1),
+                               rtol=2e-3, atol=2e-3)
+
+    Uo, _ = train_sharded(mesh, upart, ipart, ugrid, igrid, cfg,
+                          strategy="ring_overlap", ring_counts=rc)
+    np.testing.assert_allclose(np.asarray(Uo)[upart.slot], np.asarray(U1),
+                               rtol=2e-3, atol=2e-3)
+
+    # D=1 makes every gather block a full-shard slice of one shard — the
+    # chunked path must still partition it exactly
+    Uc, _ = train_sharded(mesh, upart, ipart, ush, ish, cfg,
+                          strategy="all_gather_chunked", gather_blocks=3)
+    np.testing.assert_allclose(np.asarray(Uc)[upart.slot], np.asarray(U1),
                                rtol=2e-3, atol=2e-3)

@@ -178,9 +178,12 @@ class ALS(_ALSParams, Estimator):
     train sharded over devices (None = single device; ``numUserBlocks`` /
     ``numItemBlocks`` are then API-parity hints only); ``gatherStrategy`` —
     how sharded half-steps move the opposite factors: ``'all_gather'``
-    (default), ``'ring'`` (ppermute streaming — opposite factors never
-    materialize in full), or ``'all_to_all'`` (ragged exchange of only the
-    referenced rows); ``checkpointDir`` —
+    (default), ``'all_gather_chunked'`` (gathered in column blocks per
+    row tile — the full opposite table never materializes),
+    ``'ring'`` (ppermute streaming — opposite factors never
+    materialize in full), ``'ring_overlap'`` (ring with the
+    double-buffered ppermute-under-einsum schedule), or ``'all_to_all'``
+    (ragged exchange of only the referenced rows); ``checkpointDir`` —
     where ``checkpointInterval`` writes resumable factor snapshots;
     ``resumeFrom`` — a checkpoint directory to warm-start from: ``fit``
     loads its factors + iteration counter and runs only the remaining
@@ -228,10 +231,12 @@ class ALS(_ALSParams, Estimator):
                              "'matfree' or 'dense')")
         self.cgIters = int(cgIters)
         self.cgMode = cgMode
-        if gatherStrategy not in ("all_gather", "ring", "all_to_all"):
+        if gatherStrategy not in ("all_gather", "all_gather_chunked",
+                                  "ring", "ring_overlap", "all_to_all"):
             raise ValueError(
                 f"unknown gatherStrategy {gatherStrategy!r} (expected "
-                "'all_gather', 'ring' or 'all_to_all')")
+                "'all_gather', 'all_gather_chunked', 'ring', "
+                "'ring_overlap' or 'all_to_all')")
         if dataMode not in ("replicated", "per_host"):
             raise ValueError(f"unknown dataMode {dataMode!r} (expected "
                              "'replicated' or 'per_host')")

@@ -198,7 +198,7 @@ def fit_sharded(est, u_idx, i_idx, r, user_map, item_map, cfg,
     strategy = est.gatherStrategy
     ring_counts = None
     with obs.span("train.block", strategy=strategy):
-        if strategy == "ring":
+        if strategy in ("ring", "ring_overlap"):
             from tpu_als.parallel.comm import shard_csr_grid
 
             ush = shard_csr_grid(upart, ipart, u_idx, i_idx, r)
@@ -236,6 +236,15 @@ def fit_sharded(est, u_idx, i_idx, r, user_map, item_map, cfg,
     est.lastFitStrategy = strategy
     obs.gauge("train.comm_bytes_per_iter", est.lastFitCommBytes,
               strategy=strategy)
+    if strategy == "all_gather_chunked":
+        # record the column-block plan the step will run with (trainer
+        # default): bytes are block-count-invariant, resident gathered
+        # slice is not — this is the number the rank-256 layout math uses
+        from tpu_als.parallel.comm import gather_block_plan
+
+        sub_u, _, _ = gather_block_plan(ipart.rows_per_shard, 4)
+        obs.gauge("train.gather_block_rows", sub_u, n_blocks=4,
+                  side="user_half")
 
     sharded_cb = None
     if callback is not None:

@@ -60,7 +60,12 @@ def _load_stream(path, host_index=0, num_hosts=1, vocab=None):
     """
     import numpy as np
 
-    from tpu_als.io.stream import stream_ingest
+    from tpu_als.io.stream import (
+        split_claim,
+        strip_split_claims,
+        stream_ingest,
+        validate_split_claims,
+    )
     from tpu_als.parallel.multihost import global_vocab_union
     from tpu_als.utils.frame import ColumnarFrame
 
@@ -68,7 +73,23 @@ def _load_stream(path, host_index=0, num_hosts=1, vocab=None):
         path, host_index, num_hosts, require_cols=4, skip_header=1)
 
     if vocab is None:
-        g_ul, g_il = global_vocab_union(ul), global_vocab_union(il)
+        # ride this host's byte-range claim through the user-vocab union
+        # so a stale --num-hosts on any host fails HERE, not as silently
+        # double-read/dropped ratings (io/stream.validate_split_claims)
+        import jax
+
+        claim = np.array([split_claim(host_index, num_hosts)])
+        w = max(ul.dtype.itemsize, claim.dtype.itemsize, 1)
+        claimed = np.concatenate([ul.astype(f"S{w}"), claim.astype(f"S{w}")])
+        union = global_vocab_union(claimed)
+        if jax.process_count() >= num_hosts:
+            g_ul, _ = validate_split_claims(union)
+        else:
+            # single-process harness byte-splitting for a larger host
+            # count: peer claims cannot arrive through a local union, so
+            # coverage is unverifiable — strip without enforcement
+            g_ul = strip_split_claims(union)
+        g_il = global_vocab_union(il)
         u = np.searchsorted(g_ul, ul)[u_loc]
         i = np.searchsorted(g_il, il)[i_loc]
     else:
@@ -780,7 +801,35 @@ def cmd_tt_train(args):
 
 def cmd_observe(args):
     """Inspect a run directory written by the other subcommands — the
-    analog of pointing the Spark UI at an event-log directory."""
+    analog of pointing the Spark UI at an event-log directory — or
+    (``roofline``) print the analytical per-stage bytes/FLOPs floor."""
+    if args.action == "roofline":
+        from tpu_als.perf.roofline import (
+            HEADLINE,
+            HEADLINE_MEASURED_S_PER_ITER,
+            render,
+            roofline,
+        )
+
+        kwargs = dict(
+            n_users=args.users, n_items=args.items, nnz=args.ratings,
+            rank=args.rank, dtype=args.dtype,
+            implicit=not args.explicit,
+            padding_waste=args.padding_waste, devices=args.devices,
+            strategy=args.strategy,
+            tiles_user=args.tiles, tiles_item=args.tiles,
+        )
+        measured = args.measured_s_per_iter
+        if measured is None and kwargs == dict(
+                HEADLINE, strategy=None, tiles_user=1, tiles_item=1):
+            measured = HEADLINE_MEASURED_S_PER_ITER
+        report_d = roofline(**kwargs, measured_s_per_iter=measured)
+        if args.as_json:
+            print(json.dumps(report_d))
+        else:
+            print(render(report_d))
+        return
+
     from tpu_als.obs import report
 
     try:
@@ -826,8 +875,12 @@ def main(argv=None):
                    help="train sharded over N devices (0 = all visible; "
                         "1 = single device, the default)")
     t.add_argument("--gather-strategy", default="all_gather",
-                   choices=["all_gather", "ring", "all_to_all"],
-                   help="how sharded half-steps move the opposite factors")
+                   choices=["all_gather", "all_gather_chunked", "ring",
+                            "ring_overlap", "all_to_all"],
+                   help="how sharded half-steps move the opposite factors "
+                        "(ring_overlap = double-buffered ring; "
+                        "all_gather_chunked = column-block gathers, the "
+                        "full opposite table never materializes)")
     t.add_argument("--per-host-data", action="store_true",
                    help="multi-process only: each process loads its OWN "
                         "--data split ('{proc}' in the spec expands to "
@@ -943,6 +996,38 @@ def main(argv=None):
     os2.add_argument("run_dir")
     os2.add_argument("-n", "--lines", type=int, default=20)
     os2.set_defaults(fn=cmd_observe)
+    os3 = osub.add_parser(
+        "roofline",
+        help="analytical per-stage bytes/FLOPs floor for one ALS "
+             "iteration (defaults: THE headline config, with its "
+             "measured point; see docs/roofline.md)")
+    from tpu_als.perf.roofline import HEADLINE as _RL_HEADLINE
+
+    os3.add_argument("--users", type=int, default=_RL_HEADLINE["n_users"])
+    os3.add_argument("--items", type=int, default=_RL_HEADLINE["n_items"])
+    os3.add_argument("--ratings", type=int, default=_RL_HEADLINE["nnz"])
+    os3.add_argument("--rank", type=int, default=_RL_HEADLINE["rank"])
+    os3.add_argument("--dtype", default=_RL_HEADLINE["dtype"],
+                     choices=["float32", "bfloat16"])
+    os3.add_argument("--explicit", action="store_true",
+                     help="explicit feedback (default: implicit)")
+    os3.add_argument("--padding-waste", type=float,
+                     default=_RL_HEADLINE["padding_waste"],
+                     help="padded_nnz / nnz of the built containers")
+    os3.add_argument("--devices", type=int,
+                     default=_RL_HEADLINE["devices"])
+    os3.add_argument("--strategy", default=None,
+                     choices=["all_gather", "all_gather_chunked", "ring",
+                              "ring_overlap", "all_to_all"],
+                     help="price the collective stage too (sharded)")
+    os3.add_argument("--tiles", type=int, default=1,
+                     help="row-tile count (ring/chunked strategies "
+                          "re-stream the opposite factors per tile)")
+    os3.add_argument("--measured-s-per-iter", type=float, default=None,
+                     help="overlay a measured point (default: the "
+                          "headline 1.184 when the config is untouched)")
+    os3.add_argument("--json", dest="as_json", action="store_true")
+    os3.set_defaults(fn=cmd_observe)
 
     args = ap.parse_args(argv)
     if getattr(args, "nonnegative", False) and \

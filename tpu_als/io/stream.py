@@ -256,6 +256,98 @@ def merge_vocabularies(per_host_labels):
     return global_labels, remaps
 
 
+# Sentinel vocabulary entry carrying one host's byte-range claim through
+# the vocab-union collective.  \x01 cannot appear in a parsed label (the
+# streamer rejects control bytes via the malformed-line checks and NUL is
+# the padding alphabet), sorts before every printable id, and survives
+# np.unique — so the union itself transports the split agreement with
+# zero extra collectives.
+SPLIT_CLAIM_PREFIX = b"\x01split="
+
+
+def split_claim(host_index, num_hosts):
+    """This host's byte-range claim, to append to its LOCAL user
+    vocabulary before :func:`~tpu_als.parallel.multihost.global_vocab_union`
+    (or :func:`merge_vocabularies`)."""
+    if not 0 <= int(host_index) < int(num_hosts):
+        raise ValueError(f"host_index {host_index} not in [0, {num_hosts})")
+    return SPLIT_CLAIM_PREFIX + b"%d/%d" % (int(host_index), int(num_hosts))
+
+
+def _claim_mask(labels):
+    """Boolean mask of split-claim sentinels in an ``S``-dtype array.
+    S-dtype compare is whole-string, so test the prefix bytes directly."""
+    width = max(labels.dtype.itemsize, 1)
+    raw = labels.view(np.uint8).reshape(len(labels), width) \
+        if len(labels) else np.zeros((0, width), np.uint8)
+    npx = len(SPLIT_CLAIM_PREFIX)
+    if width >= npx:
+        return (raw[:, :npx] ==
+                np.frombuffer(SPLIT_CLAIM_PREFIX, np.uint8)).all(axis=1)
+    return np.zeros(len(labels), bool)
+
+
+def strip_split_claims(labels):
+    """Remove split-claim sentinels without enforcement — for harnesses
+    that byte-split within ONE process (peer claims cannot arrive through
+    a single-process union, so coverage is unverifiable there)."""
+    labels = np.asarray(labels, dtype="S")
+    return labels[~_claim_mask(labels)]
+
+
+def validate_split_claims(labels):
+    """Strip split claims from a unioned vocabulary and verify the hosts
+    actually partitioned the file.
+
+    Every host ran ``stream_ingest(path, h, H)`` believing some ``H``;
+    :func:`host_byte_range` only partitions the file when every host used
+    the SAME ``H`` and the indices cover ``0..H-1``.  A launch where one
+    host was started with a stale ``--num-hosts`` silently double-reads
+    or drops a byte range — the claims make that loud at vocabulary
+    time, before any rating is trained on.
+
+    Returns ``(clean_labels, num_hosts)``; raises ``ValueError`` on
+    disagreeing ``num_hosts`` or missing byte ranges.  Identical claims
+    collapse in the union, so two hosts claiming the same ``h/H`` are
+    indistinguishable — but then some other range is missing, which IS
+    caught (coverage), unless they also shadow a live host, in which
+    case the ranges still partition and the data is still exactly-once.
+    """
+    labels = np.asarray(labels, dtype="S")
+    is_claim = _claim_mask(labels)
+    npx = len(SPLIT_CLAIM_PREFIX)
+    claims = []
+    for c in labels[is_claim]:
+        body = bytes(c)[npx:]
+        try:
+            h, hh = body.split(b"/")
+            claims.append((int(h), int(hh)))
+        except ValueError:
+            raise ValueError(f"corrupt split claim in vocabulary: {c!r}")
+    if not claims:
+        raise ValueError(
+            "no split claims in the unioned vocabulary — every host must "
+            "append split_claim(host_index, num_hosts) before the union")
+    counts = {hh for _, hh in claims}
+    if len(counts) > 1:
+        raise ValueError(
+            f"hosts disagree on num_hosts: claims {sorted(claims)} — the "
+            "byte ranges do not partition the file (stale --num-hosts on "
+            "some host?)")
+    (H,) = counts
+    got = {h for h, _ in claims}
+    missing = sorted(set(range(H)) - got)
+    if missing:
+        raise ValueError(
+            f"byte ranges {missing} of {H} have no ingest claim — those "
+            "ratings were never read (host down or mis-indexed)")
+    bad = sorted(h for h in got if not 0 <= h < H)
+    if bad:
+        raise ValueError(f"split claims {bad} out of range for "
+                         f"num_hosts={H}")
+    return labels[~is_claim], H
+
+
 def ingest_per_host(path, num_hosts, *, delim=",", require_cols=3,
                     skip_header=0, chunk_bytes=32 << 20):
     """Run every host's stream (single-process harness) and return

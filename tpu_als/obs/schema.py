@@ -20,6 +20,10 @@ METRICS = {
         "gauge", "bytes",
         "modeled per-device collective traffic of one ALS iteration "
         "(trainer.comm_bytes_per_iter, labeled by effective strategy)"),
+    "train.gather_block_rows": (
+        "gauge", "rows",
+        "rows per column block of the chunked all_gather schedule "
+        "(comm.gather_block_plan; bounds the resident gathered slice)"),
     "serve.request_seconds": (
         "histogram", "seconds",
         "wall-clock latency of one sharded top-k request "

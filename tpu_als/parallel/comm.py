@@ -193,7 +193,7 @@ def shard_csr_grid(row_part, col_part, row_idx, col_idx, vals,
 
 
 def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
-                   chunk_elems, YtY=None, prev=None):
+                   chunk_elems, YtY=None, prev=None, overlap=False):
     """One half-step with streaming factor shards (inside ``shard_map``).
 
     V_shard [per_opposite, r]: this device's shard of the opposite factors.
@@ -210,6 +210,16 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
     scattered.  Each pass performs all ``n_shards`` rotations, so the
     factor shard is back home when the next tile starts.  See the module
     docstring for the peak-HBM model this enforces.
+
+    ``overlap=True`` double-buffers the rotation: the ``ppermute`` sending
+    shard k+1 is issued *before* shard k's normal-equation contribution is
+    accumulated, so XLA's latency-hiding scheduler can keep one async
+    collective-permute in flight under the einsum.  The extra cost is one
+    shard-sized buffer (the in-flight slot); bytes moved, rotation count
+    and numerics are identical to ``overlap=False`` — both variants'
+    traffic is modeled by the same ``comm_bytes_per_iter('ring', ...)``
+    closed form and verified against the traced jaxpr in
+    tests/test_comm_audit.py.
     """
     r = V_shard.shape[-1]
     cdt = jnp.dtype(cfg.compute_dtype)
@@ -225,6 +235,13 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
         bb = jnp.zeros((tile, r), dtype=jnp.float32)
         for t in range(n_shards):
             src = (me - t) % n_shards  # shard held after t rotations
+            if overlap:
+                # issue the rotation for shard t+1 NOW — the permute only
+                # reads V_c, so it runs concurrently with this shard's
+                # gather+einsum below (double buffer: V_c stays readable,
+                # V_next is the in-flight slot)
+                with jax.named_scope("ring_prefetch"):
+                    V_next = jax.lax.ppermute(V_c, AXIS, perm)
             with jax.named_scope("ring_gather"):
                 c = jax.lax.dynamic_index_in_dim(cols, src, 0, False)
                 v = jax.lax.dynamic_index_in_dim(vals, src, 0, False)
@@ -250,7 +267,10 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
                         "nw,nwr->nr", (v * m).astype(cdt), Vg,
                         preferred_element_type=jnp.float32)
             # rotate every step: after n_shards rotations the shard is home
-            V_c = jax.lax.ppermute(V_c, AXIS, perm)
+            if overlap:
+                V_c = V_next
+            else:
+                V_c = jax.lax.ppermute(V_c, AXIS, perm)
         # padding rows (rows == num_rows) read an arbitrary count; their
         # b is 0 so x solves to 0 and the scatter drops them anyway
         cnt = counts[jnp.clip(rows, 0, num_rows - 1)]
@@ -293,4 +313,149 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
 
             V_shard, out = jax.lax.fori_loop(
                 0, ntiles, body, (V_shard, out))
+    return out
+
+
+def gather_block_plan(per, n_blocks):
+    """Column-block decomposition of a ``rows_per_shard``-row factor shard.
+
+    Returns ``(sub, starts, widths)``: block c covers local rows
+    ``[starts[c], starts[c] + widths[c])`` of every device's shard;
+    ``sub = ceil(per / n_blocks)`` and the last block may be ragged, so
+    any ``1 <= n_blocks`` works for any ``per`` and the blocks always
+    partition the shard exactly (``sum(widths) == per`` — the byte model
+    depends on this)."""
+    per = int(per)
+    sub = -(-per // max(1, int(n_blocks)))
+    starts = list(range(0, per, sub))
+    widths = [min(sub, per - s) for s in starts]
+    return sub, starts, widths
+
+
+def chunked_gather_half_step(V_shard, buckets, num_rows, n_shards, cfg,
+                             chunk_elems, n_blocks=4, YtY=None, prev=None):
+    """One half-step gathering the opposite factors in column blocks
+    (inside ``shard_map``) — the streamed variant of the plain
+    ``all_gather`` strategy.
+
+    V_shard [per, r]: this device's shard of the opposite factors.
+    buckets: this device's slice of a ShardedCsr — rows [nb],
+    cols/vals/mask [nb, w] with cols in GLOBAL SLOT space
+    (``slot = owner * per + local``), i.e. the same containers the plain
+    all_gather step consumes.
+
+    Instead of materializing the full ``[D·per, r]`` opposite table, each
+    row tile runs a static loop over ``n_blocks`` column blocks: block c
+    is ``all_gather(V_shard[start_c : start_c+w_c])`` — a ``[D·w_c, r]``
+    slice of the table — and only the entries whose column falls in that
+    block contribute to the tile's normal equations.  The blocks
+    partition the slot space exactly, so A/b/count accumulate to the same
+    sums as the one-shot gather (within f32 reduction order), while peak
+    HBM drops from ``D·per·r`` to ``row_tile·r² + 2·D·ceil(per/C)·r``
+    (the live block plus one in flight) — this is what unlocks rank-256
+    all_gather layouts that BASELINE's HBM table rules out today.
+
+    Double buffering: block c+1's ``all_gather`` is issued before block
+    c's einsum, keeping one async gather in flight under the compute.
+    Per tile pass the gathers move ``(D−1)·per·r·4`` bytes — identical to
+    one full all_gather — so total traffic is that times the row-tile
+    count (``comm_bytes_per_iter('all_gather_chunked', ...)``; traced
+    jaxpr equality in tests/test_comm_audit.py).
+
+    Ridge/YtY/solver-precedence semantics mirror ``ring_half_step``: the
+    per-row count is accumulated in-step from the mask (explicit: rated
+    entries; implicit: positive entries), then ``A += λ·count·I`` (+YtY
+    implicit) and nonnegative > cg > exact solve with ``prev`` as the CG
+    warm start.
+    """
+    r = V_shard.shape[-1]
+    per = V_shard.shape[0]
+    cdt = jnp.dtype(cfg.compute_dtype)
+    eye = jnp.eye(r, dtype=jnp.float32)
+    out = jnp.zeros((num_rows, r), dtype=jnp.float32)
+    sub, starts, widths = gather_block_plan(per, n_blocks)
+    C = len(starts)
+
+    def gather_block(c):
+        with jax.named_scope("gchunk_gather"):
+            blk = jax.lax.slice_in_dim(
+                V_shard, starts[c], starts[c] + widths[c], axis=0)
+            # tiled gather is device-major: slot (d, l) of block c lands
+            # at row d*widths[c] + (l - starts[c])
+            return jax.lax.all_gather(blk, AXIS, axis=0, tiled=True)
+
+    def tile_pass(rows, cols, vals, mask):
+        """rows [tile]; cols/vals/mask [tile, w] -> x [tile, r]"""
+        tile = rows.shape[0]
+        A = jnp.zeros((tile, r, r), dtype=jnp.float32)
+        bb = jnp.zeros((tile, r), dtype=jnp.float32)
+        cnt = jnp.zeros((tile,), dtype=jnp.float32)
+        d = cols // per
+        loc = cols % per
+        # ragged last block: every local row >= starts[-1] belongs to it
+        blkid = jnp.minimum(loc // sub, C - 1)
+        G_next = gather_block(0)
+        for c in range(C):
+            G = G_next
+            if c + 1 < C:
+                G_next = gather_block(c + 1)  # in flight under this einsum
+            m_c = mask * (blkid == c)
+            # clip keeps masked-out entries' indices in bounds; their
+            # contribution is zeroed by m_c
+            idx = jnp.clip(d * widths[c] + (loc - starts[c]),
+                           0, n_shards * widths[c] - 1)
+            with jax.named_scope("gchunk_normal_eq"):
+                Vg = G[idx].astype(cdt)
+                if cfg.implicit_prefs:
+                    conf_m1 = cfg.alpha * jnp.abs(vals) * m_c
+                    pref = (vals > 0).astype(cdt)
+                    A = A + jnp.einsum(
+                        "nw,nwr,nws->nrs", conf_m1.astype(cdt), Vg, Vg,
+                        preferred_element_type=jnp.float32)
+                    bb = bb + jnp.einsum(
+                        "nw,nwr->nr",
+                        ((1.0 + conf_m1) * pref * m_c).astype(cdt), Vg,
+                        preferred_element_type=jnp.float32)
+                    cnt = cnt + ((vals > 0) * m_c).sum(axis=-1)
+                else:
+                    Vm = Vg * m_c[..., None].astype(cdt)
+                    A = A + jnp.einsum(
+                        "nwr,nws->nrs", Vm, Vm,
+                        preferred_element_type=jnp.float32)
+                    bb = bb + jnp.einsum(
+                        "nw,nwr->nr", (vals * m_c).astype(cdt), Vg,
+                        preferred_element_type=jnp.float32)
+                    cnt = cnt + m_c.sum(axis=-1)
+        A = A + (cfg.reg_param * cnt)[:, None, None] * eye
+        if cfg.implicit_prefs:
+            A = A + YtY[None]
+        with jax.named_scope("gchunk_solve"):
+            if cfg.nonnegative:
+                x = solve_nnls(A, bb, cnt, sweeps=cfg.nnls_sweeps)
+            elif cfg.cg_iters > 0 and cfg.solve_backend != "fused":
+                x0 = (prev[jnp.clip(rows, 0, num_rows - 1)]
+                      if prev is not None else None)
+                x = solve_cg(A, bb, cnt, x0=x0, iters=cfg.cg_iters)
+            else:
+                x = solve_spd(A, bb, cnt)
+        return x
+
+    for b in buckets:
+        nb, w = b.cols.shape
+        tile = trainer_chunk(nb, w, r, chunk_elems)
+        ntiles = nb // tile
+        if ntiles == 1:
+            x = tile_pass(b.rows, b.cols, b.vals, b.mask)
+            out = out.at[b.rows].set(x, mode="drop", unique_indices=True)
+        else:
+            def body(ti, out, b=b, tile=tile):
+                s0 = ti * tile
+                rows = jax.lax.dynamic_slice_in_dim(b.rows, s0, tile, 0)
+                cols = jax.lax.dynamic_slice_in_dim(b.cols, s0, tile, 0)
+                vals = jax.lax.dynamic_slice_in_dim(b.vals, s0, tile, 0)
+                mask = jax.lax.dynamic_slice_in_dim(b.mask, s0, tile, 0)
+                x = tile_pass(rows, cols, vals, mask)
+                return out.at[rows].set(x, mode="drop", unique_indices=True)
+
+            out = jax.lax.fori_loop(0, ntiles, body, out)
     return out

@@ -248,7 +248,7 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
     def assemble(local):
         return jax.make_array_from_process_local_data(leading, local)
 
-    if strategy == "ring":
+    if strategy in ("ring", "ring_overlap"):
         # ring exists to bound DEVICE HBM (opposite factors never
         # materialize in full); its grid layout is computed globally
         # (every host holds the full triples at this point) but only the
@@ -267,8 +267,12 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
             assemble(stacked_counts(ipart, i, r,
                                     positive_only=pos_only)[positions]),
         )
-        step_factory = make_ring_step
-    elif strategy == "all_gather":
+        if strategy == "ring_overlap":
+            def step_factory(mesh, ush, ish, cfg):
+                return make_ring_step(mesh, ush, ish, cfg, overlap=True)
+        else:
+            step_factory = make_ring_step
+    elif strategy in ("all_gather", "all_gather_chunked"):
         umask = local_rating_mask(upart, u, positions=positions)
         imask = local_rating_mask(ipart, i, positions=positions)
         ush = shard_csr(upart, ipart, u[umask], i[umask], r[umask],
@@ -278,7 +282,12 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
                         min_width=min_width, chunk_elems=chunk_elems,
                         positions=positions, row_counts=icounts)
         extra = ()
-        step_factory = make_sharded_step
+        if strategy == "all_gather_chunked":
+            from tpu_als.parallel.trainer import make_chunked_gather_step
+
+            step_factory = make_chunked_gather_step
+        else:
+            step_factory = make_sharded_step
     elif strategy == "all_to_all":
         # exchange plan computed globally (full triples are present),
         # only the local source rows placed; degenerate plans (one hot
@@ -304,7 +313,8 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
     else:
         raise ValueError(
             f"unknown strategy {strategy!r} for multi-host training "
-            "(expected 'all_gather', 'ring' or 'all_to_all')")
+            "(expected 'all_gather', 'all_gather_chunked', 'ring', "
+            "'ring_overlap' or 'all_to_all')")
 
     ub = jax.tree.map(assemble, ush.device_buckets())
     ib = jax.tree.map(assemble, ish.device_buckets())

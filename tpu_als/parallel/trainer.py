@@ -125,11 +125,18 @@ def make_sharded_step(mesh, user_sharded, item_sharded, cfg: AlsConfig):
     return jax.jit(sharded, donate_argnums=(0, 1))
 
 
-def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig):
+def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig,
+                   overlap=False):
     """Jitted full ALS iteration with the ring (``ppermute``) strategy:
     factor shards stream around the mesh, normal-equation accumulators stay
     stationary, the full opposite factor matrix is never materialized
     (tpu_als.parallel.comm).  Signature: ``step(U, V, ub, ib, uc, ic)``.
+
+    ``overlap=True`` is the double-buffered schedule (strategy name
+    ``'ring_overlap'``): each rotation's ``ppermute`` is issued before the
+    held shard's normal-equation accumulation so the collective-permute
+    flies under the einsum.  Identical bytes and numerics-within-f32 to
+    ``overlap=False``.
     """
     from tpu_als.parallel.comm import ring_half_step
 
@@ -150,18 +157,69 @@ def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig):
             YtY_u = (jax.lax.psum(compute_yty(U_loc), AXIS)
                      if cfg.implicit_prefs else None)
             V_new = ring_half_step(U_loc, ibuckets, icounts, per_i, D,
-                                   cfg, i_chunk, YtY_u, prev=V_loc)
+                                   cfg, i_chunk, YtY_u, prev=V_loc,
+                                   overlap=overlap)
         with jax.named_scope("user_half_step"):
             YtY_v = (jax.lax.psum(compute_yty(V_new), AXIS)
                      if cfg.implicit_prefs else None)
             U_new = ring_half_step(V_new, ubuckets, ucounts, per_u, D,
-                                   cfg, u_chunk, YtY_v, prev=U_loc)
+                                   cfg, u_chunk, YtY_v, prev=U_loc,
+                                   overlap=overlap)
         return U_new, V_new
 
     sharded = shard_map(
         step_body,
         mesh=mesh,
         in_specs=(P(AXIS),) * 6,
+        out_specs=(P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+def make_chunked_gather_step(mesh, user_sharded, item_sharded,
+                             cfg: AlsConfig, n_blocks=4):
+    """Jitted full ALS iteration with the chunked all_gather strategy
+    (``'all_gather_chunked'``): the opposite factors are gathered in
+    ``n_blocks`` column blocks per row tile and the ``[n, r, r]`` normal
+    equations accumulate incrementally — the full opposite table is never
+    materialized (tpu_als.parallel.comm.chunked_gather_half_step).
+    Consumes the same ShardedCsr containers as the plain all_gather step;
+    signature ``step(U, V, ub, ib)``.
+    """
+    from tpu_als.parallel.comm import chunked_gather_half_step
+
+    D = mesh.devices.size
+    _check_shard_containers(mesh, user_sharded, item_sharded)
+    per_u = user_sharded.rows_per_shard
+    per_i = item_sharded.rows_per_shard
+    u_chunk = user_sharded.chunk_elems
+    i_chunk = item_sharded.chunk_elems
+    # same capability envelope as ring: the blockwise solve has no
+    # matrix-free path (it never holds the full gathered table)
+    _prewarm(cfg, matfree_capable=False)
+
+    def step_body(U_loc, V_loc, ubuckets, ibuckets):
+        ubuckets = _squeeze0(ubuckets)
+        ibuckets = _squeeze0(ibuckets)
+        with jax.named_scope("item_half_step"):
+            YtY_u = (jax.lax.psum(compute_yty(U_loc), AXIS)
+                     if cfg.implicit_prefs else None)
+            V_new = chunked_gather_half_step(
+                U_loc, ibuckets, per_i, D, cfg, i_chunk,
+                n_blocks=n_blocks, YtY=YtY_u, prev=V_loc)
+        with jax.named_scope("user_half_step"):
+            YtY_v = (jax.lax.psum(compute_yty(V_new), AXIS)
+                     if cfg.implicit_prefs else None)
+            U_new = chunked_gather_half_step(
+                V_new, ubuckets, per_u, D, cfg, u_chunk,
+                n_blocks=n_blocks, YtY=YtY_v, prev=U_loc)
+        return U_new, V_new
+
+    sharded = shard_map(
+        step_body,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
         out_specs=(P(AXIS), P(AXIS)),
         check_vma=False,
     )
@@ -224,10 +282,17 @@ def comm_bytes_per_iter(strategy, user_part, item_part, rank,
 
     - ``all_gather``: the full opposite table minus the resident shard,
       ``(D−1)·rows_per_shard·r·4``.
-    - ``ring``: ``D·rows_per_shard·r·4`` per tile pass (every tile runs
-      ALL ``D`` ppermute rotations so the shard ends home — no
-      resident-shard discount), times the row-tile count read from the
-      built ``RingCsr`` containers when given, else assumed 1.
+    - ``ring`` / ``ring_overlap``: ``D·rows_per_shard·r·4`` per tile pass
+      (every tile runs ALL ``D`` ppermute rotations so the shard ends
+      home — no resident-shard discount), times the row-tile count read
+      from the built ``RingCsr`` containers when given, else assumed 1.
+      The double-buffered schedule reorders the same rotations, so its
+      traffic is identical.
+    - ``all_gather_chunked``: the column blocks of one tile pass sum to
+      exactly one full gather, ``(D−1)·rows_per_shard·r·4`` — per row
+      tile (unlike plain all_gather, which gathers once per half-step
+      regardless of tiling), times the row-tile count from the built
+      ``ShardedCsr`` containers when given, else assumed 1.
     - ``all_to_all``: only the requested rows move, ``(D−1)/D · D·R·r·4``
       received (+ the same sent); needs the built ``A2aCsr`` plans for R.
     - implicit adds one ``psum(YtY)`` per half-step: ``2·(D−1)/D·r²·4``
@@ -249,9 +314,14 @@ def comm_bytes_per_iter(strategy, user_part, item_part, rank,
     if strategy == "all_gather":
         half_u = (D - 1) * item_part.rows_per_shard * fb   # gathers V
         half_v = (D - 1) * user_part.rows_per_shard * fb   # gathers U
-    elif strategy == "ring":
+    elif strategy in ("ring", "ring_overlap"):
         half_u = D * item_part.rows_per_shard * fb * tiles(user_container)
         half_v = D * user_part.rows_per_shard * fb * tiles(item_container)
+    elif strategy == "all_gather_chunked":
+        half_u = ((D - 1) * item_part.rows_per_shard * fb
+                  * tiles(user_container))
+        half_v = ((D - 1) * user_part.rows_per_shard * fb
+                  * tiles(item_container))
     elif strategy == "all_to_all":
         if user_container is None or item_container is None:
             raise ValueError("all_to_all traffic needs the built A2aCsr "
@@ -282,14 +352,19 @@ def stacked_counts(part, row_idx, vals=None, positive_only=False):
 
 def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
                   cfg: AlsConfig, callback=None, strategy="all_gather",
-                  ring_counts=None, init=None, start_iter=0):
+                  ring_counts=None, init=None, start_iter=0,
+                  gather_blocks=4):
     """Distributed ALS training loop.  Returns slot-space (U, V) jax.Arrays
     sharded over ``mesh``; index with ``Partition.slot`` to get entity rows.
 
     strategy: 'all_gather' (full opposite-factor gather per half-step),
-    'ring' (ppermute streaming; pass RingCsr containers and
+    'all_gather_chunked' (same containers, gathered in ``gather_blocks``
+    column blocks per row tile — the full opposite table is never
+    materialized), 'ring' (ppermute streaming; pass RingCsr containers and
     ``ring_counts=(user_counts, item_counts)`` from :func:`stacked_counts`),
-    or 'all_to_all' (ragged row exchange; pass A2aCsr containers from
+    'ring_overlap' (ring with the double-buffered ppermute-under-einsum
+    schedule; same containers/counts as 'ring'), or 'all_to_all' (ragged
+    row exchange; pass A2aCsr containers from
     tpu_als.parallel.a2a.build_a2a).
 
     ``init``: optional entity-space ``(U0, V0)`` warm start (checkpoint
@@ -321,25 +396,33 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
             _slot_init(kv, item_part, cfg.rank), leading
         )
 
-    if strategy not in ("all_gather", "ring", "all_to_all"):
+    if strategy not in ("all_gather", "all_gather_chunked", "ring",
+                        "ring_overlap", "all_to_all"):
         raise ValueError(f"unknown strategy {strategy!r} "
-                         "(expected 'all_gather', 'ring' or 'all_to_all')")
+                         "(expected 'all_gather', 'all_gather_chunked', "
+                         "'ring', 'ring_overlap' or 'all_to_all')")
     with obs.span("train.build_step", strategy=strategy):
         if strategy == "all_to_all":
             us = jax.device_put(user_sharded.send_idx, leading)
             is_ = jax.device_put(item_sharded.send_idx, leading)
             step = make_a2a_step(mesh, user_sharded, item_sharded, cfg)
             args = (ub, ib, us, is_)
-        elif strategy == "ring":
+        elif strategy in ("ring", "ring_overlap"):
             if ring_counts is None:
                 raise ValueError(
-                    "strategy='ring' requires ring_counts="
+                    f"strategy={strategy!r} requires ring_counts="
                     "(user_counts, item_counts) from stacked_counts")
             uc, ic = ring_counts
             uc = jax.device_put(uc, leading)
             ic = jax.device_put(ic, leading)
-            step = make_ring_step(mesh, user_sharded, item_sharded, cfg)
+            step = make_ring_step(mesh, user_sharded, item_sharded, cfg,
+                                  overlap=(strategy == "ring_overlap"))
             args = (ub, ib, uc, ic)
+        elif strategy == "all_gather_chunked":
+            step = make_chunked_gather_step(
+                mesh, user_sharded, item_sharded, cfg,
+                n_blocks=gather_blocks)
+            args = (ub, ib)
         else:
             step = make_sharded_step(mesh, user_sharded, item_sharded, cfg)
             args = (ub, ib)

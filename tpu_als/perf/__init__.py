@@ -1,0 +1,14 @@
+"""``tpu_als.perf`` — analytical performance models.
+
+:mod:`tpu_als.perf.roofline` prices one ALS iteration stage by stage
+(bytes moved vs FLOPs) and turns it into an HBM/compute floor in
+seconds per iteration, so measured points land on a chart with a floor
+instead of in a vacuum.  See docs/roofline.md.
+"""
+
+from tpu_als.perf.roofline import (  # noqa: F401
+    HEADLINE,
+    Stage,
+    render,
+    roofline,
+)

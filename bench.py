@@ -72,46 +72,73 @@ def call_with_timeout(fn, seconds, what):
     return box["v"]
 
 
+def _load_retry_module():
+    """Load tpu_als/resilience/retry.py STANDALONE (the file is
+    deliberately stdlib-only): importing the tpu_als package here would
+    pull jax into THIS process ahead of the subprocess probe, defeating
+    the hang isolation."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tpu_als", "resilience", "retry.py")
+    spec = importlib.util.spec_from_file_location("_bench_retry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def tpu_ready(attempts=6, wait_s=90, probe_timeout_s=120):
     """Probe backend init in a subprocess (a hung tunnel cannot wedge us).
 
     Returns ``(ok, error_string, events)``.  Retries ``attempts`` times,
-    ``wait_s`` apart — the tunnel is known to recover on its own.  Each
-    failed attempt is logged as ONE structured JSONL ``bench_retry``
-    event (the tpu_als.obs.schema shape, constructed inline: importing
-    tpu_als here would pull jax into THIS process ahead of the
-    subprocess probe, defeating the hang isolation) so a log scraper
-    gets attempt counts and wait reasons without parsing prose.
+    ``wait_s`` apart — the tunnel is known to recover on its own.  The
+    loop itself is tpu_als.resilience.retry (constant backoff: factor=1,
+    no jitter — the historical probe cadence), loaded standalone so this
+    process stays jax-free.  Each failed attempt is logged as ONE
+    structured JSONL ``bench_retry`` event (the tpu_als.obs.schema
+    shape, built in the on_attempt hook) so a log scraper gets attempt
+    counts and wait reasons without parsing prose.
     """
+    retry = _load_retry_module()
     code = "import jax; d = jax.devices(); print(len(d), d[0].device_kind)"
-    err = "unknown"
     events = []
-    for k in range(attempts):
+
+    def probe():
         t0 = time.time()
         try:
             p = subprocess.run(
                 [sys.executable, "-c", code],
                 timeout=probe_timeout_s, capture_output=True, text=True,
             )
-            if p.returncode == 0:
-                log(f"backend probe ok ({time.time()-t0:.0f}s): "
-                    f"{p.stdout.strip()}")
-                return True, "", events
+        except subprocess.TimeoutExpired:
+            raise TimeoutError(f"backend init hung >{probe_timeout_s}s "
+                               "(axon tunnel unresponsive)")
+        if p.returncode != 0:
             tail = [ln for ln in (p.stderr or "").strip().splitlines()
                     if ln.strip()]
-            err = tail[-1] if tail else f"probe rc={p.returncode}"
-        except subprocess.TimeoutExpired:
-            err = (f"backend init hung >{probe_timeout_s}s "
-                   "(axon tunnel unresponsive)")
+            raise IOError(tail[-1] if tail
+                          else f"probe rc={p.returncode}")
+        log(f"backend probe ok ({time.time()-t0:.0f}s): "
+            f"{p.stdout.strip()}")
+
+    def on_attempt(info):
+        # provenance contract: reason is the RAW probe error, not the
+        # retry layer's "ExcName: ..." rendering
         ev = {"ts": round(time.time(), 6), "type": "bench_retry",
-              "attempt": k + 1, "attempts": attempts,
-              "elapsed_seconds": round(time.time() - t0, 3),
-              "reason": err}
+              "attempt": info["attempt"], "attempts": info["attempts"],
+              "elapsed_seconds": round(info["elapsed_seconds"], 3),
+              "reason": info["reason"].split(": ", 1)[-1]}
         events.append(ev)
         log(json.dumps(ev))
-        if k + 1 < attempts:
-            time.sleep(wait_s)
-    return False, err, events
+
+    policy = retry.RetryPolicy(max_attempts=attempts, base_delay=wait_s,
+                               factor=1.0, max_delay=wait_s, jitter=0.0)
+    try:
+        retry.retry_call(probe, policy=policy, what="bench.tpu_ready",
+                         on_attempt=on_attempt)
+        return True, "", events
+    except retry.RetryExhausted as e:
+        return False, str(e.last), events
 
 
 # headline sweep step -> the flag overrides it measured

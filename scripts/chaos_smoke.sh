@@ -1,0 +1,61 @@
+#!/bin/bash
+# Chaos smoke: the resilience subsystem's CI gate, CPU-only (no
+# accelerator, no network).  Three stages, fail-fast:
+#
+#   1. the fast chaos matrix — every fault point exercised with at least
+#      one injected failure (tests/test_resilience.py, tier-1 subset)
+#      plus the resume/preemption suite,
+#   2. the static obs-schema check (the resilience event vocabulary —
+#      retry_attempt, fault_injected, preempted, ... — must stay
+#      declared),
+#   3. one END-TO-END kill-and-resume train: preempt the CLI at an
+#      iteration boundary (deterministic TPU_ALS_PREEMPT_AT knob),
+#      expect the distinct exit code 43, resume with --resume auto,
+#      expect success.
+#
+# Usage: scripts/chaos_smoke.sh   (from the repo root; ~1 min on CPU)
+set -u
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+fail=0
+
+echo "== chaos smoke 1/3: fault-point matrix (fast tier) =="
+python -m pytest tests/test_resilience.py tests/test_resume.py \
+    -q -m 'not slow' -p no:cacheprovider || fail=1
+
+echo "== chaos smoke 2/3: obs schema (static) =="
+python scripts/check_obs_schema.py || fail=1
+
+echo "== chaos smoke 3/3: end-to-end kill-and-resume =="
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+train=(python -m tpu_als.cli train --data synthetic:80x40x1500
+       --rank 4 --max-iter 6 --reg-param 0.05 --seed 7
+       --checkpoint-dir "$work/ck")
+
+TPU_ALS_PREEMPT_AT=3 "${train[@]}" 2>"$work/preempt.log"
+rc=$?
+if [ "$rc" -ne 43 ]; then
+    echo "FAIL: preempted train exited $rc, expected 43" >&2
+    tail -5 "$work/preempt.log" >&2
+    fail=1
+fi
+
+"${train[@]}" --resume auto --output "$work/model" 2>"$work/resume.log"
+rc=$?
+if [ "$rc" -ne 0 ] || [ ! -f "$work/model/manifest.json" ]; then
+    echo "FAIL: resumed train exited $rc (model present: $([ -f "$work/model/manifest.json" ] && echo yes || echo no))" >&2
+    tail -5 "$work/resume.log" >&2
+    fail=1
+fi
+grep -q "resuming from" "$work/resume.log" || {
+    echo "FAIL: resume did not discover the preemption checkpoint" >&2
+    fail=1
+}
+
+if [ "$fail" -ne 0 ]; then
+    echo "chaos smoke: FAIL" >&2
+    exit 1
+fi
+echo "chaos smoke: OK"

@@ -112,6 +112,105 @@ def test_resume_rejects_mismatched_solver_params(dataset, tmp_path):
                     resumeFrom=ckpt).fit(frame)
 
 
+def _cli(args, env=None):
+    return subprocess.run(
+        [sys.executable, "-c",
+         "import sys; from tpu_als.cli import main; main(sys.argv[1:])"]
+        + args,
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **(env or {})})
+
+
+def test_cli_preempt_then_resume_auto_is_bitwise_exact(tmp_path):
+    """Graceful preemption end to end: the train CLI stops at an
+    iteration boundary with the distinct exit code, and ``--resume
+    auto`` discovers the checkpoint and produces factors BITWISE equal
+    to an uninterrupted run — resume is restart-from-factors of a
+    deterministic fixed-point iteration, so anything weaker than
+    ``np.array_equal`` would hide a real divergence.
+
+    Uses the deterministic ``TPU_ALS_PREEMPT_AT`` knob (a real SIGTERM
+    races a fast CPU fit; the signal plumbing itself is covered by
+    tests/test_resilience.py)."""
+    from tpu_als.resilience.preempt import EXIT_PREEMPTED
+
+    base = ["train", "--data", "synthetic:80x40x1500", "--rank", "4",
+            "--max-iter", "6", "--reg-param", "0.05", "--seed", "7"]
+    ckdir, out_full, out_res = (str(tmp_path / d)
+                                for d in ("ck", "full", "resumed"))
+
+    p = _cli(base + ["--output", out_full])
+    assert p.returncode == 0, p.stderr
+
+    # "preempted" at the iteration-3 boundary: checkpoint, exit 43
+    p = _cli(base + ["--checkpoint-dir", ckdir,
+                     "--checkpoint-interval", "100"],
+             env={"TPU_ALS_PREEMPT_AT": "3"})
+    assert p.returncode == EXIT_PREEMPTED, (p.returncode, p.stderr)
+    assert "preempted" in p.stderr
+    manifest, *_ = load_factors(os.path.join(ckdir, "als_checkpoint"))
+    assert manifest["iteration"] == 3
+
+    # resume discovers the checkpoint and finishes iterations 4..6
+    p = _cli(base + ["--checkpoint-dir", ckdir, "--resume", "auto",
+                     "--output", out_res])
+    assert p.returncode == 0, p.stderr
+    assert "resuming from" in p.stderr
+
+    for side in ("user_factors.npz", "item_factors.npz"):
+        full = np.load(os.path.join(out_full, side))
+        res = np.load(os.path.join(out_res, side))
+        assert np.array_equal(full["factors"], res["factors"]), side
+        assert np.array_equal(full["ids"], res["ids"]), side
+
+
+@pytest.mark.slow
+def test_cli_real_sigterm_checkpoints_and_exits_43(tmp_path):
+    """A REAL SIGTERM mid-fit (not the deterministic knob): the guard
+    finishes the in-flight iteration, checkpoints, and exits 43.  maxIter
+    is set far beyond what the timeout allows so the run is always
+    mid-fit when the signal lands."""
+    import signal
+    import time
+
+    from tpu_als.resilience.preempt import EXIT_PREEMPTED
+
+    ckdir = str(tmp_path / "ck")
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys; from tpu_als.cli import main; main(sys.argv[1:])",
+         "train", "--data", "synthetic:80x40x1500", "--rank", "4",
+         "--max-iter", "100000", "--reg-param", "0.05", "--seed", "7",
+         "--checkpoint-dir", ckdir, "--checkpoint-interval", "100000"],
+        stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        # wait until the fit is actually running before signaling
+        for line in proc.stderr:
+            if "training on" in line:
+                break
+        time.sleep(3)                      # let compilation+iters start
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == EXIT_PREEMPTED, rc
+    manifest, *_ = load_factors(os.path.join(ckdir, "als_checkpoint"))
+    assert manifest["iteration"] >= 1
+
+
+def test_cli_resume_auto_fresh_dir_starts_from_scratch(tmp_path):
+    """--resume auto with nothing on disk must start fresh (exit 0),
+    not fail — the orchestrator reruns the same command after ANY
+    preemption, including one that never reached a checkpoint."""
+    p = _cli(["train", "--data", "synthetic:40x20x400", "--rank", "3",
+              "--max-iter", "2", "--checkpoint-dir",
+              str(tmp_path / "empty"), "--resume", "auto"])
+    assert p.returncode == 0, p.stderr
+    assert "starting from scratch" in p.stderr
+
+
 def test_truncated_checkpoint_raises_not_garbage(rng, tmp_path):
     """A torn factor file (partial write, disk corruption) must raise at
     load — the npz zip container CRC/structure check is the integrity

@@ -540,10 +540,23 @@ class ALS(_ALSParams, Estimator):
                   and iteration % interval == 0)
         return due_cb, due_ck
 
+    def _callback_due(self, iteration):
+        """True when the per-iteration callback has any work at this
+        iteration (fitCallback, checkpoint, or a pending preemption) —
+        the gate fit_sharded uses to skip the slot→entity factor fetch
+        on quiet iterations."""
+        from tpu_als.resilience import preempt
+
+        due_cb, due_ck = self._due(iteration)
+        return due_cb or due_ck or preempt.pending(iteration)
+
     def _checkpoint_callback(self, user_map, item_map):
+        from tpu_als.resilience import preempt
+
         ckpt = self.checkpointDir is not None \
             and self.getCheckpointInterval() >= 1
-        if not ckpt and self.fitCallback is None:
+        if not ckpt and self.fitCallback is None \
+                and not preempt.enabled():
             return None
 
         def cb(iteration, U, V):
@@ -552,6 +565,25 @@ class ALS(_ALSParams, Estimator):
                 self.fitCallback(iteration, U, V)
             if due_ck:
                 self._save_checkpoint(user_map, item_map, iteration, U, V)
+            if preempt.pending(iteration):
+                # the in-flight iteration is complete (we are at its
+                # boundary): write the resume point, then stop with the
+                # distinct exit status
+                import os
+
+                from tpu_als import obs
+
+                path = None
+                if self.checkpointDir is not None:
+                    if not due_ck:  # don't rewrite an identical save
+                        self._save_checkpoint(
+                            user_map, item_map, iteration, U, V)
+                    path = os.path.join(self.checkpointDir,
+                                        "als_checkpoint")
+                g = preempt.installed()
+                signum = g.signum if g is not None else None
+                obs.emit("preempted", iteration=iteration, signum=signum)
+                raise preempt.Preempted(iteration, path, signum)
 
         return cb
 

@@ -123,7 +123,19 @@ def fit_multiprocess(est, u_idx, i_idx, r, user_map, item_map, cfg,
     # expensive end-of-training collective)
     if callback is not None:
         def mp_cb(iteration, Us, Vs, up, ip):
+            from jax.experimental import multihost_utils as mhu
+
+            from tpu_als.resilience import preempt
+
             due_cb, due_ck = est._due(iteration)
+            # preemption must be a COLLECTIVE decision: the signal lands
+            # on one host, but every process must take the same save +
+            # stop path or the survivors hang in the next collective
+            stopping = bool(np.asarray(mhu.process_allgather(np.array(
+                [int(preempt.pending(iteration))],
+                dtype=np.int64))).sum() > 0)
+            if stopping and est.checkpointDir is not None:
+                due_ck = True  # force a resume point at this boundary
             if due_ck and est.checkpointSharded:
                 # factor bytes never cross hosts: each process writes
                 # its own shards (barriers inside); the gather below
@@ -140,7 +152,7 @@ def fit_multiprocess(est, u_idx, i_idx, r, user_map, item_map, cfg,
                     est.mesh, params=est._ckpt_params(),
                     iteration=iteration)
                 due_ck = False
-            if not (due_cb or due_ck):
+            if not (due_cb or due_ck or stopping):
                 return
             # the gathers are collective: EVERY process runs them; only
             # process 0 observes the result
@@ -156,6 +168,17 @@ def fit_multiprocess(est, u_idx, i_idx, r, user_map, item_map, cfg,
                 if due_ck:
                     est._save_checkpoint(
                         user_map, item_map, iteration, Ue, Ve)
+            if stopping:
+                import os
+
+                from tpu_als import obs
+
+                path = (os.path.join(est.checkpointDir, "als_checkpoint")
+                        if est.checkpointDir is not None else None)
+                g = preempt.installed()
+                signum = g.signum if g is not None else None
+                obs.emit("preempted", iteration=iteration, signum=signum)
+                raise preempt.Preempted(iteration, path, signum)
 
     Us, Vs, upart, ipart = train_multihost(
         u_idx, i_idx, r, len(user_map), len(item_map), cfg,
@@ -249,6 +272,8 @@ def fit_sharded(est, u_idx, i_idx, r, user_map, item_map, cfg,
     sharded_cb = None
     if callback is not None:
         def sharded_cb(iteration, U, V):  # slot space -> entity space
+            if not est._callback_due(iteration):
+                return  # nothing due: skip the full-factor fetch
             with obs.span("train.fetch_factors"):
                 Ue = np.asarray(U)[upart.slot]
                 Ve = np.asarray(V)[ipart.slot]

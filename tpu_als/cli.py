@@ -305,8 +305,35 @@ def _iteration_cb(logger):
     return cb
 
 
+def _resolve_resume(args):
+    """``--resume PATH`` loads that checkpoint; ``--resume auto``
+    discovers the newest VALID generation under --checkpoint-dir
+    (digest-checked, corrupt generations quarantined, ``.old``
+    considered) and starts fresh when none exists."""
+    resume = getattr(args, "resume", None)
+    if not resume:
+        return None
+    if resume != "auto":
+        return resume
+    if not getattr(args, "checkpoint_dir", None):
+        raise SystemExit("--resume auto needs --checkpoint-dir (it "
+                         "searches that directory for the newest valid "
+                         "checkpoint)")
+    from tpu_als.io.checkpoint import discover_resume
+
+    path = discover_resume(args.checkpoint_dir)
+    if path is None:
+        print("--resume auto: no valid checkpoint under "
+              f"{args.checkpoint_dir}; starting from scratch",
+              file=sys.stderr)
+    else:
+        print(f"--resume auto: resuming from {path}", file=sys.stderr)
+    return path
+
+
 def cmd_train(args):
     from tpu_als import ALS, RegressionEvaluator, obs
+    from tpu_als.resilience import preempt
     from tpu_als.utils.observe import IterationLogger
 
     # resolve the multi-process branch BEFORE loading data: every pod host
@@ -348,19 +375,30 @@ def cmd_train(args):
               nonnegative=args.nonnegative, seed=args.seed,
               coldStartStrategy="drop", fitCallback=fit_cb,
               mesh=mesh, gatherStrategy=args.gather_strategy,
-              cgIters=args.cg_iters)
+              cgIters=args.cg_iters,
+              checkpointDir=args.checkpoint_dir,
+              checkpointInterval=args.checkpoint_interval,
+              resumeFrom=_resolve_resume(args))
     print(f"training on {len(train):,} ratings "
           f"({len(test):,} held out)", file=sys.stderr)
     try:
-        if args.profile_dir:
-            from tpu_als.utils.observe import trace
+        # SIGTERM/SIGINT: finish the in-flight iteration, checkpoint,
+        # exit with the distinct EXIT_PREEMPTED status (resume with
+        # `--resume auto`)
+        with preempt.PreemptionGuard():
+            if args.profile_dir:
+                from tpu_als.utils.observe import trace
 
-            with trace(args.profile_dir):
+                with trace(args.profile_dir):
+                    model = als.fit(train)
+                print(f"profiler trace written to {args.profile_dir}",
+                      file=sys.stderr)
+            else:
                 model = als.fit(train)
-            print(f"profiler trace written to {args.profile_dir}",
-                  file=sys.stderr)
-        else:
-            model = als.fit(train)
+    except preempt.Preempted as p:
+        print(f"preempted — {p}; rerun with --resume auto to continue",
+              file=sys.stderr)
+        raise  # SystemExit(EXIT_PREEMPTED); obs still finalizes in main
     finally:
         if logger is not None:
             logger.close()
@@ -445,23 +483,35 @@ def _train_multiprocess(args):
     print(f"[proc {pid}/{pcount}] training {len(train):,} ratings "
           f"({'per-host' if args.per_host_data else 'replicated'} load) "
           f"over {mesh.devices.size} devices", file=sys.stderr)
+    from tpu_als.resilience import preempt
+
     als = ALS(rank=args.rank, maxIter=args.max_iter,
               regParam=args.reg_param, implicitPrefs=args.implicit,
               alpha=args.alpha, nonnegative=args.nonnegative,
               seed=args.seed, coldStartStrategy="drop", mesh=mesh,
               gatherStrategy=args.gather_strategy, fitCallback=fit_cb,
               dataMode="per_host" if args.per_host_data else "replicated",
-              cgIters=args.cg_iters)
+              cgIters=args.cg_iters,
+              checkpointDir=args.checkpoint_dir,
+              checkpointInterval=args.checkpoint_interval,
+              resumeFrom=_resolve_resume(args))
     ctx = contextlib.nullcontext()
     if args.profile_dir:
         from tpu_als.utils.observe import trace
 
         ctx = trace(f"{args.profile_dir}/proc{pid}")
     try:
-        with ctx:
+        # the preemption decision is collective inside fit: a signal on
+        # ANY host checkpoints and stops EVERY process at the same
+        # iteration boundary
+        with preempt.PreemptionGuard(), ctx:
             # fit's multi-process branch: per-host blocking, cross-host
             # collectives, replicated model on every host
             model = als.fit(train)
+    except preempt.Preempted as p:
+        print(f"[proc {pid}] preempted — {p}; rerun with --resume auto",
+              file=sys.stderr)
+        raise
     finally:
         if logger is not None:
             logger.close()
@@ -889,6 +939,19 @@ def main(argv=None):
                    help="> 0: inexact ALS — warm-started CG solve with "
                         "this many steps per half-step (0 = exact "
                         "batched Cholesky)")
+    t.add_argument("--checkpoint-dir", default=None,
+                   help="write atomic factor checkpoints under this "
+                        "directory every --checkpoint-interval "
+                        "iterations (also the preemption save target: "
+                        "SIGTERM checkpoints here and exits 43)")
+    t.add_argument("--checkpoint-interval", type=int, default=10,
+                   help="iterations between checkpoints (with "
+                        "--checkpoint-dir)")
+    t.add_argument("--resume", default=None, metavar="PATH|auto",
+                   help="warm-start from a checkpoint: a directory "
+                        "path, or 'auto' to discover the newest VALID "
+                        "generation under --checkpoint-dir (corrupt "
+                        "generations are quarantined to .corrupt/)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("evaluate", help="score a dataset with a saved model",

@@ -7,10 +7,33 @@ iteration so recovery is literally restart-from-factors; and (b) model
 persistence — the analog of ``ALSModel.save`` (JSON metadata +
 userFactors/itemFactors Parquet, SURVEY.md §2.B11), here a JSON manifest +
 ``.npz`` arrays (factors and original-id maps).
+
+Integrity contract (the resilience layer's half of the story):
+
+- ``save_factors`` records a blake2b digest of every data file in
+  ``manifest["files"]`` and installs atomically (tmp → ``.old`` swap),
+  so a *complete* generation exists at ``path`` or ``path + '.old'`` at
+  every instant.
+- ``load_factors`` verifies presence + digest of every manifest-listed
+  file.  A torn or bit-rotted generation raises the typed
+  :class:`CheckpointCorrupt` (never a raw numpy traceback), is moved
+  aside to a ``.corrupt/`` quarantine sibling (preserved for forensics,
+  out of the way of the next save), and the ``.old`` generation is
+  loaded instead when it validates.
+- ``discover_resume`` is the ``--resume auto`` entry point: newest
+  *valid* generation under a checkpoint dir, quarantining invalid ones
+  it encounters.
+
+Transient I/O errors during save/load are retried under
+``tpu_als.resilience.retry`` (CheckpointCorrupt is a fact about bytes,
+not the weather, and is never retried).  Fault points
+``checkpoint.write`` and ``checkpoint.rename`` let the chaos suite
+exercise every branch above deterministically.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import time
@@ -18,6 +41,29 @@ import time
 import numpy as np
 
 from tpu_als import obs
+from tpu_als.resilience import faults
+from tpu_als.resilience.retry import RetryPolicy, retry_call
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint directory failed validation: missing manifest,
+    unparseable manifest, missing data file, or digest mismatch.
+    ``path`` is the offending generation."""
+
+    def __init__(self, path, reason):
+        super().__init__(f"corrupt checkpoint at {path}: {reason}")
+        self.path = str(path)
+        self.reason = reason
+
+
+# transient-I/O budget for checkpoint save/load; chaos tests swap in a
+# fast policy via the retry_policy= parameters
+_DEFAULT_RETRY = dict(max_attempts=3, base_delay=0.05, max_delay=1.0)
+
+
+def _retry_policy(override):
+    return override if override is not None \
+        else RetryPolicy(**_DEFAULT_RETRY)
 
 
 def _tree_bytes(path):
@@ -29,6 +75,14 @@ def _tree_bytes(path):
             except OSError:
                 pass
     return total
+
+
+def _file_digest(path):
+    h = hashlib.blake2b(digest_size=16)
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
 
 # 1 = replicated layout (user_factors.npz / item_factors.npz);
 # 2 = shard-per-process layout (user_shard_*.npz + slots.npz, written by
@@ -56,52 +110,84 @@ def atomic_install(tmp, path):
         shutil.rmtree(old)
     if os.path.exists(path):
         os.rename(path, old)
+    # fault point: a crash in the swap window leaves only .old on disk
+    faults.check("checkpoint.rename")
     os.rename(tmp, path)
     if os.path.exists(old):
         shutil.rmtree(old)
 
 
 def save_factors(path, user_ids, user_factors, item_ids, item_factors,
-                 params=None, iteration=None, extra=None):
-    """Write a checkpoint/model directory (atomic via tmp+rename)."""
+                 params=None, iteration=None, extra=None,
+                 retry_policy=None):
+    """Write a checkpoint/model directory (atomic via tmp+rename).
+
+    The whole write body is retried on transient I/O errors; it is
+    idempotent across attempts (stale tmp dirs are removed, the install
+    swap tolerates a pre-existing ``.old``).
+    """
     import shutil
 
     t0 = time.perf_counter()
     tmp = path + ".tmp"
-    if os.path.exists(tmp):  # stale leftovers from a crashed attempt
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
-    np.savez(os.path.join(tmp, "user_factors.npz"),
-             ids=np.asarray(user_ids), factors=np.asarray(user_factors))
-    np.savez(os.path.join(tmp, "item_factors.npz"),
-             ids=np.asarray(item_ids), factors=np.asarray(item_factors))
-    manifest = {
-        "format_version": REPLICATED_FORMAT,
-        "rank": int(np.asarray(user_factors).shape[1]),
-        "num_users": int(np.asarray(user_factors).shape[0]),
-        "num_items": int(np.asarray(item_factors).shape[0]),
-        "iteration": iteration,
-        "params": params or {},
-        "extra": extra or {},
-    }
-    with open(os.path.join(tmp, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=2)
-    nbytes = _tree_bytes(tmp)  # before the install renames tmp away
-    atomic_install(tmp, path)
+    nbytes_box = {}
+
+    def _write():
+        if os.path.exists(tmp):  # stale leftovers from a failed attempt
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        np.savez(os.path.join(tmp, "user_factors.npz"),
+                 ids=np.asarray(user_ids),
+                 factors=np.asarray(user_factors))
+        np.savez(os.path.join(tmp, "item_factors.npz"),
+                 ids=np.asarray(item_ids),
+                 factors=np.asarray(item_factors))
+        files = {name: _file_digest(os.path.join(tmp, name))
+                 for name in ("user_factors.npz", "item_factors.npz")}
+        manifest = {
+            "format_version": REPLICATED_FORMAT,
+            "rank": int(np.asarray(user_factors).shape[1]),
+            "num_users": int(np.asarray(user_factors).shape[0]),
+            "num_items": int(np.asarray(item_factors).shape[0]),
+            "iteration": iteration,
+            "params": params or {},
+            "extra": extra or {},
+            "files": files,
+        }
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+        # fault point: raise = transient write error (retried);
+        # corrupt = torn npz slips past the writer, caught at load by
+        # the digest check
+        if faults.check("checkpoint.write") == "corrupt":
+            target = os.path.join(tmp, "user_factors.npz")
+            with open(target, "r+b") as f:
+                f.truncate(max(0, os.path.getsize(target) // 2))
+        nbytes_box["n"] = _tree_bytes(tmp)  # before the install renames
+        atomic_install(tmp, path)
+
+    retry_call(_write, policy=_retry_policy(retry_policy),
+               what="checkpoint.save")
     dt = time.perf_counter() - t0
+    nbytes = nbytes_box["n"]
     obs.histogram("checkpoint.save_seconds", dt)
     obs.counter("checkpoint.save_bytes", nbytes)
     obs.emit("checkpoint_save", path=str(path), seconds=round(dt, 6),
              bytes=nbytes, iteration=iteration)
 
 
-def load_factors(path):
+def load_factors(path, retry_policy=None):
     """Read a checkpoint/model directory.
 
     Returns (manifest, user_ids, user_factors, item_ids, item_factors).
+    Validates every manifest-listed file digest; a corrupt primary is
+    quarantined to ``.corrupt/`` and the ``.old`` generation is loaded
+    when it validates, else :class:`CheckpointCorrupt` propagates.
     """
     t0 = time.perf_counter()
-    out = _load_factors(path)
+    out = retry_call(_load_validated, path,
+                     policy=_retry_policy(retry_policy),
+                     what="checkpoint.load")
     dt = time.perf_counter() - t0
     nbytes = _tree_bytes(path)
     obs.histogram("checkpoint.load_seconds", dt)
@@ -111,12 +197,81 @@ def load_factors(path):
     return out
 
 
-def _load_factors(path):
-    if not os.path.exists(os.path.join(path, "manifest.json")) and \
-            os.path.exists(os.path.join(path + ".old", "manifest.json")):
-        path = path + ".old"  # crash hit the save_factors swap window
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
+def _read_manifest(path):
+    mpath = os.path.join(path, "manifest.json")
+    if not os.path.exists(mpath):
+        raise CheckpointCorrupt(path, "missing manifest.json")
+    try:
+        with open(mpath) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CheckpointCorrupt(path, f"unreadable manifest.json: {e}")
+
+
+def validate_dir(path):
+    """Manifest + digest check of one generation; returns the manifest
+    or raises :class:`CheckpointCorrupt`.  Pre-digest manifests (no
+    ``files`` key, e.g. sharded saves) get a presence-only check."""
+    manifest = _read_manifest(path)
+    files = manifest.get("files")
+    if files is None:
+        return manifest
+    for name, digest in files.items():
+        fpath = os.path.join(path, name)
+        if not os.path.exists(fpath):
+            raise CheckpointCorrupt(path, f"missing data file {name}")
+        actual = _file_digest(fpath)
+        if actual != digest:
+            raise CheckpointCorrupt(
+                path, f"digest mismatch for {name} "
+                      f"(manifest {digest}, file {actual})")
+    return manifest
+
+
+def quarantine(path, reason):
+    """Move a corrupt generation into a ``.corrupt/`` sibling directory
+    (preserved for forensics, out of the next save's way).  Returns the
+    quarantine destination, or None if the move itself failed."""
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    qdir = os.path.join(parent, ".corrupt")
+    base = os.path.basename(path.rstrip(os.sep))
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        dest = os.path.join(qdir, f"{base}.{int(time.time())}")
+        n = 0
+        while os.path.exists(dest):
+            n += 1
+            dest = os.path.join(qdir, f"{base}.{int(time.time())}.{n}")
+        os.rename(path, dest)
+    except OSError:
+        return None
+    obs.emit("checkpoint_quarantined", path=str(path), reason=reason,
+             quarantined_to=dest)
+    return dest
+
+
+def _load_validated(path):
+    primary, old = path, path + ".old"
+    if not os.path.exists(os.path.join(primary, "manifest.json")) and \
+            os.path.exists(os.path.join(old, "manifest.json")):
+        # crash hit the save_factors swap window: only .old is complete
+        return _load_dir(old, validate_dir(old))
+    try:
+        return _load_dir(primary, validate_dir(primary))
+    except CheckpointCorrupt as e:
+        # quarantine only dirs that ARE checkpoints with torn contents:
+        # the atomic writer never installs a generation without its
+        # manifest, so a manifest-less dir is some OTHER artifact (e.g.
+        # an estimator save) passed by mistake — moving it aside would
+        # destroy it
+        if os.path.exists(os.path.join(primary, "manifest.json")):
+            quarantine(primary, e.reason)
+        if os.path.exists(os.path.join(old, "manifest.json")):
+            return _load_dir(old, validate_dir(old))
+        raise
+
+
+def _load_dir(path, manifest):
     if manifest["format_version"] > FORMAT_VERSION:
         raise ValueError(
             f"checkpoint format {manifest['format_version']} is newer than "
@@ -146,6 +301,49 @@ def _load_factors(path):
         V = side("item", int(manifest["rows_per_shard_item"]),
                  slots["item_slot"])
         return manifest, slots["user_ids"], U, slots["item_ids"], V
-    u = np.load(os.path.join(path, "user_factors.npz"), allow_pickle=False)
-    i = np.load(os.path.join(path, "item_factors.npz"), allow_pickle=False)
-    return manifest, u["ids"], u["factors"], i["ids"], i["factors"]
+    try:
+        u = np.load(os.path.join(path, "user_factors.npz"),
+                    allow_pickle=False)
+        i = np.load(os.path.join(path, "item_factors.npz"),
+                    allow_pickle=False)
+        return manifest, u["ids"], u["factors"], i["ids"], i["factors"]
+    except FileNotFoundError as e:
+        raise CheckpointCorrupt(path, f"missing data file: {e}")
+    except (ValueError, OSError, KeyError) as e:
+        # a torn npz surfaces from numpy as ValueError/zipfile errors —
+        # translate to the typed contract (pre-digest manifests only;
+        # digest validation catches this first otherwise)
+        raise CheckpointCorrupt(path, f"unreadable data file: {e}")
+
+
+def discover_resume(checkpoint_dir):
+    """``--resume auto``: newest valid checkpoint generation under
+    ``checkpoint_dir``.
+
+    Accepts either a directory that *is* a checkpoint (has
+    manifest.json) or a training ``checkpointDir`` containing the
+    estimator's ``als_checkpoint`` (+ ``.old``) generations.  Invalid
+    generations encountered on the way are quarantined.  Returns the
+    path to load, or None when nothing valid exists.
+    """
+    candidates = []
+    if os.path.exists(os.path.join(checkpoint_dir, "manifest.json")):
+        candidates.append(checkpoint_dir)
+    else:
+        for name in ("als_checkpoint", "als_checkpoint.old"):
+            p = os.path.join(checkpoint_dir, name)
+            if os.path.isdir(p):
+                candidates.append(p)
+    best, best_iter = None, None
+    for p in candidates:
+        try:
+            manifest = validate_dir(p)
+        except CheckpointCorrupt as e:
+            if os.path.exists(os.path.join(p, "manifest.json")):
+                quarantine(p, e.reason)  # torn checkpoint, not junk
+            continue
+        it = manifest.get("iteration")
+        it = -1 if it is None else int(it)
+        if best_iter is None or it > best_iter:
+            best, best_iter = p, it
+    return best

@@ -45,6 +45,8 @@ import numpy as np
 
 from tpu_als import obs
 from tpu_als.io._native_build import build_native
+from tpu_als.resilience import faults
+from tpu_als.resilience.retry import RetryPolicy, retry_call
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _SRC = os.path.join(_NATIVE_DIR, "streamcsv.cc")
@@ -115,8 +117,31 @@ def decode_labels(labels):
     return [s.decode("utf-8") for s in labels.tolist()]
 
 
+def _read_chunk(f, pos, want, policy):
+    """One chunk read under the retry policy.  Each attempt seeks back
+    to ``pos`` first, so a partially-consumed failed read never skips
+    bytes.  Fault point ``ingest.read_chunk``: raise = transient read
+    error (retried); corrupt = a stray newline tears a line mid-chunk
+    (NUL would be skipped as padding by the native parser), which the
+    strict parser rejects as a malformed line (typed ValueError, never
+    silently-wrong rows)."""
+
+    def _read():
+        f.seek(pos)
+        mode = faults.check("ingest.read_chunk")
+        block = f.read(want)
+        if mode == "corrupt" and block:
+            buf = bytearray(block)
+            buf[len(buf) // 2] = ord("\n")
+            block = bytes(buf)
+        return block
+
+    return retry_call(_read, policy=policy, what="ingest.read_chunk")
+
+
 def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
-                  require_cols=3, skip_header=0, chunk_bytes=32 << 20):
+                  require_cols=3, skip_header=0, chunk_bytes=32 << 20,
+                  retry_policy=None):
     """Stream this host's byte range into (users, items, ratings, vocab).
 
     Returns ``(u_local, i_local, ratings, user_labels, item_labels)``
@@ -131,6 +156,8 @@ def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
     fastcsv strictness contract: no silent zero/merged rows).
     """
     lib = _load()
+    policy = retry_policy if retry_policy is not None \
+        else RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=1.0)
     size = os.path.getsize(path)
     start, end = host_byte_range(size, host_index, num_hosts)
     handle = lib.sc_create()
@@ -161,7 +188,7 @@ def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
             while pos < end:
                 want = min(chunk_bytes, end - pos)
                 t_io = time.perf_counter()
-                block = f.read(want)
+                block = _read_chunk(f, pos, want, policy)
                 stall += time.perf_counter() - t_io
                 if not block:
                     break

@@ -82,7 +82,10 @@ def summarize_events(events):
             snapshot = ev
     for s in spans.values():
         s["total_seconds"] = round(s["total_seconds"], 6)
-        s["mean_seconds"] = round(s["total_seconds"] / s["count"], 6)
+        # derived from the rounded total WITHOUT re-rounding: a 6-decimal
+        # round of the mean breaks mean == total/count whenever the total
+        # is an odd number of microseconds (sub-µs spans in tests)
+        s["mean_seconds"] = s["total_seconds"] / s["count"]
     out = {"phases": spans, "iterations": iterations, "gauges": gauges,
            "warnings": warnings}
     if ingest["calls"]:

@@ -53,6 +53,10 @@ METRICS = {
         "histogram", "seconds", "load_factors wall-clock duration"),
     "checkpoint.load_bytes": (
         "counter", "bytes", "bytes read by load_factors"),
+    "serve.degraded": (
+        "counter", "requests",
+        "top-k requests answered from last-good factors because the "
+        "sharded gather failed (parallel.serve degraded mode)"),
 }
 
 # event type -> (required fields beyond ts/type, help text).  Extra
@@ -86,6 +90,30 @@ EVENTS = {
     "bench_retry": (
         ("attempt", "attempts", "elapsed_seconds", "reason"),
         "one per failed bench.py backend probe attempt"),
+    "retry_attempt": (
+        ("what", "attempt", "attempts", "elapsed_seconds", "reason"),
+        "one per failed attempt inside resilience.retry.retry_call "
+        "(the call will be retried)"),
+    "retry_exhausted": (
+        ("what", "attempts", "reason"),
+        "retry_call gave up: every attempt in the budget failed"),
+    "fault_injected": (
+        ("point", "mode", "hit"),
+        "a resilience.faults fault point fired (chaos testing only; "
+        "never emitted when TPU_ALS_FAULT_SPEC is unset)"),
+    "serve_degraded": (
+        ("strategy", "reason"),
+        "a sharded top-k request fell back to last-good gathered "
+        "factors after a gather failure"),
+    "preempted": (
+        ("iteration", "signum"),
+        "training stopped at an iteration boundary after SIGTERM/"
+        "SIGINT; a resumable checkpoint was written if a checkpoint "
+        "dir is configured"),
+    "checkpoint_quarantined": (
+        ("path", "reason"),
+        "load_factors moved a corrupt checkpoint generation aside to "
+        ".corrupt/ (and fell back to .old when present)"),
     "warning": (
         ("what", "reason"),
         "a degraded-but-continuing condition (e.g. profiler trace "

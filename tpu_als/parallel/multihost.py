@@ -48,7 +48,7 @@ import jax
 
 
 def init_distributed(coordinator_address=None, num_processes=None,
-                     process_id=None):
+                     process_id=None, retry_policy=None):
     """Connect this process to the deployment (no-op when single-process).
 
     Resolution order: explicit args → the standard JAX env vars
@@ -56,8 +56,17 @@ def init_distributed(coordinator_address=None, num_processes=None,
     ``JAX_PROCESS_ID``, also set by TPU pod launchers) → single-process
     no-op.  Must run before first JAX use, like Spark's ``SparkContext``
     construction must precede any job.
+
+    The rendezvous is retried under ``tpu_als.resilience.retry``
+    (default: 5 attempts, 1s base exponential backoff) — a coordinator
+    that is still binding its port, or a DCN blip, is the single most
+    common pod-launch flake and must not kill the whole deployment.
+    Fault point ``multihost.init`` fires inside each rendezvous attempt.
     Returns (process_index, process_count).
     """
+    from tpu_als.resilience import faults
+    from tpu_als.resilience.retry import RetryPolicy, retry_call
+
     coordinator_address = (coordinator_address
                            or os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if coordinator_address and _already_initialized():
@@ -65,16 +74,26 @@ def init_distributed(coordinator_address=None, num_processes=None,
         # before handing control to code that also calls this — a second
         # jax.distributed.initialize would raise (the backend is up)
         coordinator_address = None
-    if coordinator_address:
-        kw = {"coordinator_address": coordinator_address}
-        num_processes = num_processes or os.environ.get("JAX_NUM_PROCESSES")
-        process_id = process_id if process_id is not None else \
-            os.environ.get("JAX_PROCESS_ID")
-        if num_processes is not None:
-            kw["num_processes"] = int(num_processes)
-        if process_id is not None:
-            kw["process_id"] = int(process_id)
-        jax.distributed.initialize(**kw)
+
+    def _rendezvous():
+        # the fault point lives INSIDE the retried closure so chaos
+        # tests exercise the retry loop even on the single-process path
+        faults.check("multihost.init")
+        if coordinator_address and not _already_initialized():
+            kw = {"coordinator_address": coordinator_address}
+            np_ = num_processes or os.environ.get("JAX_NUM_PROCESSES")
+            pid = process_id if process_id is not None else \
+                os.environ.get("JAX_PROCESS_ID")
+            if np_ is not None:
+                kw["num_processes"] = int(np_)
+            if pid is not None:
+                kw["process_id"] = int(pid)
+            jax.distributed.initialize(**kw)
+
+    policy = retry_policy if retry_policy is not None else \
+        RetryPolicy(max_attempts=5, base_delay=1.0, max_delay=15.0,
+                    retry_on=(OSError, TimeoutError, RuntimeError))
+    retry_call(_rendezvous, policy=policy, what="multihost.init")
     return jax.process_index(), jax.process_count()
 
 

@@ -38,8 +38,49 @@ from jax.sharding import PartitionSpec as P
 from tpu_als import obs
 from tpu_als.ops.topk import NEG_INF, chunked_topk_scores
 from tpu_als.parallel.mesh import AXIS, shard_map
+from tpu_als.resilience import faults
 
 STRATEGIES = ("all_gather", "ring")
+
+
+class ServeShardLost(RuntimeError):
+    """A sharded top-k gather failed (lost/stale factor shard) and no
+    last-good catalog is cached to degrade onto — the request cannot be
+    answered.  Callers that can shed load should catch this; the first
+    successful request after recovery repopulates the cache."""
+
+
+# (V, valid) from the last successful single-process sharded serve; the
+# degraded path answers from this host-side copy when a gather fails.
+# One extra catalog copy in host RAM is the availability price — see
+# docs/resilience.md.  Guarded writes only (numpy assignment is atomic
+# enough for the single reference swap).
+_last_good = None
+
+
+def reset_last_good():
+    """Drop the degraded-serving cache (tests; memory pressure)."""
+    global _last_good
+    _last_good = None
+
+
+def _serve_degraded(U, k, Nu, strategy, reason, record):
+    """Answer from the last-good catalog on ONE device.  Slower and
+    possibly stale — but an answer, which beats a crash for a
+    recommender (the scores were approximate to begin with)."""
+    if _last_good is None:
+        raise ServeShardLost(
+            f"sharded top-k failed ({reason}) and no last-good factors "
+            "are cached to serve degraded from")
+    Vg, validg = _last_good
+    kk = min(k, Vg.shape[0])
+    obs.counter("serve.degraded")
+    obs.emit("serve_degraded", strategy=strategy, reason=reason)
+    s, ix = chunked_topk_scores(jnp.asarray(U), jnp.asarray(Vg),
+                                jnp.asarray(validg), kk)
+    out = (np.asarray(s)[:Nu], np.asarray(ix)[:Nu].astype(np.int32))
+    record(Nu)
+    return out
 
 
 def _merge_topk(s1, i1, s2, i2, k):
@@ -102,7 +143,7 @@ def _build(mesh, ni_loc, k, k_loc, strategy, item_chunk):
 
 
 def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
-                 item_chunk=8192):
+                 item_chunk=8192, return_info=False):
     """Top-k over a mesh: ``U`` rows sharded as queries, ``V`` rows
     sharded as the catalog.  Identical (up to tie-breaking) to
     ``chunked_topk_scores(U, V, valid, k')`` on one device, with
@@ -115,7 +156,17 @@ def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
     rows (``shard.index[0].start`` is the global row offset).  The
     higher-level ``ALSModel.recommendFor*`` surfaces refuse the
     multi-process case rather than crash mid-assembly.
+
+    Degraded mode (single-process only): when the sharded execute fails
+    — a lost/stale factor shard, a device error, or the ``serve.gather``
+    fault point — the request is answered from the last successfully
+    gathered catalog on one device instead of crashing
+    (``serve.degraded`` counter + ``serve_degraded`` event); with no
+    last-good catalog cached, the typed :class:`ServeShardLost` raises.
+    ``return_info=True`` appends ``{"degraded": bool, "reason": ...}``
+    to the return tuple so callers can surface staleness.
     """
+    global _last_good
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown serving strategy {strategy!r} "
                          f"(expected one of {STRATEGIES})")
@@ -129,6 +180,10 @@ def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
         obs.counter("serve.requests")
         obs.counter("serve.rows", nrows)
 
+    def _info(out, degraded, reason=None):
+        return out + ({"degraded": degraded, "reason": reason},) \
+            if return_info else out
+
     U = np.asarray(U, dtype=np.float32)
     V = np.asarray(V, dtype=np.float32)
     Nu, r = U.shape
@@ -136,8 +191,8 @@ def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
     if Ni == 0 or Nu == 0:
         kk = min(k, Ni)
         _record(Nu)
-        return (np.zeros((Nu, kk), np.float32),
-                np.zeros((Nu, kk), np.int32))
+        return _info((np.zeros((Nu, kk), np.float32),
+                      np.zeros((Nu, kk), np.int32)), False)
     valid = (np.ones(Ni, dtype=bool) if item_valid is None
              else np.asarray(item_valid, dtype=bool))
     D = mesh.devices.size
@@ -157,18 +212,33 @@ def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
     from tpu_als.parallel.mesh import shard_leading
 
     spec = shard_leading(mesh)
-    with obs.span("serve.topk", strategy=strategy):
-        s, ix = f(jax.device_put(Up, spec), jax.device_put(Vp, spec),
-                  jax.device_put(validp, spec))
-        if jax.process_count() > 1:
-            # multi-process mesh: the result is a GLOBAL array whose
-            # shards live across hosts — np.asarray would fail on
-            # non-addressable shards.  Trim the query padding on device
-            # (every process executes the same op) and hand the global
-            # arrays back; the caller reads .addressable_shards for its
-            # own rows.
-            _record(Nu)
-            return s[:Nu], ix[:Nu]
-        out = np.asarray(s)[:Nu], np.asarray(ix)[:Nu]
+    multiproc = jax.process_count() > 1
+    try:
+        with obs.span("serve.topk", strategy=strategy):
+            # fault point: raise = failed gather collective; corrupt =
+            # a shard is stale/lost (nothing sane to execute against)
+            if faults.check("serve.gather") == "corrupt":
+                raise ServeShardLost("stale/lost factor shard")
+            s, ix = f(jax.device_put(Up, spec), jax.device_put(Vp, spec),
+                      jax.device_put(validp, spec))
+            if multiproc:
+                # multi-process mesh: the result is a GLOBAL array whose
+                # shards live across hosts — np.asarray would fail on
+                # non-addressable shards.  Trim the query padding on
+                # device (every process executes the same op) and hand
+                # the global arrays back; the caller reads
+                # .addressable_shards for its own rows.
+                _record(Nu)
+                return _info((s[:Nu], ix[:Nu]), False)
+            out = np.asarray(s)[:Nu], np.asarray(ix)[:Nu]
+    except (OSError, RuntimeError) as e:
+        if multiproc:
+            # every process must degrade identically for the fallback to
+            # be coherent; with no way to agree on that here, fail loud
+            raise
+        reason = f"{type(e).__name__}: {e}"
+        return _info(_serve_degraded(U, k, Nu, strategy, reason,
+                                     _record), True, reason)
+    _last_good = (V, valid)
     _record(Nu)
-    return out
+    return _info(out, False)

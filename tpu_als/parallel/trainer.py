@@ -30,10 +30,48 @@ from tpu_als.core.als import AlsConfig, init_factors, local_half_step
 from tpu_als.core.ratings import trainer_chunk
 from tpu_als.ops.solve import compute_yty
 from tpu_als.parallel.mesh import AXIS, shard_map
+from tpu_als.resilience import faults
+
+
+class FactorsCorrupt(RuntimeError):
+    """Non-finite factors detected after a collective step — the sharded
+    equivalent of a torn message (a bad DMA, a poisoned reduction).  ALS
+    cannot recover by iterating (NaN is a fixed point of the solve), so
+    the loop must stop and resume from the last checkpoint."""
 
 
 def _squeeze0(tree):
     return jax.tree.map(lambda x: x[0], tree)
+
+
+def _chaos_wrap_step(step):
+    """Host-level ``comm.ring_step`` fault wrapper.
+
+    Only installed when the point is ARMED (chaos runs): the disarmed
+    builder returns the raw jitted step, so traced jaxprs — and the
+    comm-audit byte models derived from them — are byte-identical to a
+    build without fault injection, and the steady-state hot loop carries
+    zero extra dispatch work.
+
+    raise mode surfaces :class:`~tpu_als.resilience.faults.InjectedFault`
+    before the step runs (a failed collective); corrupt mode poisons the
+    user factors with NaN after it, which the armed-path finiteness check
+    converts into the typed :class:`FactorsCorrupt`.
+    """
+    import jax.numpy as jnp
+
+    def chaos_step(U, V, *args):
+        mode = faults.check("comm.ring_step")
+        U, V = step(U, V, *args)
+        if mode == "corrupt":
+            U = U * jnp.float32(jnp.nan)
+        if not bool(jnp.isfinite(jnp.sum(U)) & jnp.isfinite(jnp.sum(V))):
+            raise FactorsCorrupt(
+                "non-finite factors after ring step — resume from the "
+                "last checkpoint")
+        return U, V
+
+    return chaos_step
 
 
 def _check_shard_containers(mesh, user_sharded, item_sharded):
@@ -174,7 +212,10 @@ def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig,
         out_specs=(P(AXIS), P(AXIS)),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0, 1))
+    jitted = jax.jit(sharded, donate_argnums=(0, 1))
+    if faults.armed("comm.ring_step"):
+        return _chaos_wrap_step(jitted)
+    return jitted
 
 
 def make_chunked_gather_step(mesh, user_sharded, item_sharded,

@@ -1,0 +1,56 @@
+"""Resilience subsystem: fault injection, retries, preemption safety.
+
+Three jax-free modules (importable before jax, usable from bench.py's
+pre-probe phase):
+
+- :mod:`tpu_als.resilience.faults` — deterministic fault-injection
+  harness behind the ``TPU_ALS_FAULT_SPEC`` env var; the named fault
+  points every chaos test drives.
+- :mod:`tpu_als.resilience.retry` — the one retry/backoff policy
+  implementation (jittered exponential, per-attempt timeout, budget)
+  used by multihost init, checkpoint I/O, stream ingest and bench.py.
+- :mod:`tpu_als.resilience.preempt` — SIGTERM/SIGINT → graceful
+  checkpoint-and-exit (:data:`EXIT_PREEMPTED`) for spot/preemptible
+  capacity.
+
+Degraded-mode serving lives in :mod:`tpu_als.parallel.serve` (it needs
+jax) but its typed error is re-exported here for one-stop handling.
+
+See docs/resilience.md for the operator-facing story.
+"""
+
+from tpu_als.resilience.faults import (
+    ENV_VAR as FAULT_SPEC_ENV,
+    FAULT_POINTS,
+    FaultSpecError,
+    InjectedFault,
+)
+from tpu_als.resilience import faults
+from tpu_als.resilience.preempt import (
+    EXIT_PREEMPTED,
+    Preempted,
+    PreemptionGuard,
+)
+from tpu_als.resilience import preempt
+from tpu_als.resilience.retry import (
+    AttemptTimeout,
+    RetryExhausted,
+    RetryPolicy,
+    retry_call,
+)
+
+__all__ = [
+    "AttemptTimeout",
+    "EXIT_PREEMPTED",
+    "FAULT_POINTS",
+    "FAULT_SPEC_ENV",
+    "FaultSpecError",
+    "InjectedFault",
+    "Preempted",
+    "PreemptionGuard",
+    "RetryExhausted",
+    "RetryPolicy",
+    "faults",
+    "preempt",
+    "retry_call",
+]

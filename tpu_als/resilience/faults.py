@@ -1,0 +1,307 @@
+"""Deterministic fault injection — the testable half of fault tolerance.
+
+The reference stack's resilience story is exercised by Spark's own chaos
+suites (executor kills in ``local-cluster`` masters, SURVEY.md §5.3); our
+JAX port needs the same property: every failure path must be REACHABLE on
+demand, deterministically, so a test can assert recovery instead of
+hoping a flake exercises the handler.  This module is that switchboard.
+
+Named fault points (the complete vocabulary — sites call
+:func:`check` with one of these):
+
+========================  ====================================================
+``checkpoint.write``      inside ``io.checkpoint.save_factors``' write body,
+                          before the atomic install (corrupt = torn npz)
+``checkpoint.rename``     inside ``io.checkpoint.atomic_install``, in the
+                          window between the two renames (crash mid-swap)
+``ingest.read_chunk``     per chunk read in ``io.stream.stream_ingest``
+                          (corrupt = bit-flipped chunk bytes)
+``multihost.init``        inside ``parallel.multihost.init_distributed``'s
+                          rendezvous attempt
+``comm.ring_step``        per trainer iteration of the ring strategies
+                          (host-level, around the jitted step; corrupt =
+                          non-finite factors)
+``serve.gather``          inside ``parallel.serve.topk_sharded``'s sharded
+                          execute (corrupt = stale/lost factor shard)
+========================  ====================================================
+
+Spec grammar (``TPU_ALS_FAULT_SPEC`` env var, or :func:`install`)::
+
+    SPEC  ::= RULE (';' RULE)*
+    RULE  ::= POINT '=' MODE ('@' SCHED)?
+    MODE  ::= 'raise' | 'corrupt' | 'hang:' SECONDS
+    SCHED ::= 'once' | 'nth=' K | 'first=' N | 'every=' K
+            | 'prob=' P (',seed=' S)?
+
+Hit indices are 1-based per point.  ``once`` == ``nth=1`` (the default
+schedule).  ``prob`` draws from a dedicated ``random.Random(seed)`` per
+rule — the schedule is a pure function of (spec, hit index), never of
+wall clock or global RNG state, so a failing chaos run replays exactly.
+
+Modes at the site: ``raise`` raises :class:`InjectedFault` (an ``IOError``
+subclass, so the retry policies treat it as transient I/O); ``hang:S``
+sleeps S seconds then continues (a stall the caller's timeout must
+catch); ``corrupt`` returns ``"corrupt"`` from :func:`check` and the
+site applies its own corruption (torn file, flipped bytes, NaN factors).
+
+When no spec is installed, :func:`check` is a single attribute load and
+``None`` compare, and :func:`armed` lets trace-time call sites (the ring
+step builder) skip wrapping entirely — traced jaxprs are byte-identical
+to a build without this module.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+
+FAULT_POINTS = (
+    "checkpoint.write",
+    "checkpoint.rename",
+    "ingest.read_chunk",
+    "multihost.init",
+    "comm.ring_step",
+    "serve.gather",
+)
+
+MODES = ("raise", "corrupt", "hang")
+
+ENV_VAR = "TPU_ALS_FAULT_SPEC"
+
+
+class InjectedFault(IOError):
+    """Raised by an armed ``raise``-mode fault point.
+
+    Subclasses ``IOError`` deliberately: the injected failure stands in
+    for a transient I/O / RPC error, so the retry policies
+    (tpu_als.resilience.retry) classify it as retryable without a
+    special case at every call site."""
+
+    def __init__(self, point, hit):
+        super().__init__(
+            f"injected fault at {point!r} (hit {hit}) — "
+            f"{ENV_VAR} / tpu_als.resilience.faults.install")
+        self.point = point
+        self.hit = hit
+
+
+class FaultSpecError(ValueError):
+    """A malformed ``TPU_ALS_FAULT_SPEC`` string."""
+
+
+class _Rule:
+    __slots__ = ("point", "mode", "hang_seconds", "sched", "k",
+                 "prob", "_rng", "hits", "fired")
+
+    def __init__(self, point, mode, hang_seconds, sched, k, prob, seed):
+        self.point = point
+        self.mode = mode
+        self.hang_seconds = hang_seconds
+        self.sched = sched
+        self.k = k
+        self.prob = prob
+        self._rng = random.Random(seed) if sched == "prob" else None
+        self.hits = 0      # times the point was reached
+        self.fired = 0     # times the fault actually triggered
+
+    def due(self):
+        """Advance the hit counter and decide whether this hit fires."""
+        self.hits += 1
+        if self.sched == "nth":
+            hit = self.hits == self.k
+        elif self.sched == "first":
+            hit = self.hits <= self.k
+        elif self.sched == "every":
+            hit = self.hits % self.k == 0
+        else:  # prob
+            hit = self._rng.random() < self.prob
+        if hit:
+            self.fired += 1
+        return hit
+
+
+def _parse_rule(text):
+    text = text.strip()
+    point, sep, rest = text.partition("=")
+    point = point.strip()
+    if not sep or not rest:
+        raise FaultSpecError(
+            f"fault rule {text!r} is not POINT=MODE[@SCHED]")
+    if point not in FAULT_POINTS:
+        raise FaultSpecError(
+            f"unknown fault point {point!r} (known: {list(FAULT_POINTS)})")
+    mode_part, _, sched_part = rest.partition("@")
+    mode_part = mode_part.strip()
+    hang_seconds = 0.0
+    if mode_part.startswith("hang:"):
+        mode = "hang"
+        try:
+            hang_seconds = float(mode_part[len("hang:"):])
+        except ValueError:
+            raise FaultSpecError(
+                f"hang mode needs 'hang:SECONDS', got {mode_part!r}")
+        if hang_seconds < 0:
+            raise FaultSpecError("hang seconds must be >= 0")
+    elif mode_part in ("raise", "corrupt"):
+        mode = mode_part
+    else:
+        raise FaultSpecError(
+            f"unknown fault mode {mode_part!r} (known: raise, corrupt, "
+            "hang:SECONDS)")
+    sched, k, prob, seed = "nth", 1, 0.0, 0
+    sched_part = sched_part.strip()
+    if sched_part and sched_part != "once":
+        key, _, val = sched_part.partition("=")
+        key = key.strip()
+        if key in ("nth", "first", "every"):
+            sched = key
+            try:
+                k = int(val)
+            except ValueError:
+                raise FaultSpecError(
+                    f"schedule {sched_part!r}: K must be an integer")
+            if k < 1:
+                raise FaultSpecError(f"schedule {sched_part!r}: K must "
+                                     "be >= 1")
+        elif key == "prob":
+            sched = "prob"
+            body, _, seed_part = val.partition(",")
+            try:
+                prob = float(body)
+            except ValueError:
+                raise FaultSpecError(
+                    f"schedule {sched_part!r}: P must be a float")
+            if not 0.0 <= prob <= 1.0:
+                raise FaultSpecError("prob must be in [0, 1]")
+            if seed_part:
+                skey, _, sval = seed_part.partition("=")
+                if skey.strip() != "seed":
+                    raise FaultSpecError(
+                        f"schedule {sched_part!r}: expected ',seed=S'")
+                try:
+                    seed = int(sval)
+                except ValueError:
+                    raise FaultSpecError(
+                        f"schedule {sched_part!r}: seed must be an "
+                        "integer")
+        else:
+            raise FaultSpecError(
+                f"unknown schedule {sched_part!r} (known: once, nth=K, "
+                "first=N, every=K, prob=P[,seed=S])")
+    return _Rule(point, mode, hang_seconds, sched, k, prob, seed)
+
+
+def parse_spec(spec):
+    """Parse a spec string into ``{point: _Rule}``; raises
+    :class:`FaultSpecError` on any malformed rule."""
+    rules = {}
+    for part in spec.split(";"):
+        if not part.strip():
+            continue
+        rule = _parse_rule(part)
+        if rule.point in rules:
+            raise FaultSpecError(
+                f"fault point {rule.point!r} appears twice in the spec")
+        rules[rule.point] = rule
+    if not rules:
+        raise FaultSpecError(f"empty fault spec {spec!r}")
+    return rules
+
+
+# the installed rule table; None = disarmed (the common case — check()
+# is then one load + compare).  A lock guards install/clear vs readers
+# on other threads (FoldInServer, timeout threads); the armed fast path
+# reads one reference without taking it.
+_rules = None
+_lock = threading.Lock()
+
+
+def install(spec):
+    """Arm the harness: ``spec`` is a grammar string or a pre-parsed
+    ``{point: _Rule}``.  Replaces any previous installation."""
+    global _rules
+    rules = parse_spec(spec) if isinstance(spec, str) else dict(spec)
+    with _lock:
+        _rules = rules
+    return rules
+
+
+def install_from_env(environ=None):
+    """Arm from ``TPU_ALS_FAULT_SPEC`` if set; no-op (and disarm) when
+    unset.  Called once at import, callable again by tests."""
+    spec = (environ if environ is not None else os.environ).get(ENV_VAR)
+    if spec:
+        return install(spec)
+    clear()
+    return None
+
+
+def clear():
+    """Disarm every fault point."""
+    global _rules
+    with _lock:
+        _rules = None
+
+
+def active():
+    """True when any fault point is armed."""
+    return _rules is not None
+
+
+def armed(point):
+    """True when ``point`` specifically is armed — trace-time call sites
+    use this to skip wrapping jitted code entirely when disarmed."""
+    r = _rules
+    return r is not None and point in r
+
+
+def hits(point):
+    """(times reached, times fired) for an armed point; (0, 0) when
+    disarmed."""
+    r = _rules
+    if r is None or point not in r:
+        return (0, 0)
+    rule = r[point]
+    return (rule.hits, rule.fired)
+
+
+def check(point):
+    """The fault point itself.  Returns ``None`` (continue normally) or
+    ``"corrupt"`` (the caller must corrupt its artifact); raises
+    :class:`InjectedFault` for raise mode; sleeps for hang mode.
+
+    Disarmed cost: one module-attribute load and an ``is None`` test.
+    """
+    r = _rules
+    if r is None:
+        return None
+    rule = r.get(point)
+    if rule is None or not rule.due():
+        return None
+    _emit_fired(rule)
+    if rule.mode == "raise":
+        raise InjectedFault(point, rule.hits)
+    if rule.mode == "hang":
+        time.sleep(rule.hang_seconds)
+        return None
+    return "corrupt"
+
+
+def _emit_fired(rule):
+    """One ``fault_injected`` obs event per firing — but only when the
+    obs module is already loaded (this module must stay importable from
+    jax-free contexts like bench.py's pre-probe phase)."""
+    obs = sys.modules.get("tpu_als.obs")
+    if obs is None:
+        return
+    try:
+        obs.emit("fault_injected", point=rule.point, mode=rule.mode,
+                 hit=rule.hits)
+    except Exception:
+        pass  # chaos instrumentation must never mask the chaos itself
+
+
+install_from_env()

@@ -1,0 +1,156 @@
+"""Preemption-safe training: catch SIGTERM, checkpoint, exit cleanly.
+
+TPU VMs (and any spot/preemptible capacity) get a SIGTERM with a short
+grace window before the machine disappears.  The reference stack
+survives this because a killed Spark executor's work is recomputed from
+RDD lineage (SURVEY.md §5.3); we have no lineage, so the contract is:
+finish the in-flight iteration, write an atomic checkpoint, and exit
+with :data:`EXIT_PREEMPTED` so the orchestrator knows to reschedule
+with ``--resume auto`` rather than report a failure.
+
+The guard only *records* the signal; the trainer's per-iteration
+callback polls :func:`pending` at iteration boundaries (factors are
+only consistent between iterations — mid-step the donated buffers are
+in flux).  Resume is bitwise-exact because the checkpoint carries the
+iteration index and factors, and ALS iterations are deterministic given
+those.
+
+``TPU_ALS_PREEMPT_AT=N`` makes :func:`pending` fire at iteration N
+without any signal — deterministic "preemption" for tests where real
+kill timing races a fast CPU run.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+# distinct from generic failure (1) and the crash-test's os._exit(42)
+EXIT_PREEMPTED = 43
+
+ENV_PREEMPT_AT = "TPU_ALS_PREEMPT_AT"
+
+
+class Preempted(SystemExit):
+    """Raised (by the trainer callback) after the preemption checkpoint
+    is safely on disk.  Subclasses SystemExit with code
+    :data:`EXIT_PREEMPTED` so an unhandled escape still exits with the
+    right status; ``checkpoint_path`` tells the handler where the
+    resumable state landed (None if no checkpoint dir was configured)."""
+
+    def __init__(self, iteration, checkpoint_path=None, signum=None):
+        super().__init__(EXIT_PREEMPTED)
+        self.iteration = iteration
+        self.checkpoint_path = checkpoint_path
+        self.signum = signum
+
+    def __str__(self):
+        where = self.checkpoint_path or "<no checkpoint dir>"
+        return (f"preempted at iteration {self.iteration}; "
+                f"state at {where}")
+
+
+class PreemptionGuard:
+    """Context manager that converts SIGTERM/SIGINT into a flag.
+
+    Signal handlers can only be installed from the main thread; on any
+    other thread (FoldInServer workers, test runners) the guard degrades
+    to the ``TPU_ALS_PREEMPT_AT`` env knob only.  Handlers are restored
+    on exit.  A second signal while the flag is already set re-raises
+    the default behavior (the user pressing Ctrl-C twice really wants
+    out *now*).
+    """
+
+    _active = None  # the currently installed guard, for pending()
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._flag = threading.Event()
+        self._signum = None
+        self._saved = {}
+        self._installed = False
+
+    # -- signal plumbing -------------------------------------------------
+    def _handler(self, signum, frame):
+        if self._flag.is_set():
+            # second signal: restore defaults and let it kill us
+            self._restore()
+            signal.raise_signal(signum)
+            return
+        self._signum = signum
+        self._flag.set()
+
+    def _restore(self):
+        for s, old in self._saved.items():
+            try:
+                signal.signal(s, old)
+            except (ValueError, OSError):
+                pass
+        self._saved.clear()
+        self._installed = False
+
+    def __enter__(self):
+        if threading.current_thread() is threading.main_thread():
+            for s in self.signals:
+                self._saved[s] = signal.signal(s, self._handler)
+            self._installed = True
+        PreemptionGuard._active = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._installed:
+            self._restore()
+        if PreemptionGuard._active is self:
+            PreemptionGuard._active = None
+        return False
+
+    # -- queries ---------------------------------------------------------
+    @property
+    def signum(self):
+        return self._signum
+
+    def triggered(self):
+        """True once a signal has been observed."""
+        return self._flag.is_set()
+
+    def trigger(self, signum=signal.SIGTERM):
+        """Programmatic preemption (tests, simulated orchestrators)."""
+        self._signum = signum
+        self._flag.set()
+
+
+def installed():
+    """The active :class:`PreemptionGuard`, or None."""
+    return PreemptionGuard._active
+
+
+def enabled():
+    """True when preemption handling is in play at all — a guard is
+    installed or the deterministic test knob is set.  Trainers use this
+    to decide whether their loop needs a preemption-aware callback."""
+    return (PreemptionGuard._active is not None
+            or bool(os.environ.get(ENV_PREEMPT_AT)))
+
+
+def pending(iteration=None):
+    """Should training stop at this iteration boundary?
+
+    True when the active guard has observed a signal, or when
+    ``TPU_ALS_PREEMPT_AT`` equals ``iteration`` (the deterministic test
+    knob).  Cheap enough to poll every iteration.
+    """
+    g = PreemptionGuard._active
+    if g is not None and g.triggered():
+        return True
+    if iteration is not None:
+        at = os.environ.get(ENV_PREEMPT_AT)
+        if at:
+            try:
+                if int(at) == iteration:
+                    if g is not None:
+                        g.trigger()
+                    return True
+            except ValueError:
+                pass
+    return False

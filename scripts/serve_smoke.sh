@@ -1,0 +1,71 @@
+#!/bin/bash
+# Serving smoke: the online-serving subsystem's CI gate, CPU-only (no
+# accelerator, no network).  Three stages, fail-fast:
+#
+#   1. the serving test tier — int8-index bitwise property sweep,
+#      admission queue, engine loop, serving fault points, and the
+#      topk validity mask (tests/test_serving.py + the topk/sharded
+#      companions),
+#   2. the static obs-schema check (the serving.* metric vocabulary
+#      and the serving_publish event must stay declared),
+#   3. one END-TO-END open-loop serve-bench: 5 seconds of synthetic
+#      load on CPU against a loose SLO, the result banked with
+#      banked_at provenance and sanity-checked (non-empty histograms,
+#      SLO met, nothing shed).
+#
+# Usage: scripts/serve_smoke.sh   (from the repo root; ~1 min on CPU)
+set -u
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+fail=0
+
+echo "== serve smoke 1/3: serving test tier =="
+python -m pytest tests/test_serving.py tests/test_serve_sharded.py \
+    tests/test_topk_foldin.py -q -m 'not slow' -p no:cacheprovider || fail=1
+
+echo "== serve smoke 2/3: obs schema (static) =="
+python scripts/check_obs_schema.py || fail=1
+
+echo "== serve smoke 3/3: end-to-end open-loop serve-bench =="
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+python -m tpu_als.cli serve-bench \
+    --users 2000 --items 5000 --rank 32 --k 10 --shortlist-k 64 \
+    --qps 100 --duration 5 --slo-ms 2000 --max-wait-ms 2 \
+    --bench-json "$work/BENCH_serve_smoke.json" \
+    >"$work/serve.out" 2>"$work/serve.log"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "FAIL: serve-bench exited $rc" >&2
+    tail -5 "$work/serve.log" >&2
+    fail=1
+else
+    python - "$work/BENCH_serve_smoke.json" <<'EOF' || fail=1
+import json, sys
+
+r = json.load(open(sys.argv[1]))
+problems = []
+if r["metric"] != "serve_e2e_p99_ms":
+    problems.append(f"unexpected metric {r['metric']!r}")
+if not r["scored"]:
+    problems.append("no request completed (empty latency histograms)")
+if not r["slo_met"]:
+    problems.append(f"p99 {r['value']}ms blew the loose {r['slo_ms']}ms SLO")
+if r["shed_rate"] > 0:
+    problems.append(f"shed {r['shed_rate']:.1%} at 100 rps on CPU")
+if "banked_at" not in r or "+00:00" not in r["banked_at"]:
+    problems.append("missing/naive banked_at provenance stamp")
+for p in problems:
+    print(f"FAIL: serve-bench result: {p}", file=sys.stderr)
+print(f"serve-bench: p50={r['p50_ms']}ms p99={r['value']}ms "
+      f"scored={r['scored']} (SLO {r['slo_ms']}ms)")
+sys.exit(1 if problems else 0)
+EOF
+fi
+
+if [ "$fail" -ne 0 ]; then
+    echo "serve smoke: FAIL" >&2
+    exit 1
+fi
+echo "serve smoke: OK"

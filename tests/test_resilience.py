@@ -497,6 +497,42 @@ def test_serve_recovers_after_fault_clears(rng):
     np.testing.assert_array_equal(s2, s0)
 
 
+def test_last_good_cache_keyed_by_mesh(rng):
+    """Two meshes in one process (a pod host serving two slices) must
+    never answer from each other's cached catalog: priming mesh A leaves
+    mesh B with nothing to degrade onto."""
+    import jax
+
+    serve, U, V, _ = _serve_setup(rng)
+    from tpu_als.parallel.mesh import make_mesh
+
+    mesh_a = make_mesh(devices=jax.devices()[:4])
+    mesh_b = make_mesh(devices=jax.devices()[4:8])
+    s0, _ = serve.topk_sharded(U, V, 5, mesh_a)    # primes A only
+    faults.install("serve.gather=raise@first=2")
+    with pytest.raises(serve.ServeShardLost):      # B has no last-good
+        serve.topk_sharded(U, V, 5, mesh_b)
+    s1, _, info = serve.topk_sharded(U, V, 5, mesh_a, return_info=True)
+    assert info["degraded"]                        # A degrades onto A's
+    np.testing.assert_allclose(s1, s0, atol=1e-5)
+
+
+def test_last_good_cache_keyed_by_strategy(rng):
+    """A catalog served via all_gather must not back a degraded ring
+    answer — the strategies' tie-breaking differs, and a mixed cache
+    would silently change results across the failover."""
+    serve, U, V, mesh = _serve_setup(rng)
+    serve.topk_sharded(U, V, 5, mesh, strategy="all_gather")
+    faults.install("serve.gather=raise@nth=1")
+    with pytest.raises(serve.ServeShardLost):
+        serve.topk_sharded(U, V, 5, mesh, strategy="ring")
+
+
+# ---------------------------------------------------------------------------
+# fault points: serving.publish / serving.score live with the engine
+# tests in tests/test_serving.py (the serving subsystem owns them)
+
+
 # ---------------------------------------------------------------------------
 # bench.py rides the same retry implementation
 
@@ -627,6 +663,19 @@ def test_chaos_matrix(point, mode, rng, tmp_path):
         elif point == "comm.ring_step":
             step, args = _ring_step_inputs(rng, spec)
             step(*args)
+        elif point in ("serving.publish", "serving.score"):
+            # raise -> InjectedFault out of publish/serve_batch;
+            # corrupt -> stale-index detection + exact-path fallback
+            # (the request is still answered — recovery, not an error)
+            from tpu_als.serving import ServingEngine
+
+            eng = ServingEngine(k=3, buckets=(8,), max_wait_s=0.0)
+            faults.install(spec)
+            eng.publish(rng.normal(size=(6, 3)).astype(np.float32),
+                        rng.normal(size=(12, 3)).astype(np.float32))
+            t = eng.submit(0)
+            eng.serve_batch(eng.batcher.next_batch(timeout=1.0))
+            t.result(timeout=1.0)
         else:  # serve.gather
             serve.reset_last_good()
             U = rng.normal(size=(8, 3)).astype(np.float32)

@@ -1,0 +1,417 @@
+"""Online serving subsystem tests (tpu_als/serving/).
+
+Three layers: the int8 candidate index's bitwise-equality contract
+against the exact kernel (property sweep over shapes, validity masks,
+and adversarial duplicate-score inputs), the micro-batching admission
+queue (bucketing, shedding, deadlines), and the engine loop
+(publish/swap, stale-index fallback, fault points, the serve-bench
+CLI).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_als import obs
+from tpu_als.ops.topk import NEG_INF, chunked_topk_scores, topk_validity
+from tpu_als.resilience import faults
+from tpu_als.resilience.faults import InjectedFault
+from tpu_als.serving import (
+    DeadlineExceeded,
+    Int8CandidateIndex,
+    MicroBatcher,
+    NoModelPublished,
+    Overloaded,
+    ServingEngine,
+    bucket_for,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    """Disarmed faults + a fresh metrics registry per test (counters
+    are asserted exactly)."""
+    faults.clear()
+    reg = obs.reset()
+    yield reg
+    faults.clear()
+
+
+def _exact(U, V, valid, k):
+    s, ix = chunked_topk_scores(jnp.asarray(U), jnp.asarray(V),
+                                jnp.asarray(valid), k)
+    return np.asarray(s), np.asarray(ix)
+
+
+def _assert_matches_exact(s, ix, ref_s, ref_ix):
+    """The index contract: scores bitwise equal; indices equal on rows
+    whose scores are unique (ties may legitimately resolve differently);
+    on tied rows every returned index must still earn its score."""
+    s, ix = np.asarray(s), np.asarray(ix)
+    np.testing.assert_array_equal(s, ref_s)
+    for row in range(s.shape[0]):
+        real = topk_validity(s[row])
+        if len(np.unique(s[row][real])) == real.sum():
+            np.testing.assert_array_equal(ix[row][real],
+                                          ref_ix[row][real])
+
+
+# ---------------------------------------------------------------------------
+# int8 index + exact rescore == exact kernel (the acceptance property)
+
+
+@pytest.mark.parametrize("n,Ni,r,k,sk,seed", [
+    (1, 50, 4, 5, 20, 0),
+    (13, 257, 24, 10, 40, 1),
+    (33, 1000, 64, 10, 64, 2),
+    (8, 96, 8, 8, 96, 3),       # shortlist == catalog: unconditional
+    (5, 7, 3, 7, 7, 4),         # k == catalog size
+])
+def test_int8_rescore_matches_exact_random(n, Ni, r, k, sk, seed):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(n, r)).astype(np.float32)
+    V = rng.normal(size=(Ni, r)).astype(np.float32)
+    valid = np.ones(Ni, bool)
+    idx = Int8CandidateIndex(V, valid, shortlist_k=sk)
+    s, ix = idx.topk(U, k)
+    _assert_matches_exact(s, ix, *_exact(U, V, valid, k))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_int8_rescore_matches_exact_duplicate_scores(seed):
+    # adversarial ties: the catalog is a few distinct rows repeated, so
+    # exact scores collide in whole groups; duplicates quantize
+    # identically, so the shortlist keeps enough of each group and the
+    # returned SCORES (with multiplicity) must still match bitwise
+    rng = np.random.default_rng(100 + seed)
+    base = rng.normal(size=(6, 8)).astype(np.float32)
+    V = base[rng.integers(0, 6, 120)]
+    U = np.concatenate([rng.normal(size=(5, 8)), base[:3]]).astype(
+        np.float32)
+    valid = np.ones(120, bool)
+    idx = Int8CandidateIndex(V, valid, shortlist_k=60)
+    k = 12
+    s, ix = idx.topk(U, k)
+    ref_s, ref_ix = _exact(U, V, valid, k)
+    np.testing.assert_array_equal(np.asarray(s), ref_s)
+    # tied indices may differ, but each must earn its claimed score
+    full = U.astype(np.float64) @ V.astype(np.float64).T
+    np.testing.assert_allclose(
+        np.take_along_axis(full, np.asarray(ix), axis=1), ref_s,
+        rtol=1e-5, atol=1e-5)
+
+
+def test_int8_rescore_sparse_validity(rng):
+    U = rng.normal(size=(9, 16)).astype(np.float32)
+    V = rng.normal(size=(200, 16)).astype(np.float32)
+    valid = rng.random(200) < 0.3
+    idx = Int8CandidateIndex(V, valid, shortlist_k=48)
+    s, ix = idx.topk(U, 8)
+    _assert_matches_exact(s, ix, *_exact(U, V, valid, 8))
+    assert valid[np.asarray(ix)[topk_validity(np.asarray(s))]].all()
+
+
+def test_int8_fewer_valid_than_k_leaves_sentinels(rng):
+    U = rng.normal(size=(4, 8)).astype(np.float32)
+    V = rng.normal(size=(50, 8)).astype(np.float32)
+    valid = np.zeros(50, bool)
+    valid[[7, 21, 40]] = True
+    idx = Int8CandidateIndex(V, valid, shortlist_k=10)
+    s, ix = idx.topk(U, 5)
+    ref_s, _ = _exact(U, V, valid, 5)
+    s = np.asarray(s)
+    np.testing.assert_array_equal(s, ref_s)        # incl. the sentinels
+    mask = topk_validity(s)
+    np.testing.assert_array_equal(mask, np.tile([True] * 3 + [False] * 2,
+                                                (4, 1)))
+    assert np.isin(np.asarray(ix)[mask], [7, 21, 40]).all()
+
+
+def test_int8_all_invalid_catalog(rng):
+    U = rng.normal(size=(3, 4)).astype(np.float32)
+    V = rng.normal(size=(20, 4)).astype(np.float32)
+    idx = Int8CandidateIndex(V, np.zeros(20, bool), shortlist_k=8)
+    s, _ = idx.topk(U, 4)
+    assert not topk_validity(np.asarray(s)).any()
+    np.testing.assert_array_equal(np.asarray(s),
+                                  np.full((3, 4), NEG_INF, np.float32))
+
+
+def test_int8_index_guards():
+    with pytest.raises(ValueError, match="empty catalog"):
+        Int8CandidateIndex(np.zeros((0, 4), np.float32))
+    idx = Int8CandidateIndex(np.ones((10, 4), np.float32), shortlist_k=4)
+    with pytest.raises(ValueError, match="exceeds shortlist_k"):
+        idx.topk(np.ones((2, 4), np.float32), 6)
+    # shortlist is capped by the catalog
+    assert Int8CandidateIndex(np.ones((5, 4), np.float32),
+                              shortlist_k=64).shortlist_k == 5
+
+
+# ---------------------------------------------------------------------------
+# admission queue
+
+
+def test_bucket_for():
+    assert bucket_for(1, (8, 32, 128)) == 8
+    assert bucket_for(8, (8, 32, 128)) == 8
+    assert bucket_for(9, (8, 32, 128)) == 32
+    assert bucket_for(128, (8, 32, 128)) == 128
+    with pytest.raises(ValueError, match="largest bucket"):
+        bucket_for(129, (8, 32, 128))
+
+
+def test_batcher_coalesces_and_stamps(_fresh):
+    b = MicroBatcher(buckets=(4, 8), max_wait_s=0.01)
+    tickets = [b.submit(i) for i in range(3)]
+    batch = b.next_batch(timeout=1.0)
+    assert [t.payload for t in batch] == [0, 1, 2]
+    assert all(t.t_dequeue is not None for t in batch)
+    assert b.depth() == 0
+    assert _fresh.histogram_count("serving.enqueue_seconds") == 3
+    assert tickets[0] is batch[0]
+
+
+def test_batcher_caps_dequeue_at_largest_bucket():
+    b = MicroBatcher(buckets=(2, 4), max_wait_s=0.0)
+    for i in range(6):
+        b.submit(i)
+    assert len(b.next_batch(timeout=1.0)) == 4
+    assert len(b.next_batch(timeout=1.0)) == 2
+
+
+def test_batcher_sheds_when_full(_fresh):
+    b = MicroBatcher(buckets=(8,), max_queue=2, max_wait_s=0.0)
+    b.submit(0)
+    b.submit(1)
+    with pytest.raises(Overloaded):
+        b.submit(2)
+    assert _fresh.snapshot()["counters"]["serving.shed"] == 1
+
+
+def test_batcher_timeout_returns_none():
+    b = MicroBatcher(max_wait_s=0.0)
+    assert b.next_batch(timeout=0.01) is None
+
+
+def test_batcher_close_drains_then_stops():
+    b = MicroBatcher(buckets=(8,), max_wait_s=0.0)
+    b.submit(0)
+    b.close()
+    assert len(b.next_batch(timeout=0.1)) == 1
+    assert b.next_batch(timeout=0.1) is None
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(1)
+
+
+def test_batcher_rejects_bad_buckets():
+    with pytest.raises(ValueError, match="sorted and unique"):
+        MicroBatcher(buckets=(32, 8))
+
+
+# ---------------------------------------------------------------------------
+# engine
+
+
+def _engine(rng, n=40, Ni=300, r=8, k=5, quantize=True, **kw):
+    eng = ServingEngine(k=k, buckets=(8, 32), shortlist_k=32,
+                        max_wait_s=0.0, **kw)
+    U = rng.normal(size=(n, r)).astype(np.float32)
+    V = rng.normal(size=(Ni, r)).astype(np.float32)
+    eng.publish(U, V, quantize=quantize)
+    return eng, U, V
+
+
+def _drain_one(eng):
+    """Pump one batch through the engine synchronously (no thread)."""
+    batch = eng.batcher.next_batch(timeout=1.0)
+    assert batch is not None
+    eng.serve_batch(batch)
+    return batch
+
+
+@pytest.mark.parametrize("quantize", [True, False])
+def test_engine_roundtrip_ids_and_foldin_rows(rng, quantize):
+    eng, U, V = _engine(rng, quantize=quantize)
+    valid = np.ones(V.shape[0], bool)
+    t_id = eng.submit(7)
+    t_row = eng.submit(U[3] * 0.5)       # a fold-in vector payload
+    _drain_one(eng)
+    queries = np.stack([U[7], U[3] * 0.5])
+    ref_s, ref_ix = _exact(queries, V, valid, eng.k)
+    for j, t in enumerate([t_id, t_row]):
+        s, ix = t.result(timeout=1.0)
+        np.testing.assert_allclose(s, ref_s[j], rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(ix, ref_ix[j])
+
+
+def test_engine_threaded_recommend(rng, _fresh):
+    eng, U, V = _engine(rng)
+    with eng:
+        s, ix = eng.recommend(11, timeout=5.0)
+    assert s.shape == (5,) and ix.shape == (5,)
+    ref_s, _ = _exact(U[11:12], V, np.ones(V.shape[0], bool), 5)
+    np.testing.assert_allclose(s, ref_s[0], rtol=1e-5, atol=1e-6)
+    snap = _fresh.snapshot()
+    assert snap["counters"]["serving.requests"] == 1
+    assert snap["histograms"]["serving.e2e_seconds"]["count"] == 1
+    assert snap["histograms"]['serving.score_seconds{path="int8"}'][
+        "count"] == 1
+
+
+def test_engine_per_request_k_trims(rng):
+    eng, _, _ = _engine(rng, k=8)
+    t = eng.submit(0, k=3)
+    _drain_one(eng)
+    s, ix = t.result(timeout=1.0)
+    assert s.shape == (3,) and ix.shape == (3,)
+
+
+def test_engine_submit_guards(rng):
+    eng = ServingEngine(k=5)
+    with pytest.raises(NoModelPublished):
+        eng.submit(0)
+    eng.publish(np.ones((4, 6), np.float32), np.ones((9, 6), np.float32))
+    with pytest.raises(ValueError, match="outside the published table"):
+        eng.submit(4)
+    with pytest.raises(ValueError, match="payload shape"):
+        eng.submit(np.ones(5, np.float32))
+    with pytest.raises(ValueError, match="per-request k"):
+        eng.submit(0, k=6)
+
+
+def test_engine_deadline_expires_in_queue(rng, _fresh):
+    eng, _, _ = _engine(rng)
+    t = eng.submit(0, deadline_s=0.0)
+    time.sleep(0.01)
+    _drain_one(eng)
+    with pytest.raises(DeadlineExceeded):
+        t.result(timeout=1.0)
+    assert _fresh.snapshot()["counters"]["serving.expired"] == 1
+
+
+def test_engine_publish_swaps_atomically(rng, _fresh):
+    eng, U, V = _engine(rng)
+    t1 = eng.submit(0)
+    _drain_one(eng)
+    V2 = V * -1.0                        # same shape: no recompile path
+    assert eng.publish(U, V2) == 2
+    t2 = eng.submit(0)
+    _drain_one(eng)
+    s1, _ = t1.result(timeout=1.0)
+    s2, _ = t2.result(timeout=1.0)
+    ref2, _ = _exact(U[:1], V2, np.ones(V.shape[0], bool), eng.k)
+    np.testing.assert_allclose(s2, ref2[0], rtol=1e-5, atol=1e-6)
+    assert not np.allclose(s1, s2)
+    snap = _fresh.snapshot()
+    assert snap["counters"]["serving.publishes"] == 2
+    seqs = [e["seq"] for e in _fresh._events
+            if e["type"] == "serving_publish"]
+    assert seqs == [1, 2]
+
+
+def test_engine_stale_index_falls_back_to_exact(rng, _fresh):
+    eng, U, V = _engine(rng, quantize=True)
+    V2 = rng.normal(size=V.shape).astype(np.float32)
+    eng.publish(U, V2, quantize=False)   # index carried but stale
+    t = eng.submit(2)
+    _drain_one(eng)
+    s, ix = t.result(timeout=1.0)
+    # served the NEW catalog on the exact path, not the stale index
+    ref_s, ref_ix = _exact(U[2:3], V2, np.ones(V.shape[0], bool), eng.k)
+    np.testing.assert_allclose(s, ref_s[0], rtol=1e-5, atol=1e-6)
+    snap = _fresh.snapshot()
+    assert snap["counters"]["serving.fallback_exact"] == 1
+    assert snap["histograms"]['serving.score_seconds{path="exact"}'][
+        "count"] == 1
+
+
+def test_engine_publish_corrupt_fault_marks_index_stale(rng, _fresh):
+    faults.install("serving.publish=corrupt@nth=1")
+    eng, U, V = _engine(rng, quantize=True)
+    t = eng.submit(1)
+    _drain_one(eng)
+    s, _ = t.result(timeout=1.0)
+    ref_s, _ = _exact(U[1:2], V, np.ones(V.shape[0], bool), eng.k)
+    np.testing.assert_allclose(s, ref_s[0], rtol=1e-5, atol=1e-6)
+    assert _fresh.snapshot()["counters"]["serving.fallback_exact"] == 1
+
+
+def test_engine_score_corrupt_fault_forces_exact(rng, _fresh):
+    eng, U, V = _engine(rng, quantize=True)
+    faults.install("serving.score=corrupt@nth=1")
+    t = eng.submit(1)
+    _drain_one(eng)
+    t.result(timeout=1.0)
+    assert _fresh.snapshot()["counters"]["serving.fallback_exact"] == 1
+
+
+def test_engine_score_raise_fault_fails_waiting_callers(rng):
+    eng, _, _ = _engine(rng)
+    faults.install("serving.score=raise@nth=1")
+    with eng:
+        t = eng.submit(0)
+        with pytest.raises(InjectedFault):
+            t.result(timeout=5.0)
+        # the loop survives the fault: the next request is served
+        s, _ = eng.recommend(1, timeout=5.0)
+    assert s.shape == (5,)
+
+
+def test_engine_warmup_records_no_latency_samples(rng, _fresh):
+    eng, _, _ = _engine(rng)
+    eng.warmup()
+    snap = _fresh.snapshot()
+    assert "serving.score_seconds" not in str(snap["histograms"])
+    assert snap["histograms"].get("serving.e2e_seconds") is None
+
+
+def test_engine_small_catalog_skips_index(rng):
+    eng = ServingEngine(k=10, buckets=(8,), max_wait_s=0.0)
+    eng.publish(rng.normal(size=(4, 3)).astype(np.float32),
+                rng.normal(size=(6, 3)).astype(np.float32))
+    # catalog (6) < k (10): exact path, sentinel-padded like the kernel
+    t = eng.submit(0)
+    _drain_one(eng)
+    s, _ = t.result(timeout=1.0)
+    assert topk_validity(s).sum() == 6
+
+
+# ---------------------------------------------------------------------------
+# serve-bench CLI (the SLO report the acceptance criteria name)
+
+
+def test_serve_bench_cli_reports_from_histograms(tmp_path, capsys):
+    from tpu_als.cli import main
+
+    bank = tmp_path / "BENCH_serve_test.json"
+    main(["serve-bench", "--users", "300", "--items", "800",
+          "--rank", "8", "--k", "5", "--shortlist-k", "32",
+          "--qps", "400", "--duration", "0.25", "--slo-ms", "5000",
+          "--foldin-frac", "0.2", "--buckets", "8,32",
+          "--bench-json", str(bank)])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "serve_e2e_p99_ms"
+    assert out["value"] > 0 and out["p50_ms"] > 0
+    assert out["scored"] > 0
+    assert out["slo_met"] is True        # 5s SLO on a toy config
+    assert 0.0 <= out["shed_rate"] <= 1.0
+    banked = json.loads(bank.read_text())
+    assert banked["banked_by"] == "tpu_als serve-bench"
+    assert banked["banked_at"].endswith("+00:00")
+    assert banked["value"] == out["value"]
+
+
+def test_serve_bench_cli_exact_path(capsys):
+    from tpu_als.cli import main
+
+    main(["serve-bench", "--users", "100", "--items", "200",
+          "--rank", "4", "--qps", "300", "--duration", "0.1",
+          "--slo-ms", "5000", "--exact", "--buckets", "8"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["config"]["path"] == "exact"
+    assert out["scored"] > 0

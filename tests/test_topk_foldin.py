@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from tpu_als.core.als import AlsConfig, train
 from tpu_als.core.foldin import fold_in
 from tpu_als.core.ratings import build_csr_buckets
-from tpu_als.ops.topk import chunked_topk_scores
+from tpu_als.ops.topk import NEG_INF, chunked_topk_scores, topk_validity
 
 from conftest import make_ratings
 
@@ -44,6 +44,37 @@ def test_topk_scores_sorted_desc(rng):
     s, _ = chunked_topk_scores(jnp.array(U), jnp.array(V), jnp.ones(33, bool), k=7)
     s = np.asarray(s)
     assert np.all(np.diff(s, axis=1) <= 1e-6)
+
+
+def test_topk_validity_marks_sentinel_slots(rng):
+    """Fewer valid items than k: the surplus slots carry the NEG_INF
+    sentinel with meaningless indices — topk_validity is the contract
+    callers trim by before surfacing recommendations."""
+    U = rng.normal(size=(6, 4)).astype(np.float32)
+    V = rng.normal(size=(30, 4)).astype(np.float32)
+    valid = np.zeros(30, bool)
+    valid[[2, 11, 29]] = True
+    s, idx = chunked_topk_scores(jnp.array(U), jnp.array(V),
+                                 jnp.array(valid), k=5, item_chunk=8)
+    s, idx = np.asarray(s), np.asarray(idx)
+    mask = topk_validity(s)
+    np.testing.assert_array_equal(
+        mask, np.tile([True] * 3 + [False] * 2, (6, 1)))
+    np.testing.assert_array_equal(s[~mask],
+                                  np.full(12, NEG_INF, np.float32))
+    assert np.isin(idx[mask], [2, 11, 29]).all()
+
+
+def test_topk_validity_all_false_item_valid(rng):
+    """All-False validity (an empty catalog in disguise): every slot is
+    a sentinel and the mask says so — no row leaks a real-looking score."""
+    U = rng.normal(size=(3, 4)).astype(np.float32)
+    V = rng.normal(size=(10, 4)).astype(np.float32)
+    s, _ = chunked_topk_scores(jnp.array(U), jnp.array(V),
+                               jnp.zeros(10, bool), k=4)
+    s = np.asarray(s)
+    assert not topk_validity(s).any()
+    np.testing.assert_array_equal(s, np.full((3, 4), NEG_INF, np.float32))
 
 
 def _padded_rows(u_sel, u, i, r, width):

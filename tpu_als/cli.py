@@ -793,6 +793,116 @@ def cmd_foldin_bench(args):
     }))
 
 
+def cmd_serve_bench(args):
+    """Open-loop serving latency benchmark: synthetic factors, a fixed
+    request rate for a fixed window, p50/p99/shed-rate read back from
+    the obs histograms and judged against ``--slo-ms``.
+
+    Open-loop means arrivals are scheduled by the clock, not by
+    completions — the honest load model for online serving (a closed
+    loop self-throttles and hides queueing collapse).  Results can be
+    banked as ``BENCH_serve_*.json`` with the same ``banked_at``
+    provenance stamp bench.py uses (``--bench-json``).
+    """
+    import datetime as _dt
+    import time
+
+    from tpu_als import obs
+    from tpu_als.serving import Overloaded, ServingEngine
+
+    rng = np.random.default_rng(args.seed)
+    U = rng.normal(size=(args.users, args.rank)).astype(np.float32)
+    V = rng.normal(size=(args.items, args.rank)).astype(np.float32)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    engine = ServingEngine(
+        k=args.k, buckets=buckets, shortlist_k=args.shortlist_k,
+        max_queue=args.max_queue, max_wait_s=args.max_wait_ms / 1e3,
+        default_deadline_s=(args.deadline_ms / 1e3
+                            if args.deadline_ms else None))
+    engine.publish(U, V, quantize=not args.exact)
+    with obs.span("serve_bench.warmup"):
+        engine.warmup()
+    path = "exact" if args.exact else "int8"
+    n_req = max(1, int(args.qps * args.duration))
+    print(f"serve-bench: {n_req} requests at {args.qps:g} rps over "
+          f"{args.duration:g}s ({path} path, "
+          f"{args.items:,} items, rank {args.rank})", file=sys.stderr)
+    foldin_ids = rng.random(n_req) < args.foldin_frac
+    uids = rng.integers(0, args.users, n_req)
+    tickets, shed = [], 0
+    engine.start()
+    try:
+        t0 = time.perf_counter()
+        with obs.span("serve_bench.drive"):
+            for j in range(n_req):
+                target = t0 + j / args.qps
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                payload = (U[uids[j]] if foldin_ids[j]
+                           else int(uids[j]))
+                try:
+                    tickets.append(engine.submit(payload))
+                except Overloaded:
+                    shed += 1
+            for t in tickets:
+                try:
+                    t.result(timeout=max(5.0, 10 * args.slo_ms / 1e3))
+                except Exception:
+                    pass   # expired/failed requests are counted below
+    finally:
+        engine.stop()
+
+    p50 = obs.histogram_quantile("serving.e2e_seconds", 0.5)
+    p99 = obs.histogram_quantile("serving.e2e_seconds", 0.99)
+    scored = obs.histogram_count("serving.e2e_seconds")
+    admitted = obs.counter_value("serving.requests")
+    shed_obs = obs.counter_value("serving.shed")
+    expired = obs.counter_value("serving.expired")
+    attempted = admitted + shed_obs
+    if scored == 0:
+        raise SystemExit("serve-bench: no request completed — the "
+                         "latency histograms are empty")
+    assert shed == shed_obs, (shed, shed_obs)  # driver and obs agree
+    result = {
+        "metric": "serve_e2e_p99_ms",
+        "value": round(p99 * 1e3, 3),
+        "unit": "ms",
+        "slo_ms": args.slo_ms,
+        "slo_met": bool(p99 * 1e3 <= args.slo_ms),
+        "p50_ms": round(p50 * 1e3, 3),
+        "shed_rate": round(shed_obs / attempted, 4) if attempted else 0.0,
+        "expired": int(expired),
+        "scored": int(scored),
+        "queue_wait_p99_ms": round(
+            obs.histogram_quantile("serving.enqueue_seconds", 0.99) * 1e3,
+            3),
+        "config": {
+            "path": path, "users": args.users, "items": args.items,
+            "rank": args.rank, "k": args.k,
+            "shortlist_k": args.shortlist_k, "qps": args.qps,
+            "duration_s": args.duration, "buckets": list(buckets),
+            "max_queue": args.max_queue, "max_wait_ms": args.max_wait_ms,
+            "deadline_ms": args.deadline_ms,
+            "foldin_frac": args.foldin_frac,
+        },
+    }
+    print(json.dumps(result))
+    if args.bench_json:
+        # same provenance contract as bench.py's banked variants: an
+        # absolute UTC stamp, never a relative phrase
+        with open(args.bench_json, "w") as f:
+            json.dump({
+                **result,
+                "banked_by": "tpu_als serve-bench",
+                "banked_at": _dt.datetime.now(
+                    _dt.timezone.utc).isoformat(timespec="seconds"),
+            }, f, indent=2)
+            f.write("\n")
+        print(f"result banked to {args.bench_json}", file=sys.stderr)
+    return result
+
+
 def cmd_tt_train(args):
     """Train the two-tower retrieval model (BASELINE config 5) from a
     ratings file: ALS warm start (unless --cold), filtered-recall holdout
@@ -1036,6 +1146,48 @@ def main(argv=None):
     tt.add_argument("--k", type=int, default=10)
     tt.add_argument("--seed", type=int, default=0)
     tt.set_defaults(fn=cmd_tt_train)
+
+    sb = sub.add_parser(
+        "serve-bench",
+        help="open-loop serving latency benchmark against an SLO "
+             "(micro-batched engine, int8 index unless --exact)",
+        parents=[obs_common])
+    sb.add_argument("--users", type=int, default=20_000)
+    sb.add_argument("--items", type=int, default=50_000)
+    sb.add_argument("--rank", type=int, default=64)
+    sb.add_argument("--k", type=int, default=10)
+    sb.add_argument("--shortlist-k", type=int, default=64,
+                    help="int8 shortlist rescored exactly in f32 "
+                         "(>= items makes the match unconditional)")
+    sb.add_argument("--exact", action="store_true",
+                    help="skip the int8 index; score every request on "
+                         "the exact chunked kernel")
+    sb.add_argument("--qps", type=float, default=200.0,
+                    help="open-loop arrival rate (requests/second)")
+    sb.add_argument("--duration", type=float, default=5.0,
+                    help="measured window in seconds")
+    sb.add_argument("--slo-ms", type=float, default=50.0,
+                    help="end-to-end p99 target the report is judged "
+                         "against")
+    sb.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request deadline; requests that exceed it "
+                         "while queued fail instead of being scored")
+    sb.add_argument("--max-queue", type=int, default=1024,
+                    help="admission-queue depth beyond which requests "
+                         "are shed (typed Overloaded)")
+    sb.add_argument("--max-wait-ms", type=float, default=2.0,
+                    help="micro-batch coalescing window")
+    sb.add_argument("--buckets", default="8,32,128",
+                    help="comma-separated padded batch sizes (one "
+                         "compiled program each)")
+    sb.add_argument("--foldin-frac", type=float, default=0.0,
+                    help="fraction of requests carrying a fold-in "
+                         "factor row instead of a user id")
+    sb.add_argument("--seed", type=int, default=0)
+    sb.add_argument("--bench-json", default=None, metavar="PATH",
+                    help="also bank the result JSON (with banked_at "
+                         "provenance) here, e.g. BENCH_serve_cpu.json")
+    sb.set_defaults(fn=cmd_serve_bench)
 
     f = sub.add_parser("foldin-bench", help="fold-in latency micro-benchmark",
                        parents=[obs_common])

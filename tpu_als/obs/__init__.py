@@ -54,6 +54,18 @@ def histogram(name, value, **labels):
     _default.histogram(name, value, **labels)
 
 
+def histogram_quantile(name, q, **labels):
+    return _default.histogram_quantile(name, q, **labels)
+
+
+def histogram_count(name, **labels):
+    return _default.histogram_count(name, **labels)
+
+
+def counter_value(name, **labels):
+    return _default.counter_value(name, **labels)
+
+
 def emit(etype, **fields):
     return _default.emit(etype, **fields)
 

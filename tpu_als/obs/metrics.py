@@ -151,6 +151,26 @@ class MetricsRegistry:
                 h = self._hists[key] = _Hist()
             h.observe(float(value))
 
+    def histogram_quantile(self, name, q, **labels):
+        """Bucketed quantile estimate of a recorded histogram series
+        (exact label match; NaN when the series has no observations).
+        What ``serve-bench`` reads its p50/p99 from."""
+        key = (name, _labels_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+        return h.quantile(q) if h is not None else float("nan")
+
+    def histogram_count(self, name, **labels):
+        key = (name, _labels_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+        return h.count if h is not None else 0
+
+    def counter_value(self, name, **labels):
+        key = (name, _labels_key(labels))
+        with self._lock:
+            return self._counters.get(key, 0)
+
     def emit(self, etype, **fields):
         """Append one event; returns the event dict (with its ts)."""
         schema.check_event(etype, fields)

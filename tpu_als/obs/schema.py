@@ -57,6 +57,41 @@ METRICS = {
         "counter", "requests",
         "top-k requests answered from last-good factors because the "
         "sharded gather failed (parallel.serve degraded mode)"),
+    "serving.enqueue_seconds": (
+        "histogram", "seconds",
+        "time a request waited in the admission queue "
+        "(serving.batcher: enqueue -> dequeue)"),
+    "serving.score_seconds": (
+        "histogram", "seconds",
+        "device scoring time per serving micro-batch, labeled "
+        "path=int8|exact"),
+    "serving.e2e_seconds": (
+        "histogram", "seconds",
+        "end-to-end serving request latency (submit -> completion)"),
+    "serving.batch_rows": (
+        "histogram", "rows",
+        "real (unpadded) requests per dequeued serving micro-batch — "
+        "shows bucket fill under the offered load"),
+    "serving.queue_depth": (
+        "gauge", "requests",
+        "admission-queue backlog sampled after each batch dequeue"),
+    "serving.requests": (
+        "counter", "requests", "requests admitted by the serving engine"),
+    "serving.shed": (
+        "counter", "requests",
+        "requests refused at admission (queue at capacity; the typed "
+        "Overloaded the caller sees)"),
+    "serving.expired": (
+        "counter", "requests",
+        "requests whose deadline passed while queued (failed with "
+        "DeadlineExceeded instead of being scored)"),
+    "serving.fallback_exact": (
+        "counter", "requests",
+        "requests scored on the exact path because the int8 index was "
+        "stale (publish without requantize, or injected staleness)"),
+    "serving.publishes": (
+        "counter", "publishes",
+        "model generations atomically swapped into the serving engine"),
 }
 
 # event type -> (required fields beyond ts/type, help text).  Extra
@@ -101,6 +136,10 @@ EVENTS = {
         ("point", "mode", "hit"),
         "a resilience.faults fault point fired (chaos testing only; "
         "never emitted when TPU_ALS_FAULT_SPEC is unset)"),
+    "serving_publish": (
+        ("seq", "items", "quantized"),
+        "one per ServingEngine.publish: the generation sequence number, "
+        "catalog size, and whether an int8 index was built for it"),
     "serve_degraded": (
         ("strategy", "reason"),
         "a sharded top-k request fell back to last-good gathered "

@@ -20,12 +20,32 @@ import jax.numpy as jnp
 NEG_INF = -3.4e38
 
 
+def topk_validity(scores):
+    """Bool mask of the slots in a top-k result that hold a REAL score.
+
+    When fewer than ``k`` items are valid (sparse ``item_valid``, a
+    catalog smaller than ``k``, or all-False validity), the surplus
+    slots carry the ``NEG_INF`` sentinel with arbitrary indices —
+    callers must trim with this mask before surfacing results.  Works
+    on the output of :func:`chunked_topk_scores`, the sharded
+    ``parallel.serve.topk_sharded``, and the int8 index
+    (``serving.index``): all three fill invalid slots with the same
+    sentinel constant.
+    """
+    return scores > NEG_INF
+
+
 @functools.partial(jax.jit, static_argnames=("k", "item_chunk"))
 def chunked_topk_scores(U, V, item_valid, k, item_chunk=8192):
     """Top-k items per user row of ``U``.
 
     U [n, r]; V [Ni, r]; item_valid [Ni] bool (False rows never recommended —
     padding rows and cold items).  Returns (scores [n, k], indices [n, k]).
+
+    When a row has fewer than ``k`` valid items the remaining slots
+    hold the ``NEG_INF`` sentinel score with MEANINGLESS indices (the
+    running-merge init state) — apply :func:`topk_validity` to the
+    scores to know which slots are real.
     """
     n, r = U.shape
     Ni = V.shape[0]

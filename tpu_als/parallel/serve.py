@@ -28,6 +28,7 @@ per device); scores are always identical.
 from __future__ import annotations
 
 import functools
+import threading
 import time
 
 import jax
@@ -50,29 +51,40 @@ class ServeShardLost(RuntimeError):
     successful request after recovery repopulates the cache."""
 
 
-# (V, valid) from the last successful single-process sharded serve; the
-# degraded path answers from this host-side copy when a gather fails.
-# One extra catalog copy in host RAM is the availability price — see
-# docs/resilience.md.  Guarded writes only (numpy assignment is atomic
-# enough for the single reference swap).
-_last_good = None
+# (V, valid) from the last successful single-process sharded serve,
+# keyed by (mesh device ids, strategy); the degraded path answers from
+# this host-side copy when a gather fails.  Keyed, not a single global:
+# two meshes in one process (a pod host serving two slices, the test
+# harness) must never answer each other's requests from the wrong
+# catalog.  One extra catalog copy in host RAM per (mesh, strategy) is
+# the availability price — see docs/resilience.md.  The lock guards the
+# dict against concurrent serving threads (the engine loop plus direct
+# callers).
+_last_good = {}
+_last_good_lock = threading.Lock()
+
+
+def _cache_key(mesh, strategy):
+    return (tuple(int(d.id) for d in mesh.devices.flat), strategy)
 
 
 def reset_last_good():
     """Drop the degraded-serving cache (tests; memory pressure)."""
-    global _last_good
-    _last_good = None
+    with _last_good_lock:
+        _last_good.clear()
 
 
-def _serve_degraded(U, k, Nu, strategy, reason, record):
+def _serve_degraded(U, k, Nu, mesh, strategy, reason, record):
     """Answer from the last-good catalog on ONE device.  Slower and
     possibly stale — but an answer, which beats a crash for a
     recommender (the scores were approximate to begin with)."""
-    if _last_good is None:
+    with _last_good_lock:
+        entry = _last_good.get(_cache_key(mesh, strategy))
+    if entry is None:
         raise ServeShardLost(
             f"sharded top-k failed ({reason}) and no last-good factors "
-            "are cached to serve degraded from")
-    Vg, validg = _last_good
+            "are cached for this (mesh, strategy) to serve degraded from")
+    Vg, validg = entry
     kk = min(k, Vg.shape[0])
     obs.counter("serve.degraded")
     obs.emit("serve_degraded", strategy=strategy, reason=reason)
@@ -159,14 +171,14 @@ def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
 
     Degraded mode (single-process only): when the sharded execute fails
     — a lost/stale factor shard, a device error, or the ``serve.gather``
-    fault point — the request is answered from the last successfully
-    gathered catalog on one device instead of crashing
+    fault point — the request is answered from the last catalog this
+    SAME (mesh, strategy) successfully served on one device instead of
+    crashing
     (``serve.degraded`` counter + ``serve_degraded`` event); with no
     last-good catalog cached, the typed :class:`ServeShardLost` raises.
     ``return_info=True`` appends ``{"degraded": bool, "reason": ...}``
     to the return tuple so callers can surface staleness.
     """
-    global _last_good
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown serving strategy {strategy!r} "
                          f"(expected one of {STRATEGIES})")
@@ -237,8 +249,9 @@ def topk_sharded(U, V, k, mesh, strategy="all_gather", item_valid=None,
             # be coherent; with no way to agree on that here, fail loud
             raise
         reason = f"{type(e).__name__}: {e}"
-        return _info(_serve_degraded(U, k, Nu, strategy, reason,
+        return _info(_serve_degraded(U, k, Nu, mesh, strategy, reason,
                                      _record), True, reason)
-    _last_good = (V, valid)
+    with _last_good_lock:
+        _last_good[_cache_key(mesh, strategy)] = (V, valid)
     _record(Nu)
     return _info(out, False)

@@ -23,6 +23,12 @@ Named fault points (the complete vocabulary — sites call
                           non-finite factors)
 ``serve.gather``          inside ``parallel.serve.topk_sharded``'s sharded
                           execute (corrupt = stale/lost factor shard)
+``serving.publish``       inside ``serving.engine.ServingEngine.publish``
+                          (corrupt = the new int8 index is tagged stale, so
+                          every batch falls back to the exact path)
+``serving.score``         per serving micro-batch, before scoring (corrupt =
+                          treat the index as stale for this batch; raise =
+                          the batch's tickets fail with the injected error)
 ========================  ====================================================
 
 Spec grammar (``TPU_ALS_FAULT_SPEC`` env var, or :func:`install`)::
@@ -65,6 +71,8 @@ FAULT_POINTS = (
     "multihost.init",
     "comm.ring_step",
     "serve.gather",
+    "serving.publish",
+    "serving.score",
 )
 
 MODES = ("raise", "corrupt", "hang")

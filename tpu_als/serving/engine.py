@@ -1,0 +1,301 @@
+"""Steady-state serving loop: batcher -> scorer -> response.
+
+One background thread drains the :class:`~tpu_als.serving.batcher.
+MicroBatcher`, pads each micro-batch to its bucket, scores it against
+the currently-published model (int8 shortlist + exact rescore when an
+index is live, the exact chunked kernel otherwise) and completes the
+tickets.  The pieces the rest of the stack plugs into:
+
+- **Atomic publishes, no recompile.**  :meth:`ServingEngine.publish`
+  places the new U/V on device once and swaps a single reference under
+  a lock; in-flight batches finish against the old tables, the next
+  batch sees the new ones.  The scoring executables are keyed on
+  (bucket, k, catalog shape) only, so a same-shape publish — the steady
+  state of periodic retraining — reuses every compiled program, and the
+  dropped reference releases the old device buffers (the donation
+  pattern: the engine owns its buffers, callers hand factors over and
+  must not mutate them afterwards).
+- **Stale-index fallback.**  Each publish carries a sequence number;
+  an index whose ``seq`` doesn't match the live model (a publish with
+  ``quantize=False`` after a quantized one, or a ``serving.publish``
+  corrupt-mode fault) is never scored against — the batch takes the
+  exact path and ``serving.fallback_exact`` counts it.
+- **Fault points.**  ``serving.publish`` fires inside publish (corrupt
+  = the new index is tagged stale); ``serving.score`` fires per batch
+  (corrupt = treat the index as stale for this batch; raise = the
+  injected error fails the batch's tickets, visible to every waiting
+  caller).
+- **Metrics.**  enqueue/score/e2e latency histograms, queue-depth
+  gauge, shed/expired/fallback counters — all through ``tpu_als.obs``
+  (see docs/serving.md for the vocabulary).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_als import obs
+from tpu_als.ops.topk import chunked_topk_scores
+from tpu_als.resilience import faults
+from tpu_als.serving.batcher import (
+    DEFAULT_BUCKETS,
+    DeadlineExceeded,
+    MicroBatcher,
+    bucket_for,
+)
+from tpu_als.serving.index import Int8CandidateIndex
+
+
+class NoModelPublished(RuntimeError):
+    """A request arrived before the first :meth:`ServingEngine.publish`."""
+
+
+class _Published:
+    """One immutable model generation; the engine swaps whole instances."""
+
+    __slots__ = ("seq", "U", "V", "valid", "index", "n_users", "rank")
+
+    def __init__(self, seq, U, V, valid, index):
+        self.seq = seq
+        self.U = U
+        self.V = V
+        self.valid = valid
+        self.index = index
+        self.n_users = int(U.shape[0])
+        self.rank = int(U.shape[1])
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _select_rows(U, ids, rows, rowmask):
+    """Per-slot query vectors: the published row for id-requests, the
+    carried fold-in vector for row-requests (``rowmask``)."""
+    ids = jnp.clip(ids, 0, U.shape[0] - 1)   # pad slots point anywhere safe
+    return jnp.where(rowmask[:, None], rows, jnp.take(U, ids, axis=0))
+
+
+class ServingEngine:
+    """Request-path serving over published ALS factors.
+
+    ``k`` is the engine-wide top-k width (one compiled program per
+    bucket); per-request ``k`` may be smaller and is trimmed at
+    completion.  ``buckets`` are the padded batch shapes; keep the set
+    small — each is one executable per (path, catalog shape).
+    """
+
+    def __init__(self, k=10, buckets=DEFAULT_BUCKETS, shortlist_k=64,
+                 max_queue=1024, max_wait_s=0.002,
+                 default_deadline_s=None, item_chunk=8192):
+        self.k = int(k)
+        self.shortlist_k = int(shortlist_k)
+        self.item_chunk = int(item_chunk)
+        self.batcher = MicroBatcher(
+            buckets=buckets, max_queue=max_queue, max_wait_s=max_wait_s,
+            default_deadline_s=default_deadline_s)
+        self._model = None              # _Published; swapped atomically
+        self._publish_lock = threading.Lock()
+        self._seq = 0
+        self._thread = None
+        self._stopping = threading.Event()
+
+    # -- model lifecycle ----------------------------------------------
+    def publish(self, U, V, item_valid=None, quantize=True):
+        """Swap in a new model generation atomically.
+
+        ``quantize=True`` builds the int8 candidate index for the new
+        catalog (skipped when the catalog is smaller than ``k`` — the
+        exact pass is already minimal there); ``quantize=False`` keeps
+        serving exact until the next quantized publish (the old index,
+        if any, is carried but detected as stale and never used).
+        Returns the publish sequence number.
+        """
+        mode = faults.check("serving.publish")
+        U = jnp.asarray(U, dtype=jnp.float32)
+        V = jnp.asarray(V, dtype=jnp.float32)
+        Ni = int(V.shape[0])
+        valid = (jnp.ones(Ni, dtype=jnp.bool_) if item_valid is None
+                 else jnp.asarray(item_valid, dtype=jnp.bool_))
+        with self._publish_lock:
+            seq = self._seq + 1
+            sk = min(max(self.shortlist_k, self.k), Ni)
+            index = None
+            if quantize and sk >= self.k and Ni > 0:
+                index = Int8CandidateIndex(V, valid, shortlist_k=sk,
+                                           seq=seq)
+                if mode == "corrupt":
+                    # injected staleness: the index exists but belongs
+                    # to no live publish — the score path must detect
+                    # the seq mismatch and fall back to exact
+                    index.seq = -1
+            elif index is None and self._model is not None:
+                index = self._model.index      # carried, now stale
+            self._model = _Published(seq, U, V, valid, index)
+            self._seq = seq
+        obs.counter("serving.publishes")
+        obs.emit("serving_publish", seq=seq, items=Ni,
+                 quantized=bool(index is not None and index.seq == seq))
+        return seq
+
+    @property
+    def published_seq(self):
+        m = self._model
+        return m.seq if m is not None else 0
+
+    def warmup(self):
+        """Compile every (bucket, path) scoring executable now, against
+        the published model — first-request latency must not carry a
+        compile.  Records no metrics (a warmup sample in the latency
+        histograms would poison the SLO tail serve-bench reports)."""
+        m = self._model
+        if m is None:
+            raise NoModelPublished("publish(U, V) before warmup")
+        for B in self.batcher.buckets:
+            Ub = _select_rows(m.U, jnp.zeros(B, jnp.int32),
+                              jnp.zeros((B, m.rank), jnp.float32),
+                              jnp.zeros(B, jnp.bool_))
+            if m.index is not None and m.index.seq == m.seq:
+                s, _ = m.index.topk(Ub, self.k)
+            else:
+                s, _ = chunked_topk_scores(
+                    Ub, m.V, m.valid, self.k,
+                    item_chunk=min(self.item_chunk,
+                                   max(m.V.shape[0], 1)))
+            s.block_until_ready()
+
+    # -- request path -------------------------------------------------
+    def submit(self, payload, k=None, deadline_s=None):
+        """Admit one request; returns its ticket (see ``Ticket.result``).
+
+        ``payload``: int user index into the published user table, or a
+        rank-length f32 vector (fold-in row).  Raises ``Overloaded``
+        when shedding, ``NoModelPublished`` before the first publish,
+        ``ValueError`` on a malformed payload.
+        """
+        m = self._model
+        if m is None:
+            raise NoModelPublished("publish(U, V) before submitting")
+        if k is not None and not 0 < k <= self.k:
+            raise ValueError(f"per-request k={k} must be in 1..{self.k} "
+                             "(the engine's compiled top-k width)")
+        if isinstance(payload, (int, np.integer)):
+            if not 0 <= payload < m.n_users:
+                raise ValueError(f"user index {payload} outside the "
+                                 f"published table [0, {m.n_users})")
+        else:
+            payload = np.asarray(payload, dtype=np.float32)
+            if payload.shape != (m.rank,):
+                raise ValueError(
+                    f"fold-in payload shape {payload.shape} != "
+                    f"({m.rank},) (the published rank)")
+        t = self.batcher.submit(payload, k=k, deadline_s=deadline_s)
+        obs.counter("serving.requests")
+        return t
+
+    def recommend(self, payload, k=None, deadline_s=None, timeout=None):
+        """Submit + block: returns ``(scores, indices)`` for one request."""
+        return self.submit(payload, k=k,
+                           deadline_s=deadline_s).result(timeout)
+
+    # -- engine loop --------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._stopping.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-als-serving", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain_timeout_s=5.0):
+        """Close admission, drain in-flight batches, join the loop."""
+        self.batcher.close()
+        self._stopping.set()
+        if self._thread is not None:
+            self._thread.join(drain_timeout_s)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _run(self):
+        while True:
+            batch = self.batcher.next_batch(timeout=0.1)
+            if batch is None:
+                if self._stopping.is_set():
+                    return
+                continue
+            try:
+                self.serve_batch(batch)
+            except BaseException as e:   # noqa: BLE001 — tickets must resolve
+                for t in batch:
+                    if not t.done():
+                        t.fail(e)
+                if not isinstance(e, faults.InjectedFault):
+                    obs.emit("warning", what="serving.batch",
+                             reason=f"{type(e).__name__}: {e}")
+
+    def serve_batch(self, batch):
+        """Score one dequeued micro-batch and complete its tickets.
+
+        Public so tests and synchronous callers can drive the engine
+        without the background thread.
+        """
+        now = time.perf_counter()
+        live = []
+        for t in batch:
+            if t.deadline is not None and now > t.deadline:
+                obs.counter("serving.expired")
+                t.fail(DeadlineExceeded(
+                    "deadline passed while queued "
+                    f"({now - t.t_submit:.4f}s since submit)"))
+            else:
+                live.append(t)
+        if not live:
+            return
+        mode = faults.check("serving.score")   # raise-mode -> _run fails all
+        m = self._model
+        n = len(live)
+        B = bucket_for(n, self.batcher.buckets)
+        ids = np.zeros(B, dtype=np.int32)
+        rows = np.zeros((B, m.rank), dtype=np.float32)
+        rowmask = np.zeros(B, dtype=bool)
+        for j, t in enumerate(live):
+            if isinstance(t.payload, (int, np.integer)):
+                ids[j] = t.payload
+            else:
+                rows[j] = t.payload
+                rowmask[j] = True
+        obs.histogram("serving.batch_rows", n)
+
+        index = m.index
+        use_index = (index is not None and index.seq == m.seq
+                     and mode != "corrupt")
+        if index is not None and not use_index:
+            obs.counter("serving.fallback_exact", n)
+        path = "int8" if use_index else "exact"
+        t0 = time.perf_counter()
+        Ub = _select_rows(m.U, jnp.asarray(ids), jnp.asarray(rows),
+                          jnp.asarray(rowmask))
+        if use_index:
+            s, ix = index.topk(Ub, self.k)
+        else:
+            s, ix = chunked_topk_scores(
+                Ub, m.V, m.valid, self.k,
+                item_chunk=min(self.item_chunk, max(m.V.shape[0], 1)))
+        s = np.asarray(s)
+        ix = np.asarray(ix)
+        obs.histogram("serving.score_seconds",
+                      time.perf_counter() - t0, path=path)
+        done = time.perf_counter()
+        for j, t in enumerate(live):
+            kk = t.k or self.k
+            t.complete((s[j, :kk], ix[j, :kk]))
+            obs.histogram("serving.e2e_seconds", done - t.t_submit)

@@ -4,7 +4,14 @@ Sweeps the production ``spd_solve_lanes`` trailing-update panel widths for
 correctness (vs the XLA lowering) and speed at a headline-representative
 shape; the winner sets ``pallas_lanes.DEFAULT_PANEL``.
 
+``--ne`` switches to the DMA-gather NE-build lab instead: per bucket
+width, the fused gather+Gram kernel (ops/pallas_gather_ne) vs the XLA
+gather+einsum build it replaces — wall time, max error, and the modeled
+HBM bytes of each path (perf.roofline closed forms, the same numbers the
+roofline stage table and the jaxpr audit pin).
+
 Usage: python scripts/kernel_lab.py [--n 262144] [--rank 128] [--panel 8]
+       python scripts/kernel_lab.py --ne [--widths 64 256 1024]
 """
 
 import argparse
@@ -21,12 +28,70 @@ import jax.numpy as jnp
 from tpu_als.ops.pallas_lanes import LANES, spd_solve_lanes
 
 
+def ne_lab(args, interpret):
+    """Per-width fused-vs-einsum NE build A/B (the --ne mode)."""
+    import jax
+
+    from tpu_als.ops.pallas_gather_ne import gather_normal_eq_explicit
+    from tpu_als.ops.solve import normal_eq_explicit
+    from tpu_als.perf.roofline import (einsum_ne_build_bytes,
+                                       fused_ne_kernel_bytes)
+    from tpu_als.utils.platform import fence
+
+    r = args.rank
+    rng = np.random.default_rng(0)
+    N = 1 << 16 if not interpret else 512
+    V = jnp.asarray(rng.normal(size=(N, r)).astype(np.float32)
+                    / np.sqrt(r))
+    for w in args.widths:
+        n = max(8, min(args.n, (1 << 22) // w) if not interpret else 16)
+        cols = jnp.asarray(rng.integers(0, N, (n, w)).astype(np.int32))
+        vals = jnp.asarray(rng.normal(size=(n, w)).astype(np.float32))
+        mask = jnp.asarray((rng.random((n, w)) < 0.9).astype(np.float32))
+
+        @jax.jit
+        def fused(V, c, v, m):
+            return gather_normal_eq_explicit(V, c, v, m, 0.1,
+                                             interpret=interpret)
+
+        @jax.jit
+        def einsum(V, c, v, m):
+            return normal_eq_explicit(V[c], v, m, 0.1)
+
+        def best(f):
+            fence(f(V, cols, vals, mask)[0])
+            ts = []
+            for _ in range(args.reps):
+                t0 = time.time()
+                fence(f(V, cols, vals, mask)[0])
+                ts.append(time.time() - t0)
+            return min(ts)
+
+        tf, te = best(fused), best(einsum)
+        err = np.abs(np.asarray(fused(V, cols, vals, mask)[0])
+                     - np.asarray(einsum(V, cols, vals, mask)[0])).max()
+        P = n * w
+        fb = fused_ne_kernel_bytes(P, n, max(128, r), 4)
+        eb = einsum_ne_build_bytes(P, n, r, 4)
+        print(f"w={w:6d} n={n:7d}: fused {tf*1e3:8.2f} ms "
+              f"({fb/1e9/max(tf,1e-9):6.1f} GB/s model)  "
+              f"einsum {te*1e3:8.2f} ms "
+              f"({eb/1e9/max(te,1e-9):6.1f} GB/s model)  "
+              f"speedup {te/max(tf,1e-9):5.2f}x  maxerr {err:.2e}",
+              flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=32768)
     ap.add_argument("--rank", type=int, default=128)
     ap.add_argument("--panels", type=int, nargs="*", default=[4, 8, 16])
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--ne", action="store_true",
+                    help="run the gather-fused NE-build lab instead of "
+                         "the solver panel sweep")
+    ap.add_argument("--widths", type=int, nargs="*",
+                    default=[64, 256, 1024])
     ap.add_argument("--platform", default="default",
                     choices=["default", "cpu"],
                     help="cpu = force the CPU backend + interpret-mode "
@@ -49,6 +114,9 @@ def main():
 
     from tpu_als.utils.platform import enable_persistent_compile_cache
     enable_persistent_compile_cache()
+
+    if args.ne:
+        return ne_lab(args, interpret)
 
     rng = np.random.default_rng(0)
     # correctness batch (small), validated vs XLA

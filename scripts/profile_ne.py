@@ -1,5 +1,7 @@
 """Decompose the non-solve half-step cost: gather vs normal-equation
-einsum vs scatter, per bucket width, at ML-25M shapes.
+einsum vs scatter, per bucket width, at ML-25M shapes — and A/B the
+DMA-gather fused NE kernel (ops/pallas_gather_ne) against the unfused
+gather+einsum it replaces, per bucket, with its modeled HBM bytes.
 
 The round-2 on-chip ablation pinned the solve at ~60%+ of the iteration;
 this script breaks down the remaining ~0.78 s/iter so the next kernel
@@ -8,6 +10,7 @@ program over the real ML-25M/scale bucket layout (padding included), with
 the axon-safe fence.
 
 Usage: python scripts/profile_ne.py [--scale 25] [--rank 128]
+       [--platform cpu]   (interpret-mode dry run, no tunnel needed)
 """
 
 import argparse
@@ -33,7 +36,16 @@ def main():
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--compute-dtype", default="float32",
                     choices=["float32", "bfloat16"])
+    ap.add_argument("--platform", default="default",
+                    choices=["default", "cpu"],
+                    help="cpu = force the CPU backend + interpret-mode "
+                         "fused kernel (dry run; timings meaningless)")
     args = ap.parse_args()
+
+    interpret = args.platform == "cpu"
+    if interpret:
+        jax.config.update("jax_platforms", "cpu")
+        args.scale = max(args.scale, 2500)   # interpret mode is serial
 
     nU, nI, nnz = (s // args.scale for s in ML25M_SHAPE)
     r = args.rank
@@ -81,8 +93,20 @@ def main():
                                    preferred_element_type=jnp.float32)
                     return A.sum(axis=(1, 2))
 
+                def fused(c, v, m):
+                    # the DMA-gather kernel doing the same one-sided
+                    # conf-weighted Gram — Vg never materialized
+                    from tpu_als.ops.pallas_gather_ne import gather_gram
+
+                    conf = (40.0 * jnp.abs(v) * m).astype(cdt)
+                    S, _ = gather_gram(V.astype(cdt), c, conf,
+                                       (v * m).astype(cdt),
+                                       two_sided=False,
+                                       interpret=interpret)
+                    return S.sum(axis=(1, 2))
+
                 f = {"gather": gather_only, "einsum": einsum_only,
-                     "gather+einsum": both}[stage]
+                     "gather+einsum": both, "fused": fused}[stage]
 
                 @jax.jit
                 def prog(cols, vals, mask):
@@ -101,12 +125,22 @@ def main():
             tg = run("gather")
             te = run("einsum")
             tb = run("gather+einsum")
+            tf = run("fused")
             gb = nb * w * r * 4 / 1e9
             fl = 2 * nb * w * r * r / 1e12
+            # the fused kernel's modeled HBM bytes (the CostEstimate /
+            # roofline single source of truth) at this bucket's shape
+            from tpu_als.perf.roofline import fused_ne_kernel_bytes
+
+            fgb = fused_ne_kernel_bytes(nb * w, nb, max(128, r),
+                                        cdt.itemsize) / 1e9
             print(f"w={w:6d} rows={nb:8d} ({nch} chunks): "
                   f"gather {tg*1e3:7.2f} ms ({gb/max(tg,1e-9):5.1f} GB/s)  "
                   f"einsum {te*1e3:7.2f} ms ({fl/max(te,1e-9):5.2f} TF/s)  "
-                  f"both {tb*1e3:7.2f} ms", flush=True)
+                  f"both {tb*1e3:7.2f} ms  "
+                  f"fused {tf*1e3:7.2f} ms "
+                  f"({fgb/max(tf,1e-9):5.1f} GB/s model, "
+                  f"{tb/max(tf,1e-9):4.2f}x vs both)", flush=True)
 
 
 if __name__ == "__main__":

@@ -62,8 +62,17 @@ DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
 #   banked append.  A/B steps therefore use a cg2_/reconfirm_ prefix;
 #   their banked evidence lands in headline_cg2.out / rmse_cg2.out via
 #   --ab-dir as before.
+#   Round-7 additions (gather-fused NE, ops/pallas_gather_ne):
+#   gather_headline measures the DMA-gather kernel A/B'd against the
+#   banked exact headline (banks to headline_gather.out via --ab-dir);
+#   wg15_headline closes the long-open width_growth=1.5 ablation as its
+#   own short step — it was only reachable inside the 1200s headline_ab
+#   omnibus, which never fit a window (tests/test_roofline.py pins the
+#   modeled waste reduction; this banks the measured iters/sec).
 STEPS=(
   "cg2_headline|700|python bench.py --no-auto-config --iters 5 --ab cg2 --ab-dir sweep_logs --probe-attempts 1"
+  "gather_headline|700|python bench.py --no-auto-config --iters 5 --ab gather --ab-dir sweep_logs --probe-attempts 1"
+  "wg15_headline|700|python bench.py --no-auto-config --iters 5 --ab wg15 --ab-dir sweep_logs --probe-attempts 1"
   "ml100k|300|python bench.py --no-auto-config --mode ml100k --probe-attempts 1"
   "reconfirm_f32|580|python bench.py --no-auto-config --iters 5 --probe-attempts 1"
   "headline_ab|1200|python bench.py --no-auto-config --iters 5 --ab bf16,wg15,bf16_wg15,cg2_bf16,cg3,cg2_dense,cg2 --ab-dir sweep_logs --probe-attempts 1"
@@ -74,6 +83,7 @@ STEPS=(
   "serve_bf16|420|python bench.py --no-auto-config --mode serve --compute-dtype bfloat16 --probe-attempts 1"
   "foldin|580|python bench.py --no-auto-config --mode foldin --probe-attempts 1"
   "kernel_lab|580|python scripts/kernel_lab.py --panels 4 8 16"
+  "ne_lab|580|python scripts/kernel_lab.py --ne --widths 64 256 1024"
   "rank256_proxy|900|python scripts/rank256_proxy.py"
   "kernel_lab_r256|580|python scripts/kernel_lab.py --rank 256 --n 8192 --panels 4 8 16"
   "ablate_full_cg2|900|python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2"

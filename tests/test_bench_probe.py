@@ -1,0 +1,112 @@
+"""bench.py's probe budget + sweep-fallback banking (round-5 failure:
+6x120s of hung backend probes burned the capture window and banked
+``value: null`` into BENCH_r05.json while a same-round sweep measurement
+sat on disk).  The budget caps total probe wall-clock; on exhaustion the
+capture banks the strongest builder-measured value with explicit
+``source: "sweep_fallback"`` provenance instead of a null."""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def _hang_forever(monkeypatch, calls):
+    """Make the probe subprocess look hung: every run raises
+    TimeoutExpired (instantly — the tests cap wall-clock via the
+    budget/waits, not via real 120s timeouts)."""
+
+    def fake_run(cmd, timeout=None, **kw):
+        calls.append(timeout)
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+
+
+def _args(mode="headline"):
+    return argparse.Namespace(mode=mode, rank=128, small=False)
+
+
+def test_probe_budget_caps_total_wallclock(monkeypatch):
+    calls = []
+    _hang_forever(monkeypatch, calls)
+    t0 = time.monotonic()
+    ok, err, events = bench.tpu_ready(attempts=6, wait_s=5,
+                                      probe_timeout_s=120, budget_s=0.3)
+    elapsed = time.monotonic() - t0
+    assert not ok
+    assert "budget" in err
+    # the 6x(120+5)s envelope never ran: the first inter-attempt sleep
+    # was clipped to the remaining budget and the next attempt stopped
+    assert elapsed < 5.0, elapsed
+    assert len(calls) < 6
+    # exhaustion is one structured event, after the real attempts
+    assert events and "budget" in events[-1]["reason"]
+
+
+def test_probe_budget_zero_keeps_full_retry_envelope(monkeypatch):
+    calls = []
+    _hang_forever(monkeypatch, calls)
+    ok, err, events = bench.tpu_ready(attempts=3, wait_s=0,
+                                      probe_timeout_s=120, budget_s=0)
+    assert not ok
+    assert "budget" not in err       # exhausted attempts, not budget
+    assert len(calls) == 3
+    assert len(events) == 3
+
+
+def _bank(d, name, payload):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, name + ".out"), "w") as f:
+        f.write(json.dumps(payload) + "\n")
+
+
+def test_hung_probe_banks_sweep_fallback_not_null(monkeypatch, tmp_path):
+    """The acceptance case: probe exhausts its budget, a same-round
+    sweep measurement exists on disk -> the emitted JSON carries THAT
+    value with sweep_fallback provenance, never value: null."""
+    calls = []
+    _hang_forever(monkeypatch, calls)
+    monkeypatch.chdir(tmp_path)
+    _bank("sweep_logs", "headline_f32",
+          {"value": 0.845, "unit": "iters/sec", "vs_baseline": 50.7,
+           "banked_at": "2026-08-01T08:32:00+00:00"})
+    ok, err, events = bench.tpu_ready(attempts=6, wait_s=1,
+                                      probe_timeout_s=120, budget_s=0.2)
+    assert not ok
+    out = bench.error_json(_args(), "als_iters_per_sec_rank128_ml25m"
+                           "_implicit", "iters/sec", err,
+                           probe_events=events)
+    assert out["value"] == 0.845
+    assert out["source"] == "sweep_fallback"
+    assert out["vs_baseline"] == 50.7
+    assert out["error"] == err          # the failure stays on record
+    lb = out["last_builder_measured"]
+    assert lb["source_log"].endswith("headline_f32.out")
+    assert lb["banked_at"] == "2026-08-01T08:32:00+00:00"
+    assert out["probe_events"]
+
+
+def test_no_evidence_still_banks_null(monkeypatch, tmp_path):
+    monkeypatch.chdir(tmp_path)          # empty sweep_logs
+    monkeypatch.setattr(bench, "_BUILDER_MEASURED", {})
+    out = bench.error_json(_args(), "m", "iters/sec", "probe dead")
+    assert out["value"] is None
+    assert "source" not in out
+
+
+def test_unit_mismatch_blocks_fallback(monkeypatch, tmp_path):
+    # a fallback from a differently-united record would be a silent
+    # unit swap — the value must stay null
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(bench, "_BUILDER_MEASURED", {})
+    _bank("sweep_logs", "headline_f32", {"value": 11.2, "unit": "s/iter"})
+    out = bench.error_json(_args(), "m", "iters/sec", "probe dead")
+    assert out["value"] is None
+    assert "source" not in out

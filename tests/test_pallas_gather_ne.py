@@ -1,0 +1,185 @@
+"""Gather-fused NE build (ops.pallas_gather_ne) vs the unfused
+``normal_eq_*(V[cols], …)`` reference, interpret mode on CPU (the same
+kernel compiles on TPU — ops/pallas_fused pattern).
+
+The numerics contract under test (kernel module docstring): for widths
+that fit ONE width chunk (w8 <= 256 — every real bucket, entity_widths
+only emits %8==0 widths) the fused build is **bitwise equal** at f32 to
+the reference — same weights, same dot_general contraction, same
+ridge/YtY tail expressions.  Widths spanning several chunks accumulate
+chunk-by-chunk: ``count`` stays bitwise, ``A``/``b`` match to
+accumulation-order rounding only, asserted tight."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_als.core.als import AlsConfig, resolve_solve_path, train
+from tpu_als.core.ratings import build_csr_buckets
+from tpu_als.ops.pallas_gather_ne import (
+    _tiles,
+    gather_normal_eq_explicit,
+    gather_normal_eq_implicit,
+)
+from tpu_als.ops.solve import compute_yty, normal_eq_explicit, \
+    normal_eq_implicit
+
+
+def _problem(rng, n, w, r, N=200, implicit=False, dtype=jnp.float32):
+    V = (rng.normal(size=(N, r)).astype(np.float32) / np.sqrt(r))
+    cols = rng.integers(0, N, (n, w)).astype(np.int32)
+    vals = rng.normal(size=(n, w)).astype(np.float32)
+    if implicit:
+        vals = np.abs(vals) * 3
+        vals[rng.random((n, w)) < 0.2] *= -1  # zero/negative confidence
+    mask = (rng.random((n, w)) < 0.8).astype(np.float32)
+    vals = vals * mask
+    return (jnp.asarray(V).astype(dtype), jnp.asarray(cols),
+            jnp.asarray(vals).astype(dtype), jnp.asarray(mask).astype(dtype))
+
+
+def _single_chunk(w):
+    """True when the kernel covers the (8-padded) width in one chunk —
+    the bitwise regime."""
+    w8 = -(-w // 8) * 8
+    _, wc, w_pad = _tiles(128, w8)
+    return w_pad // wc == 1
+
+
+def _assert_matches(got, ref, w):
+    A, b, c = (np.asarray(x) for x in got)
+    Ar, br, cr = (np.asarray(x) for x in ref)
+    np.testing.assert_array_equal(c, cr)
+    if _single_chunk(w):
+        np.testing.assert_array_equal(A, Ar)
+        np.testing.assert_array_equal(b, br)
+    else:
+        # multi-chunk accumulation reorders both reductions — rounding
+        # only (observed ~1e-05 abs at unit-scale factors)
+        np.testing.assert_allclose(A, Ar, atol=1e-4, rtol=5e-3)
+        np.testing.assert_allclose(b, br, atol=1e-4, rtol=5e-3)
+
+
+SHAPES = [
+    (5, 8, 4),       # tiny everything
+    (37, 24, 10),    # non-pow2 batch, w multiple of 8
+    (33, 100, 128),  # the benchmark rank; w not a multiple of 8
+    (64, 512, 32),   # multiple width chunks -> allclose regime for b
+]
+
+
+@pytest.mark.parametrize("n,w,r", SHAPES)
+def test_explicit_matches_reference(rng, n, w, r):
+    V, cols, vals, mask = _problem(rng, n, w, r)
+    got = gather_normal_eq_explicit(V, cols, vals, mask, 0.05,
+                                    interpret=True)
+    ref = normal_eq_explicit(V[cols], vals, mask, 0.05)
+    _assert_matches(got, ref, w)
+
+
+@pytest.mark.parametrize("n,w,r", SHAPES)
+def test_implicit_matches_reference(rng, n, w, r):
+    V, cols, vals, mask = _problem(rng, n, w, r, implicit=True)
+    YtY = compute_yty(V.astype(jnp.float32))
+    got = gather_normal_eq_implicit(V, cols, vals, mask, 0.1, 4.0, YtY,
+                                    interpret=True)
+    ref = normal_eq_implicit(V[cols], vals, mask, 0.1, 4.0, YtY)
+    _assert_matches(got, ref, w)
+
+
+def test_empty_and_all_padding_rows(rng):
+    # rows whose mask is entirely zero (empty users / all-padding bucket
+    # rows pointing at col 0): A must be exactly the ridge-free zero +
+    # tail, identical to the reference in every slot
+    n, w, r = 16, 24, 8
+    V, cols, vals, mask = _problem(rng, n, w, r)
+    mask = mask.at[3].set(0.0).at[11].set(0.0)
+    vals = vals * mask
+    cols = cols.at[11].set(0)  # the builder's padding convention
+    got = gather_normal_eq_explicit(V, cols, vals, mask, 0.05,
+                                    interpret=True)
+    ref = normal_eq_explicit(V[cols], vals, mask, 0.05)
+    _assert_matches(got, ref, w)
+    assert np.asarray(got[2])[3] == 0 and np.asarray(got[2])[11] == 0
+
+
+def test_duplicate_columns_in_a_row(rng):
+    # one entity rating the same opposite row several times in a window
+    # (also the padding convention): each occurrence's DMA lands in its
+    # own Vg slot, so duplicates contribute exactly like the gather
+    n, w, r = 12, 16, 8
+    V, cols, vals, mask = _problem(rng, n, w, r, N=5)  # tiny N -> dupes
+    assert any(len(set(row)) < w for row in np.asarray(cols))
+    got = gather_normal_eq_explicit(V, cols, vals, mask, 0.05,
+                                    interpret=True)
+    ref = normal_eq_explicit(V[cols], vals, mask, 0.05)
+    _assert_matches(got, ref, w)
+
+
+def test_bfloat16_compute_dtype(rng):
+    # the bf16 casting rule: table gathered in bf16, contraction
+    # accumulates f32 — both paths promote identically, so bitwise holds
+    n, w, r = 24, 32, 16
+    V, cols, vals, mask = _problem(rng, n, w, r, dtype=jnp.bfloat16)
+    got = gather_normal_eq_explicit(V, cols, vals, mask, 0.05,
+                                    interpret=True)
+    ref = normal_eq_explicit(V[cols], vals, mask, 0.05)
+    _assert_matches(got, ref, w)
+    YtY = compute_yty(V.astype(jnp.float32))
+    goti = gather_normal_eq_implicit(V, cols, vals, mask, 0.1, 4.0, YtY,
+                                     interpret=True)
+    refi = normal_eq_implicit(V[cols], vals, mask, 0.1, 4.0, YtY)
+    _assert_matches(goti, refi, w)
+
+
+def test_degree_skewed_buckets_match(rng):
+    # real bucket layouts from the builder on a power-law degree
+    # distribution: every (width, rows) bucket the planner emits must be
+    # bitwise (entity_widths only emits single-chunk widths here)
+    nU, nI = 120, 90
+    deg = np.minimum((rng.pareto(1.2, nU) * 4 + 1).astype(int), nI)
+    u = np.repeat(np.arange(nU), deg)
+    i = np.concatenate([rng.choice(nI, d, replace=False) for d in deg])
+    vals = rng.normal(size=len(u)).astype(np.float32)
+    csr = build_csr_buckets(u, i, vals, nU, min_width=8)
+    V = jnp.asarray(rng.normal(size=(nI, 16)).astype(np.float32) / 4.0)
+    for bkt in csr.device_buckets():
+        c = jnp.asarray(bkt.cols)
+        v = jnp.asarray(bkt.vals)
+        m = jnp.asarray(bkt.mask)
+        got = gather_normal_eq_explicit(V, c, v, m, 0.05, interpret=True)
+        ref = normal_eq_explicit(V[c], v, m, 0.05)
+        _assert_matches(got, ref, c.shape[1])
+
+
+@pytest.mark.parametrize("implicit", [False, True])
+def test_train_gather_fused_bitwise_equals_auto(rng, implicit):
+    # end to end: solve_backend='gather_fused' (interpret mode off-TPU)
+    # must reproduce the einsum path's factors BITWISE after several
+    # iterations — same normal equations in, same solver out
+    nU, nI, nnz = 40, 30, 500
+    u = rng.integers(0, nU, nnz)
+    i = rng.integers(0, nI, nnz)
+    r = np.abs(rng.normal(size=nnz)).astype(np.float32) + 0.1
+    ucsr = build_csr_buckets(u, i, r, nU, min_width=8)
+    icsr = build_csr_buckets(i, u, r, nI, min_width=8)
+    kw = dict(rank=16, max_iter=3, reg_param=0.1, seed=3,
+              implicit_prefs=implicit, alpha=4.0)
+    Ua, Va = train(ucsr, icsr, AlsConfig(**kw))
+    Ug, Vg = train(ucsr, icsr, AlsConfig(solve_backend="gather_fused",
+                                         **kw))
+    np.testing.assert_array_equal(np.asarray(Ua), np.asarray(Ug))
+    np.testing.assert_array_equal(np.asarray(Va), np.asarray(Vg))
+
+
+def test_resolve_path_forced_gather_fused():
+    info = resolve_solve_path(
+        AlsConfig(rank=16, solve_backend="gather_fused"), 16)
+    assert info["resolved_solve_path"].startswith("gatherfused+")
+    # off-TPU the auto walk must NOT pick the kernel (probe gates on TPU)
+    if not info["on_tpu"]:
+        auto = resolve_solve_path(AlsConfig(rank=16), 16)
+        assert auto["resolved_solve_path"].startswith("einsum+")
+        assert auto["gather_ne_probe"] is False

@@ -20,6 +20,7 @@ from tpu_als.perf.roofline import (
     HEADLINE,
     HEADLINE_MEASURED_S_PER_ITER,
     headline_roofline,
+    modeled_padding_waste,
     render,
     roofline,
 )
@@ -99,6 +100,74 @@ def test_restream_scales_gather_stream():
     # tiling re-streams the gathered factors ~3x (the 12*P rating stream
     # is not re-read, so strictly less than 3x)
     assert 2.0 < gt["gather_stream"] * 8 / gs["gather_stream"] < 3.0
+
+
+def _powerlaw_degrees(rng, n, cap, scale=6):
+    deg = np.minimum((rng.pareto(1.1, n) * scale + 1).astype(np.int64), cap)
+    deg[rng.random(n) < 0.1] = 0  # leave some entities unrated
+    return deg
+
+
+@pytest.mark.parametrize("growth", [2.0, 1.5])
+@pytest.mark.parametrize("chunk_elems", [512, 1 << 19])
+def test_modeled_padding_waste_matches_built_buckets(rng, growth,
+                                                     chunk_elems):
+    """The derived waste (what the roofline now uses instead of the
+    hardcoded 1.514) must EQUAL padded_nnz/nnz of an actual
+    build_csr_buckets run — same width assignment, same row padding —
+    on skewed power-law degrees, across chunk budgets and width ladders."""
+    from tpu_als.core.ratings import build_csr_buckets
+
+    nU, nI = 150, 80
+    deg = _powerlaw_degrees(rng, nU, nI)
+    u = np.repeat(np.arange(nU), deg)
+    i = rng.integers(0, nI, len(u))
+    vals = np.ones(len(u), np.float32)
+    csr = build_csr_buckets(u, i, vals, nU, min_width=8,
+                            chunk_elems=chunk_elems, width_growth=growth)
+    modeled = modeled_padding_waste(np.bincount(u, minlength=nU),
+                                    min_width=8, chunk_elems=chunk_elems,
+                                    growth=growth)
+    assert modeled == pytest.approx(csr.padded_nnz / csr.nnz, rel=0, abs=0)
+
+
+def test_width_growth_15_tighter_than_pow2(rng):
+    """The growth=1.5 ladder (AlsConfig's unmeasured knob): every width
+    still covers its count, stays a sublane multiple (the fused kernel
+    and sharded stackers rely on %8==0), never exceeds the pow2 width,
+    and cuts the MODELED padding waste on power-law degrees — the claim
+    the sweep's headline_wg15 ablation step measures on hardware."""
+    from tpu_als.core.ratings import entity_widths
+
+    counts = _powerlaw_degrees(rng, 5000, 4096, scale=12)
+    rated = counts[counts > 0]
+    w20 = entity_widths(rated, 8, growth=2.0)
+    w15 = entity_widths(rated, 8, growth=1.5)
+    assert (w15 >= rated).all()
+    assert (w15 % 8 == 0).all()
+    assert (w15 <= w20).all()
+    waste20 = modeled_padding_waste(counts, min_width=8, growth=2.0)
+    waste15 = modeled_padding_waste(counts, min_width=8, growth=1.5)
+    assert waste15 < waste20, (waste15, waste20)
+
+
+def test_roofline_padding_waste_provenance(rng):
+    cu = _powerlaw_degrees(rng, 200, 100)
+    ci = _powerlaw_degrees(rng, 100, 200)
+    nnz = int(cu.sum())
+    derived = roofline(200, 100, nnz, 16, user_counts=cu, item_counts=ci)
+    assert derived["config"]["padding_waste_source"] == "derived"
+    expect = (modeled_padding_waste(cu) + modeled_padding_waste(ci)) / 2
+    assert derived["config"]["padding_waste"] == pytest.approx(expect)
+    explicit = roofline(200, 100, nnz, 16, padding_waste=1.514)
+    assert explicit["config"]["padding_waste_source"] == "explicit"
+    assert explicit["config"]["padding_waste"] == 1.514
+    default = roofline(200, 100, nnz, 16)
+    assert default["config"]["padding_waste_source"] == "default"
+    assert default["config"]["padding_waste"] == 1.0
+    # the derived-vs-explicit knob changes ONLY byte totals, not stages
+    assert [s["name"] for s in derived["stages"]] == \
+        [s["name"] for s in explicit["stages"]]
 
 
 def test_cli_roofline_json():

@@ -978,10 +978,15 @@ def cmd_observe(args):
             padding_waste=args.padding_waste, devices=args.devices,
             strategy=args.strategy,
             tiles_user=args.tiles, tiles_item=args.tiles,
+            ne_path=args.ne_path,
         )
         measured = args.measured_s_per_iter
         if measured is None and kwargs == dict(
-                HEADLINE, strategy=None, tiles_user=1, tiles_item=1):
+                HEADLINE, strategy=None, tiles_user=1, tiles_item=1,
+                ne_path="einsum"):
+            # the measured point belongs to the einsum-path headline; a
+            # --ne-path gather_fused render shows the revised floor
+            # without pretending the old measurement sits on it
             measured = HEADLINE_MEASURED_S_PER_ITER
         report_d = roofline(**kwargs, measured_s_per_iter=measured)
         if args.as_json:
@@ -1238,6 +1243,12 @@ def main(argv=None):
     os3.add_argument("--tiles", type=int, default=1,
                      help="row-tile count (ring/chunked strategies "
                           "re-stream the opposite factors per tile)")
+    os3.add_argument("--ne-path", default="einsum",
+                     choices=["einsum", "gather_fused"],
+                     help="normal-equation build to price: the unfused "
+                          "gather+einsum round-trip, or the DMA-gather "
+                          "fused kernel (ops/pallas_gather_ne — factor "
+                          "rows read once, Vg never in HBM)")
     os3.add_argument("--measured-s-per-iter", type=float, default=None,
                      help="overlay a measured point (default: the "
                           "headline 1.184 when the config is untouched)")

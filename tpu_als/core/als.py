@@ -47,14 +47,21 @@ class AlsConfig:
     seed: int = 0
     nnls_sweeps: int = 32
     compute_dtype: str = "float32"  # or "bfloat16" for the A/b einsums
-    # 'auto': einsum normal equations + the fastest healthy Pallas solve —
+    # 'auto': normal equations + the fastest healthy Pallas solve —
     # batch-in-lanes (tpu_als.ops.pallas_lanes, rank <= 128, 2.2x the
     # blocked kernel on v5e) then blocked Cholesky (pallas_solve), else
-    # the XLA cholesky lowering; 'fused' forces the fused normal-eq+solve
-    # kernel (tpu_als.ops.pallas_fused — measured 34x SLOWER than the
-    # einsum+pallas path on v5e at ML-25M/25 rank 128, kept for ablation
-    # and for regimes where the A tensor's HBM round-trip dominates);
-    # 'unfused' forces the einsum path (NNLS always uses unfused)
+    # the XLA cholesky lowering.  On the NE-build side, 'auto'
+    # additionally upgrades the gather+einsum build to the DMA-gather
+    # fused kernel (tpu_als.ops.pallas_gather_ne — factor rows stream
+    # HBM→VMEM once, Vg never materialized) when BOTH its
+    # compile-and-validate probe AND its timing probe beat the einsum
+    # path on this chip (available ≠ faster: the fused_pallas lesson).
+    # 'gather_fused' forces that kernel (interpret-mode off-TPU, so CPU
+    # tests exercise it); 'fused' forces the round-2 fused
+    # normal-eq+SOLVE kernel (tpu_als.ops.pallas_fused — measured 34x
+    # SLOWER than the einsum+pallas path on v5e at ML-25M/25 rank 128,
+    # kept for ablation); 'unfused' forces the plain einsum path (NNLS
+    # always uses unfused)
     solve_backend: str = "auto"
     # > 0: replace the exact per-row factorization with that many
     # warm-started Jacobi-CG steps (ops.solve) — inexact ALS.
@@ -85,7 +92,11 @@ def resolve_solve_path(cfg: AlsConfig, rank, matfree_capable=True):
     einsum-built A), 'einsum+pallas_lanes',
     'einsum+pallas_lanes_blocked' (out-of-core lanes, ranks > 128),
     'einsum+pallas_cholesky', 'einsum+xla_cholesky'} plus the raw probe
-    outcomes.
+    outcomes.  The NE-build prefix flips from 'einsum' to 'gatherfused'
+    (e.g. 'gatherfused+pallas_lanes') when solve_backend='gather_fused'
+    forces the DMA-gather kernel, or — under 'auto' — when its
+    compile-and-validate probe AND its beats-the-einsum timing probe
+    both pass (tpu_als.ops.pallas_gather_ne).
 
     ``matfree_capable=False``: the caller's half-step cannot apply A
     matrix-free (the ring strategy — its A is accumulated across
@@ -103,13 +114,24 @@ def resolve_solve_path(cfg: AlsConfig, rank, matfree_capable=True):
     # (round 2 ablation, ML-25M/25 rank 128) fused = 3.93 s/iter vs
     # einsum+pallas_cholesky = 0.114 s/iter — the VMEM-resident solve on
     # the einsum-built A wins; 'fused' stays available explicitly.
-    fused_ok = solve_ok = lanes_ok = blocked_ok = None
+    fused_ok = solve_ok = lanes_ok = blocked_ok = gather_ok = None
     if cfg.nonnegative:
         path = "einsum+nnls"
     elif cfg.solve_backend == "fused":
         # forced: no probe — dispatch would ignore its outcome, and the
         # probe costs a Mosaic compile+execute on every resolve
         path = "fused_pallas"
+    elif cfg.solve_backend == "gather_fused":
+        # forced DMA-gather NE build; the solve still walks the probe
+        # order (the kernel writes A/b, the solve stays on lanes/xla).
+        # Off-TPU the kernel runs in interpret mode, so no gate here.
+        base = {
+            "lanes": "einsum+pallas_lanes",
+            "lanes_blocked": "einsum+pallas_lanes_blocked",
+            "pallas": "einsum+pallas_cholesky",
+            "xla": "einsum+xla_cholesky",
+        }[auto_solve_backend(rank)]
+        path = "gatherfused" + base[len("einsum"):]
     elif cfg.cg_iters > 0:
         # inexact ALS: no factorization, no Pallas kernel, no probe —
         # matfree applies A through the factor rows (no NE einsum at
@@ -133,9 +155,24 @@ def resolve_solve_path(cfg: AlsConfig, rank, matfree_capable=True):
                       else bool(tpu and pallas_lanes_blocked.available(rank)))
         solve_ok = (None if (lanes_ok or blocked_ok)
                     else bool(tpu and pallas_solve.available(rank)))
+        if cfg.solve_backend == "auto":
+            # NE-build upgrade: the DMA-gather kernel replaces the
+            # gather+einsum build ONLY when it validates AND measures
+            # faster than the einsum path on this chip (both probes
+            # cached per process; off-TPU both return False, so CPU runs
+            # keep the einsum path under 'auto')
+            from tpu_als.ops import pallas_gather_ne
+
+            gather_ok = bool(
+                tpu and pallas_gather_ne.available(rank, cfg.compute_dtype)
+                and pallas_gather_ne.faster_than_einsum(
+                    rank, cfg.compute_dtype))
+            if gather_ok:
+                path = "gatherfused" + path[len("einsum"):]
     return {
         "solve_backend_requested": cfg.solve_backend,
         "fused_kernel_probe": fused_ok,
+        "gather_ne_probe": gather_ok,
         "pallas_lanes_probe": lanes_ok,
         "pallas_lanes_blocked_probe": blocked_ok,
         "pallas_solve_probe": solve_ok,
@@ -187,12 +224,20 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     V_comp = V_full.astype(cdt)
     out = jnp.zeros((num_rows, r), dtype=jnp.float32)
 
-    if cfg.solve_backend not in ("auto", "fused", "unfused"):
+    if cfg.solve_backend not in ("auto", "fused", "unfused", "gather_fused"):
         raise ValueError(
             f"unknown solve_backend {cfg.solve_backend!r} "
-            "(expected 'auto', 'fused' or 'unfused')")
-    fused = resolve_solve_path(cfg, r)["resolved_solve_path"] == "fused_pallas"
-    cg = cfg.cg_iters > 0 and not cfg.nonnegative and not fused
+            "(expected 'auto', 'fused', 'unfused' or 'gather_fused')")
+    resolved = resolve_solve_path(cfg, r)
+    fused = resolved["resolved_solve_path"] == "fused_pallas"
+    # DMA-gather fused NE build (ops.pallas_gather_ne): the factor rows
+    # stream HBM→VMEM inside the kernel, so the Vg = V_comp[c] gather
+    # below never runs and the [chunk, w, r] intermediate never exists —
+    # trainer_chunk drops it from the memory model (fused_gather=True).
+    # Off-TPU the kernel runs in interpret mode (CPU tier-1 exercises it).
+    gather = resolved["resolved_solve_path"].startswith("gatherfused")
+    gather_interpret = not resolved["on_tpu"]
+    cg = cfg.cg_iters > 0 and not cfg.nonnegative and not (fused or gather)
     if cfg.cg_mode not in ("matfree", "dense"):
         raise ValueError(f"unknown cg_mode {cfg.cg_mode!r} "
                          "(expected 'matfree' or 'dense')")
@@ -200,7 +245,7 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
 
     for b in buckets:
         nb, w = b.cols.shape
-        chunk = trainer_chunk(nb, w, r, chunk_elems)
+        chunk = trainer_chunk(nb, w, r, chunk_elems, fused_gather=gather)
         nchunks = nb // chunk
         cols = b.cols.reshape(nchunks, chunk, w)
         vals = b.vals.reshape(nchunks, chunk, w)
@@ -209,6 +254,29 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
 
         def solve_chunk(args):
             c, v, m, rw = args
+            if gather:
+                from tpu_als.ops.pallas_gather_ne import (
+                    gather_normal_eq_explicit,
+                    gather_normal_eq_implicit,
+                )
+
+                # fused DMA-gather + Gram build: A/b come straight off
+                # the HBM-resident V_comp; semantics are bitwise the
+                # normal_eq_* path (same weights/ridge/YtY/count — the
+                # empty-row guard stays in solve_spd, as always)
+                with jax.named_scope("gather_fused_ne"):
+                    if cfg.implicit_prefs:
+                        A, rhs, count = gather_normal_eq_implicit(
+                            V_comp, c, v.astype(cdt), m.astype(cdt),
+                            reg, alpha, YtY.astype(jnp.float32),
+                            interpret=gather_interpret)
+                    else:
+                        A, rhs, count = gather_normal_eq_explicit(
+                            V_comp, c, v.astype(cdt), m.astype(cdt),
+                            reg, interpret=gather_interpret)
+                with jax.named_scope("solve"):
+                    return solve_spd(A.astype(jnp.float32),
+                                     rhs.astype(jnp.float32), count)
             with jax.named_scope("gather_factors"):
                 Vg = V_comp[c]
             # warm start for the inexact (CG) solvers: the solved side's

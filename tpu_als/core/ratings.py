@@ -170,16 +170,23 @@ def padded_bucket_rows(nb, width, chunk_elems):
     return -(-nb // chunk) * chunk
 
 
-def trainer_chunk(nb_padded, width, rank, chunk_elems, mem_elems=1 << 28):
+def trainer_chunk(nb_padded, width, rank, chunk_elems, mem_elems=1 << 28,
+                  fused_gather=False):
     """Trainer-side chunk: the builder chunk, halved until the largest
     per-chunk intermediate — max(Vg [chunk,w,r], A [chunk,r,r]) — fits in
     ``mem_elems`` elements (default 2^28 f32 elems = 1 GiB).
+
+    ``fused_gather=True``: the DMA-gather NE kernel
+    (tpu_als.ops.pallas_gather_ne) never materializes Vg in HBM — only
+    the A tensor bounds the chunk, so wide buckets keep the builder
+    chunk instead of halving it ``width/rank``-fold.
 
     The gcd fallback only defends against buckets built with a different
     ``chunk_elems`` (degrades throughput, never correctness).
     """
     c = scan_chunk(nb_padded, width, chunk_elems)
-    while c > 1 and c * rank * max(width, rank) > mem_elems:
+    big = rank if fused_gather else max(width, rank)
+    while c > 1 and c * rank * big > mem_elems:
         c //= 2
     if nb_padded % c:
         c = math.gcd(nb_padded, c)

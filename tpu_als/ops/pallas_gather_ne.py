@@ -1,0 +1,382 @@
+"""Pallas TPU kernel: DMA-gather factor rows + fused Gram (normal-eq) build.
+
+The unfused half-step (tpu_als.core.als.local_half_step) materializes the
+gathered opposite factors ``Vg [n, w, r]`` in HBM: the XLA gather reads one
+factor row per padded rating entry AND writes it into the gathered layout
+(``2·P·r·db`` bytes), then the normal-equation einsum reads the whole thing
+back (another ``P·r·db``).  At ML-25M/rank-128 that round-trip is the
+co-dominant stage on the roofline floor (docs/roofline.md: gather_stream
+95.76 ms + the einsum's re-read).  This kernel deletes it: the bucket's
+``cols`` land in SMEM, each factor row is DMA-copied **directly from the
+HBM-resident factor table** into a VMEM tile (double-buffered
+``pltpu.make_async_copy``), and the Gram accumulation
+
+    A = Σ_w  (aw·v) vᵀ        b = Σ_w  bw·v
+
+runs on the VMEM tile as the rows stream through — ``Vg`` never exists in
+HBM.  Each padded entry's factor row moves HBM→VMEM exactly once.
+
+Scope — deliberately narrow (the round-2 lesson): the kernel fuses ONLY
+gather + Gram build and writes ``A [n, r, r]`` / ``b [n, r]`` back to HBM;
+the ridge/YtY tail, the count, the empty-row guard and the SPD solve all
+stay on the proven XLA / ``pallas_lanes`` paths (``tpu_als.ops.solve``).
+The in-kernel VPU solve is what made ``fused_pallas`` 34× slower than
+einsum+lanes on v5e — this kernel never touches the VPU-serial recurrence.
+
+Numerics contract: :func:`gather_normal_eq_explicit` /
+:func:`gather_normal_eq_implicit` are drop-in replacements for
+``normal_eq_explicit(V[cols], …)`` / ``normal_eq_implicit(V[cols], …)``,
+**bitwise at f32** for sublane-multiple widths that fit one width chunk
+(every real bucket width — tpu_als.core.ratings.entity_widths only emits
+%8==0 widths): the weights, the count, and the ridge/YtY tail are computed
+by the *same* XLA expressions as the reference builders, and the in-kernel
+contraction is the same ``dot_general`` the einsum lowers to, over the same
+operands in the same dtypes (``compute_dtype=bfloat16`` flows through
+unchanged — the table is gathered in the compute dtype, contractions
+accumulate in f32 via ``preferred_element_type``).  Buckets whose padded
+width spans several width chunks accumulate chunk-by-chunk, which matches
+the einsum only to rounding (the property tests assert tight allclose
+there, exact equality on single-chunk widths).
+
+Grid: ``(row_tiles, width_chunks)``, width innermost; the ``[TN, r, r]``
+accumulator persists across the width chunks of one row tile (the same
+revisiting pattern as tpu_als.ops.pallas_fused).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_als.ops.solve import implicit_weights
+
+# outstanding-DMA ring depth: row copies are small (r·db bytes, 512 B at
+# rank 128 f32), so several must be in flight to hide per-descriptor
+# latency; 8 is comfortably below the DMA queue depth
+_DMA_SLOTS = 8
+
+
+def _gather_gram_kernel(cols_ref, aw_ref, bw_ref, V_hbm, A_ref, b_ref,
+                        Vg, S, bacc, sem, *, n_wc, two_sided):
+    """One (row-tile, width-chunk) grid step.
+
+    cols_ref [TN, WC] (SMEM, scalar-readable DMA indices); aw/bw [TN, WC]
+    (VMEM) — the A-side and b-side per-entry weights, precomputed by the
+    wrappers with the reference builders' exact expressions; V_hbm [N, r]
+    stays in HBM (``memory_space=ANY``).  Scratch: Vg [TN, WC, r] (the
+    VMEM landing tile — the only place the gathered rows ever exist),
+    S [TN, r, r] / bacc [TN, r] f32 accumulators, sem: DMA semaphore ring.
+
+    two_sided=True applies ``aw`` to BOTH contraction operands (the
+    explicit builder's ``Vm = Vg·mask`` on each side); False applies it to
+    one side (the implicit builder's ``conf_m1·Vg`` against raw ``Vg``).
+    """
+    j = pl.program_id(1)
+    tn, wc = cols_ref.shape
+    n_e = tn * wc
+
+    @pl.when(j == 0)
+    def _init():
+        S[:] = jnp.zeros_like(S)
+        bacc[:] = jnp.zeros_like(bacc)
+
+    def _copy(e, slot):
+        t = e // wc
+        k = e % wc
+        return pltpu.make_async_copy(
+            V_hbm.at[cols_ref[t, k]], Vg.at[t, k], sem.at[slot])
+
+    # prime the ring, then wait entry e / start entry e+DEPTH into the
+    # slot e just vacated — the standard multiple-buffering schedule
+    depth = min(_DMA_SLOTS, n_e)
+    for s in range(depth):
+        _copy(s, s).start()
+
+    def _pump(e, carry):
+        _copy(e, e % depth).wait()
+
+        @pl.when(e + depth < n_e)
+        def _next():
+            _copy(e + depth, e % depth).start()
+
+        return carry
+
+    jax.lax.fori_loop(0, n_e, _pump, 0)
+
+    Vg_t = Vg[:]
+    aw = aw_ref[:]
+    Vw = Vg_t * aw[..., None]
+    # same batched contraction the reference einsums lower to, accumulated
+    # chunk-by-chunk in f32
+    S[:] = S[:] + jax.lax.dot_general(
+        Vw, Vw if two_sided else Vg_t,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    bacc[:] = bacc[:] + jax.lax.dot_general(
+        bw_ref[:], Vg_t,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == n_wc - 1)
+    def _emit():
+        A_ref[:] = S[:]
+        b_ref[:] = bacc[:]
+
+
+def _tiles(r_pad, w8, max_wc=256):
+    """(TN, WC, W_PAD) for a bucket of (8-padded) width ``w8``.
+
+    Mosaic constrains the LAST dim of a block to be a 128-multiple or the
+    full array dim — the width is the last dim of the [TN, WC] cols/aw/bw
+    blocks, so WC is the whole padded width or a 128-multiple dividing it
+    (the pallas_fused lesson: 8-step shrinking passes interpret mode but
+    fails the real lowering).  TN is bounded by the VMEM working set
+    (S accumulator + the Vg landing tile + pipelined aw/bw blocks) and by
+    the SMEM cols block (TN·WC int32 scalars).
+    """
+    if w8 <= max_wc:
+        wc = w_pad = w8
+    else:
+        w_pad = -(-w8 // 128) * 128
+        wc = max_wc - (max_wc % 128)
+        while wc > 128 and w_pad % wc:
+            wc -= 128
+    tn = 256
+    while tn > 8 and tn * (r_pad * r_pad + 3 * wc * r_pad) > (1 << 21):
+        tn //= 2
+    while tn > 8 and tn * wc > (1 << 13):
+        tn //= 2
+    return tn, wc, w_pad
+
+
+@functools.partial(jax.jit, static_argnames=("two_sided", "interpret"))
+def gather_gram(V, cols, aw, bw, *, two_sided, interpret=False):
+    """Raw fused gather+Gram: ``S[i] = Σ_k aw[i,k]·v[i,k] v[i,k]ᵀ`` (both
+    sides weighted when ``two_sided``), ``b[i] = Σ_k bw[i,k]·v[i,k]`` with
+    ``v[i,k] = V[cols[i,k]]`` — the rows DMA'd straight from the
+    HBM-resident ``V``, never materialized as an [n, w, r] intermediate.
+
+    V [N, r] (any float dtype — bf16 halves the dominant HBM stream);
+    cols [n, w] int32; aw/bw [n, w].  Returns (S [n, r, r] f32, b [n, r]
+    f32).  The ridge/YtY/count tail lives in the gather_normal_eq_*
+    wrappers so it stays bitwise-identical to ``normal_eq_*``.
+    """
+    N, r = V.shape
+    n, w = cols.shape
+    # rows are DMA'd as whole [r_pad] slices: pad the table's lane dim to
+    # a 128 multiple once (a no-op at the rank-128 headline)
+    r_pad = max(128, -(-r // 128) * 128)
+    tn, wc, w_pad = _tiles(r_pad, -(-w // 8) * 8)
+    assert wc == w_pad or (wc % 128 == 0 and w_pad % wc == 0), (wc, w_pad)
+    n_pad = -(-n // tn) * tn
+    V_p = jnp.pad(V, ((0, 0), (0, r_pad - r)))
+    # padding slots index row 0 with zero weight — contributes nothing
+    cols_p = jnp.pad(cols.astype(jnp.int32),
+                     ((0, n_pad - n), (0, w_pad - w)))
+    aw_p = jnp.pad(aw, ((0, n_pad - n), (0, w_pad - w)))
+    bw_p = jnp.pad(bw, ((0, n_pad - n), (0, w_pad - w)))
+    n_wc = w_pad // wc
+
+    from tpu_als.perf.roofline import fused_ne_kernel_bytes
+
+    db = jnp.dtype(V.dtype).itemsize
+    kernel = functools.partial(
+        _gather_gram_kernel, n_wc=n_wc, two_sided=two_sided)
+    S, b = pl.pallas_call(
+        kernel,
+        grid=(n_pad // tn, n_wc),
+        in_specs=[
+            pl.BlockSpec((tn, wc), lambda i, j: (i, j),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((tn, wc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, wc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((tn, r_pad, r_pad), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, r_pad), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, r_pad, r_pad), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, r_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tn, wc, r_pad), V.dtype),
+            pltpu.VMEM((tn, r_pad, r_pad), jnp.float32),
+            pltpu.VMEM((tn, r_pad), jnp.float32),
+            pltpu.SemaphoreType.DMA((min(_DMA_SLOTS, tn * wc),)),
+        ],
+        # bytes = THE roofline fused-stage model (perf.roofline) at the
+        # kernel's padded shapes — tests/test_ne_audit.py extracts this
+        # from the traced jaxpr and pins it to the model, the same way
+        # test_comm_audit.py pins collective bytes
+        cost_estimate=pl.CostEstimate(
+            flops=int(2.0 * n_pad * w_pad * r_pad * (r_pad + 1)),
+            bytes_accessed=fused_ne_kernel_bytes(
+                n_pad * w_pad, n_pad, r_pad, db),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(cols_p, aw_p, bw_p, V_p)
+    return S[:n, :r, :r], b[:n, :r]
+
+
+def gather_normal_eq_explicit(V, cols, vals, mask, reg, *, interpret=False):
+    """Fused-gather drop-in for ``normal_eq_explicit(V[cols], vals, mask,
+    reg)`` — same returns ``(A, b, count)``, bitwise at f32 (module
+    docstring), without ever materializing ``V[cols]`` in HBM.
+
+    The weights and the ridge tail are the reference builder's exact
+    expressions; only the gather+contraction runs in the kernel.
+    """
+    aw = mask
+    bw = vals * mask
+    S, b = gather_gram(V, cols, aw, bw, two_sided=True, interpret=interpret)
+    count = jnp.sum(mask, axis=-1)
+    r = V.shape[-1]
+    eye = jnp.eye(r, dtype=S.dtype)
+    A = S + (reg * count)[:, None, None] * eye
+    return A, b, count
+
+
+def gather_normal_eq_implicit(V, cols, vals, mask, reg, alpha, YtY, *,
+                              interpret=False):
+    """Fused-gather drop-in for ``normal_eq_implicit(V[cols], vals, mask,
+    reg, alpha, YtY)`` — same returns ``(A, b, count)``, bitwise at f32.
+
+    Confidence/preference come from the shared :func:`implicit_weights`
+    (the one site normal_eq_implicit and solve_cg_matfree also use), the
+    YtY + weighted-λ tail is the reference builder's exact expression.
+    """
+    conf_m1, pref = implicit_weights(vals, mask, alpha)
+    aw = conf_m1
+    bw = (1.0 + conf_m1) * pref * mask
+    S, b = gather_gram(V, cols, aw, bw, two_sided=False,
+                       interpret=interpret)
+    count = jnp.sum(pref * mask, axis=-1)
+    r = V.shape[-1]
+    eye = jnp.eye(r, dtype=S.dtype)
+    A = S + YtY[None] + (reg * count)[:, None, None] * eye
+    return A, b, count
+
+
+_AVAILABLE = {}
+_FASTER = {}
+
+
+def available(rank=128, compute_dtype="float32"):
+    """Compile-and-validate probe, cached per (padded rank, dtype) — the
+    probe_kernel contract (off-TPU → False; a Mosaic rejection caches
+    False so callers stay on the einsum path).  Validates BOTH kernel
+    variants (explicit/two-sided and implicit/one-sided compile different
+    bodies) against the unfused builders on a multi-row-tile,
+    multi-width-chunk instance, so a miscompile producing finite-but-wrong
+    values also fails."""
+    from tpu_als.utils.platform import probe_kernel
+
+    r_pad = max(128, -(-rank // 128) * 128)
+    cdt = str(compute_dtype)
+
+    def probe():
+        import numpy as np
+
+        from tpu_als.ops.solve import normal_eq_explicit, normal_eq_implicit
+
+        dt = jnp.dtype(cdt)
+        # >= 2 row tiles and >= 2 width chunks: exercise the accumulator
+        # revisiting across the inner grid dim and the DMA ring reuse
+        w = 256
+        while True:
+            tn, wc, w_pad = _tiles(r_pad, w)
+            if w_pad // wc >= 2:
+                break
+            w *= 2
+        n, N = 2 * tn, 3 * tn
+        rng = np.random.default_rng(0)
+        V = jnp.asarray(rng.normal(size=(N, rank)).astype(np.float32)
+                        / np.sqrt(rank)).astype(dt)
+        cols = jnp.asarray(rng.integers(0, N, size=(n, w)).astype(np.int32))
+        vals = jnp.asarray(rng.normal(size=(n, w)).astype(np.float32))
+        mask = jnp.asarray((rng.random((n, w)) < 0.8).astype(np.float32))
+        tol = dict(atol=1e-3, rtol=1e-2)
+        A, b, c = gather_normal_eq_explicit(
+            V, cols, vals.astype(dt), mask.astype(dt), 0.1)
+        Ar, br, cr = normal_eq_explicit(
+            V[cols], vals.astype(dt), mask.astype(dt), 0.1)
+        A.block_until_ready()
+        if not (np.allclose(np.asarray(A), np.asarray(Ar), **tol)
+                and np.allclose(np.asarray(b), np.asarray(br), **tol)):
+            return False
+        YtY = jnp.asarray(rng.normal(size=(rank, rank)).astype(np.float32))
+        YtY = YtY @ YtY.T / rank
+        Ai, bi, ci = gather_normal_eq_implicit(
+            V, cols, vals.astype(dt), mask.astype(dt), 0.1, 4.0, YtY)
+        Air, bir, cir = normal_eq_implicit(
+            V[cols], vals.astype(dt), mask.astype(dt), 0.1, 4.0, YtY)
+        Ai.block_until_ready()
+        return bool(np.allclose(np.asarray(Ai), np.asarray(Air), **tol)
+                    and np.allclose(np.asarray(bi), np.asarray(bir), **tol))
+
+    return probe_kernel(_AVAILABLE, (r_pad, cdt), probe)
+
+
+def faster_than_einsum(rank=128, compute_dtype="float32", n=2048, w=256,
+                       reps=3):
+    """Timing probe: True only when the fused kernel BEATS the XLA
+    gather+einsum build on a representative bucket — the auto path
+    selects the kernel on this outcome, never on availability alone
+    (the fused_pallas lesson: available ≠ faster).  Cached per process
+    via probe_kernel (off-TPU → False)."""
+    from tpu_als.utils.platform import fence, probe_kernel
+
+    r_pad = max(128, -(-rank // 128) * 128)
+    cdt = str(compute_dtype)
+
+    def probe():
+        import time
+
+        import numpy as np
+
+        from tpu_als.ops.solve import normal_eq_explicit
+
+        if not available(rank, cdt):
+            return False
+        dt = jnp.dtype(cdt)
+        rng = np.random.default_rng(0)
+        N = 4 * n
+        V = jnp.asarray(rng.normal(size=(N, rank)).astype(np.float32)
+                        / np.sqrt(rank)).astype(dt)
+        cols = jnp.asarray(rng.integers(0, N, size=(n, w)).astype(np.int32))
+        vals = jnp.asarray(rng.normal(size=(n, w)).astype(dt))
+        mask = jnp.asarray((rng.random((n, w)) < 0.9).astype(dt))
+
+        @jax.jit
+        def fused(V, cols, vals, mask):
+            return gather_normal_eq_explicit(V, cols, vals, mask, 0.1)
+
+        @jax.jit
+        def einsum(V, cols, vals, mask):
+            return normal_eq_explicit(V[cols], vals, mask, 0.1)
+
+        def best(f):
+            fence(f(V, cols, vals, mask)[0])  # compile + warm
+            t = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fence(f(V, cols, vals, mask)[0])
+                t.append(time.perf_counter() - t0)
+            return min(t)
+
+        return best(fused) < best(einsum)
+
+    return probe_kernel(_FASTER, ("speed", r_pad, cdt, n, w), probe)

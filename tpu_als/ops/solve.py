@@ -15,6 +15,16 @@ kernel-accelerated via tpu_als.ops.pallas_*), fixed-sweep NNLS
 :func:`solve_cg` on the built tensor, :func:`solve_cg_matfree` applying
 the operator straight through the gathered factor rows.
 
+The *build* side has the same exact/fused split: the einsum builds here
+consume a materialized ``Vg`` gathered by XLA, while
+:mod:`tpu_als.ops.pallas_gather_ne` DMA-gathers factor rows from the
+HBM-resident table directly into the Gram accumulation (``Vg`` never
+touches HBM — ~59% fewer modeled NE-build bytes at the headline shape,
+see docs/roofline.md). Its wrappers reuse this module's weighting
+expressions verbatim (:func:`implicit_weights`, the ``reg·count`` ridge)
+so the fused build is bitwise-equal to :func:`normal_eq_explicit` /
+:func:`normal_eq_implicit` at f32 in the single-width-chunk regime.
+
 Shapes use the padded-CSR convention from :mod:`tpu_als.core.ratings`:
 
   ``Vg``   [n, w, r]  gathered opposite-side factor rows per entity

@@ -107,7 +107,16 @@ def _prewarm(cfg: AlsConfig, matfree_capable=True):
     (tpu_als.utils.platform.probe_kernel).  Lives here — not only in
     train_sharded — so callers driving the builders directly get the
     same guarantee.  ``matfree_capable=False`` = the ring builder, whose
-    solve cannot run matrix-free (attribution resolves to dense CG)."""
+    solve cannot run matrix-free (attribution resolves to dense CG).
+
+    This also covers the DMA-gather NE kernel's availability + timing
+    probes (tpu_als.ops.pallas_gather_ne): under solve_backend='auto'
+    the gather-fused upgrade inside local_half_step reads the cached
+    outcomes this eager resolve populates — the all_gather and
+    all_to_all builders route through local_half_step and inherit the
+    kernel; the ring/chunked builders keep the einsum build (their
+    normal equations accumulate across streamed shards in tpu_als.
+    parallel.comm, which the per-bucket kernel does not model)."""
     from tpu_als.core.als import resolve_solve_path
 
     resolve_solve_path(cfg, cfg.rank, matfree_capable=matfree_capable)

@@ -1,0 +1,112 @@
+"""Normal-equation traffic audit: pin the bytes the *traced build*
+actually moves against the roofline model, straight from the jaxpr.
+
+``perf.roofline`` carries two closed-form NE-build byte models
+(``einsum_ne_build_bytes`` / ``fused_ne_kernel_bytes``).  This module
+derives the auditable parts of both from the build functions' jaxprs —
+the same validation style as ``parallel.comm_audit`` for collectives —
+so the roofline's headline claim (the gather-fused kernel deletes the
+``Vg`` round trip) is checked against what XLA is actually handed, not
+against the model's own inputs:
+
+- ``gather_out_bytes``: bytes written by ``gather`` equations (scaled by
+  enclosing ``scan`` trip counts).  For the einsum path this is exactly
+  the materialized ``Vg = V[cols]`` tensor, ``n·w·r·itemsize``; for the
+  gather-fused path it must be **zero** — the factor rows stream through
+  VMEM via in-kernel DMA and no HBM gather exists in the jaxpr.
+- ``pallas_cost_bytes``: the ``bytes_accessed`` of every ``pallas_call``
+  equation's embedded ``CostEstimate``.  The gather-fused kernel stamps
+  its estimate from ``fused_ne_kernel_bytes`` at padded shapes, so a
+  kernel/model divergence (e.g. a padding change that the model misses)
+  fails a test instead of silently mis-reporting the roofline floor.
+
+Elementwise traffic is deliberately NOT audited: XLA fuses it invisibly,
+so the jaxpr carries no truth about it.  Gathers and kernel cost stamps
+are discrete, unfusable facts — the strongest validation available
+without an on-chip profiler trace.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+
+def _aval_bytes(aval):
+    return int(np.prod(aval.shape)) * np.dtype(aval.dtype).itemsize
+
+
+def _walk(jaxpr, mult, visit):
+    """Scan-scaled traversal shared by both counters.
+
+    ``cond`` branches are rejected rather than guessed at (mirroring
+    comm_audit's data-dependent-traffic rule); no NE builder uses one.
+    ``pallas_call`` bodies are NOT descended into: everything inside the
+    kernel touches VMEM refs (a body-level gather/cond moves no HBM), and
+    the kernel's HBM traffic is exactly its cost stamp.
+    """
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        visit(eqn, mult)
+        if name == "pallas_call":
+            continue
+        if name == "scan":
+            _walk(eqn.params["jaxpr"].jaxpr,
+                  mult * int(eqn.params["length"]), visit)
+        elif name == "cond":
+            raise ValueError(
+                "gather/pallas traffic inside cond is data-dependent "
+                "and unauditable — no NE builder should branch")
+        else:
+            for p in ("jaxpr", "call_jaxpr"):
+                inner = eqn.params.get(p) if eqn.params else None
+                if inner is not None:
+                    _walk(getattr(inner, "jaxpr", inner), mult, visit)
+
+
+def gather_out_bytes(fn, *args):
+    """Bytes written by every ``gather`` equation of one traced call.
+
+    Returns ``(total_bytes, n_gathers)``.  The einsum NE path's row
+    gather is its only large one, so at bucket shapes the total equals
+    the materialized ``Vg`` exactly; small index-arithmetic gathers
+    (none exist in the builders today) would show up in ``n_gathers``.
+    """
+    closed = jax.make_jaxpr(fn)(*args)
+    total, count = 0, 0
+
+    def visit(eqn, mult):
+        nonlocal total, count
+        if eqn.primitive.name == "gather":
+            total += mult * sum(_aval_bytes(v.aval) for v in eqn.outvars)
+            count += mult
+
+    _walk(closed.jaxpr, 1, visit)
+    return int(total), int(count)
+
+
+def pallas_cost_bytes(fn, *args):
+    """Sum of ``cost_estimate.bytes_accessed`` over every ``pallas_call``
+    equation of one traced call, scan-scaled.
+
+    Returns ``(total_bytes, n_calls)``.  Raises if a pallas_call carries
+    no cost estimate — every kernel in this codebase that claims a
+    roofline stage must stamp one, or the audit has nothing to pin.
+    """
+    closed = jax.make_jaxpr(fn)(*args)
+    total, count = 0, 0
+
+    def visit(eqn, mult):
+        nonlocal total, count
+        if eqn.primitive.name == "pallas_call":
+            cost = eqn.params.get("cost_estimate")
+            if cost is None or cost.bytes_accessed is None:
+                raise ValueError(
+                    f"pallas_call {eqn.params.get('name_and_src_info')} "
+                    "has no bytes_accessed cost estimate to audit")
+            total += mult * int(cost.bytes_accessed)
+            count += mult
+
+    _walk(closed.jaxpr, 1, visit)
+    return int(total), int(count)

@@ -64,6 +64,61 @@ HEADLINE = dict(n_users=162_541, n_items=59_047, nnz=25_000_095,
 HEADLINE_MEASURED_S_PER_ITER = 1.184
 
 
+def fused_ne_kernel_bytes(P, n, r, db):
+    """HBM bytes the gather-fused NE kernel
+    (tpu_als.ops.pallas_gather_ne) moves for one half-step over ``P``
+    padded entries / ``n`` solved rows: each entry's factor row read ONCE
+    straight into VMEM (never written back as a gathered intermediate),
+    the cols (int32) + aw/bw weight streams, and the A/b outputs.
+
+    THE single source of truth shared by the roofline's fused stage
+    below, the kernel's ``pl.CostEstimate``, and the traced-jaxpr audit
+    (tests/test_ne_audit.py extracts the estimate from the trace and pins
+    it to this formula — the test_comm_audit.py pattern).
+    """
+    return int(P * r * db + P * (4 + 2 * db) + n * r * r * 4 + n * r * 4)
+
+
+def einsum_ne_build_bytes(P, n, r, db, restream=1.0):
+    """Modeled NE-build bytes of the UNFUSED path (gather_stream +
+    normal_eq stages below, summed): the gather reads one factor row per
+    padded entry and writes the [n, w, r] intermediate, the cols/vals/
+    mask stream rides along, and the einsum re-reads the gathered rows
+    and writes A.  The fused-vs-einsum byte-reduction claim
+    (docs/roofline.md; pinned ≥40% at the headline config in
+    tests/test_ne_audit.py) is this minus :func:`fused_ne_kernel_bytes`.
+    """
+    return int(restream * (2.0 * P * r * db) + 12.0 * P
+               + P * r * db + n * r * r * 4.0)
+
+
+def modeled_padding_waste(counts, min_width=8, chunk_elems=1 << 19,
+                          growth=2.0):
+    """padded_nnz / nnz for a degree distribution, derived from the SAME
+    width-assignment + row-padding helpers the builder uses
+    (tpu_als.core.ratings.entity_widths / padded_bucket_rows) — no bucket
+    arrays are built, so this prices ML-25M-scale layouts instantly.
+    Cross-checked against an actual ``build_csr_buckets`` run in
+    tests/test_roofline.py (replaces the hardcoded 1.514 caller constant;
+    the constant survives as an explicit override).
+    """
+    import numpy as np
+
+    from tpu_als.core.ratings import entity_widths, padded_bucket_rows
+
+    counts = np.asarray(counts, dtype=np.int64)
+    nnz = int(counts.sum())
+    rated = counts[counts > 0]
+    if not nnz or not len(rated):
+        return 1.0
+    w = entity_widths(rated, min_width, growth)
+    padded = 0
+    for wv in sorted(set(w.tolist())):
+        nb = int((w == wv).sum())
+        padded += padded_bucket_rows(nb, int(wv), chunk_elems) * int(wv)
+    return padded / nnz
+
+
 @dataclass
 class Stage:
     name: str
@@ -98,10 +153,13 @@ def _dtype_bytes(dtype):
 
 
 def roofline(n_users, n_items, nnz, rank, *, dtype="float32",
-             implicit=True, padding_waste=1.0, devices=1,
+             implicit=True, padding_waste=None, devices=1,
              strategy=None, tiles_user=1, tiles_item=1,
              comm_bytes=None, user_part=None, item_part=None,
              user_container=None, item_container=None,
+             user_counts=None, item_counts=None,
+             min_width=8, chunk_elems=1 << 19, width_growth=2.0,
+             ne_path="einsum",
              hbm_gbps=V5E_HBM_GBPS, ici_gbps=V5E_ICI_GBPS,
              measured_s_per_iter=None):
     """Analytical per-stage roofline for one full ALS iteration.
@@ -110,6 +168,17 @@ def roofline(n_users, n_items, nnz, rank, *, dtype="float32",
     gather/NE stream), ``strategy`` + chunking (``tiles_user`` /
     ``tiles_item`` row-tile counts — the ring and chunked-gather
     strategies re-stream the opposite factors once per tile).
+
+    ``ne_path``: 'einsum' prices the unfused build (gather_stream +
+    normal_eq stages); 'gather_fused' prices the DMA-gather kernel
+    (tpu_als.ops.pallas_gather_ne) — one fused stage reading each factor
+    row ONCE and writing A/b, the :func:`fused_ne_kernel_bytes` model.
+
+    ``padding_waste``: explicit override; when None it is DERIVED from
+    the per-entity degree arrays ``user_counts``/``item_counts`` via
+    :func:`modeled_padding_waste` (the builder's own width assignment at
+    ``min_width``/``chunk_elems``/``width_growth``), falling back to 1.0
+    when no counts are given.
 
     Collective bytes: pass ``comm_bytes`` directly, or the built
     partitions/containers (``user_part``/``item_part`` +
@@ -127,6 +196,21 @@ def roofline(n_users, n_items, nnz, rank, *, dtype="float32",
     peak = V5E_F32_PEAK_FLOPS if db == 4 else V5E_BF16_PEAK_FLOPS
     hbm = hbm_gbps * 1e9
     ici = ici_gbps * 1e9
+    if ne_path not in ("einsum", "gather_fused"):
+        raise ValueError(f"unknown ne_path {ne_path!r} "
+                         "(expected 'einsum' or 'gather_fused')")
+    padding_waste_source = "explicit"
+    if padding_waste is None:
+        if user_counts is not None or item_counts is not None:
+            sides = [c for c in (user_counts, item_counts) if c is not None]
+            padding_waste = sum(
+                modeled_padding_waste(c, min_width, chunk_elems,
+                                      width_growth)
+                for c in sides) / len(sides)
+            padding_waste_source = "derived"
+        else:
+            padding_waste = 1.0
+            padding_waste_source = "default"
 
     # per-device padded entries over BOTH half-steps; solved rows and
     # opposite-table rows per device
@@ -138,17 +222,30 @@ def roofline(n_users, n_items, nnz, rank, *, dtype="float32",
     if strategy in ("ring", "ring_overlap", "all_gather_chunked"):
         restream = (float(tiles_user) + float(tiles_item)) / 2.0
 
-    stages = [
-        Stage("gather_stream",
-              bytes=restream * (2.0 * P * r * db) + 12.0 * P,
-              flops=0.0, bw=hbm, peak=peak,
-              note="opposite factor rows read+written per padded entry "
-                   "+ cols/vals/mask stream"),
-        Stage("normal_eq",
-              bytes=P * r * db + n * r * r * 4.0,
-              flops=2.0 * P * r * r + 2.0 * P * r,
-              bw=hbm, peak=peak,
-              note="einsum re-reads gathered rows, writes [n,r,r] A"),
+    if ne_path == "gather_fused":
+        ne_stages = [Stage(
+            "gather_fused_ne",
+            bytes=(fused_ne_kernel_bytes(P, n, r, db)
+                   + (restream - 1.0) * P * r * db),
+            flops=2.0 * P * r * r + 2.0 * P * r,
+            bw=hbm, peak=peak,
+            note="DMA-gather kernel: factor rows read ONCE into VMEM, "
+                 "A/b written — Vg never in HBM "
+                 "(ops/pallas_gather_ne)")]
+    else:
+        ne_stages = [
+            Stage("gather_stream",
+                  bytes=restream * (2.0 * P * r * db) + 12.0 * P,
+                  flops=0.0, bw=hbm, peak=peak,
+                  note="opposite factor rows read+written per padded "
+                       "entry + cols/vals/mask stream"),
+            Stage("normal_eq",
+                  bytes=P * r * db + n * r * r * 4.0,
+                  flops=2.0 * P * r * r + 2.0 * P * r,
+                  bw=hbm, peak=peak,
+                  note="einsum re-reads gathered rows, writes [n,r,r] A"),
+        ]
+    stages = ne_stages + [
         Stage("solve",
               bytes=n * (r * r + 2.0 * r) * 4.0,
               flops=n * (2.0 * r ** 3 / 3.0 + 4.0 * r * r),
@@ -208,7 +305,10 @@ def roofline(n_users, n_items, nnz, rank, *, dtype="float32",
             "n_users": int(n_users), "n_items": int(n_items),
             "nnz": int(nnz), "rank": r, "dtype": str(dtype),
             "implicit": bool(implicit),
-            "padding_waste": float(padding_waste), "devices": D,
+            "padding_waste": float(padding_waste),
+            "padding_waste_source": padding_waste_source,
+            "width_growth": float(width_growth),
+            "ne_path": ne_path, "devices": D,
             "strategy": strategy,
             "tiles_user": int(tiles_user), "tiles_item": int(tiles_item),
             "hbm_gbps": float(hbm_gbps), "ici_gbps": float(ici_gbps),
@@ -236,9 +336,13 @@ def roofline(n_users, n_items, nnz, rank, *, dtype="float32",
     return report
 
 
-def headline_roofline():
-    """The roofline of BASELINE.md row 2 with its measured point."""
-    return roofline(**HEADLINE,
+def headline_roofline(**overrides):
+    """The roofline of BASELINE.md row 2 with its measured point.
+
+    ``headline_roofline(ne_path='gather_fused')`` prices the same config
+    on the DMA-gather kernel — the revised floor docs/roofline.md quotes.
+    """
+    return roofline(**{**HEADLINE, **overrides},
                     measured_s_per_iter=HEADLINE_MEASURED_S_PER_ITER)
 
 
@@ -249,7 +353,9 @@ def render(report):
         ("ALS iteration roofline — "
          f"{c['n_users']}x{c['n_items']} nnz={c['nnz']} rank={c['rank']} "
          f"{c['dtype']} {'implicit' if c['implicit'] else 'explicit'} "
-         f"waste={c['padding_waste']} D={c['devices']}"
+         f"waste={c['padding_waste']:.3f}"
+         f" ({c.get('padding_waste_source', 'explicit')})"
+         f" ne={c.get('ne_path', 'einsum')} D={c['devices']}"
          + (f" strategy={c['strategy']}" if c["strategy"] else "")),
         f"(HBM {c['hbm_gbps']} GB/s, ICI {c['ici_gbps']} GB/s, v5e)",
         "",

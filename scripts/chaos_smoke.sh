@@ -8,10 +8,12 @@
 #   2. the static obs-schema check (the resilience event vocabulary —
 #      retry_attempt, fault_injected, preempted, ... — must stay
 #      declared),
-#   3. one END-TO-END kill-and-resume train: preempt the CLI at an
-#      iteration boundary (deterministic TPU_ALS_PREEMPT_AT knob),
-#      expect the distinct exit code 43, resume with --resume auto,
-#      expect success.
+#   3. one END-TO-END kill-and-resume train via the scenario harness
+#      (`tpu_als scenario run preempt-resume` — the ONE implementation
+#      of this flow, shared with tests/test_scenarios.py): preempt the
+#      CLI at an iteration boundary (deterministic TPU_ALS_PREEMPT_AT
+#      knob), assert the distinct exit code 43, resume with
+#      --resume auto, assert success + checkpoint discovery.
 #
 # Usage: scripts/chaos_smoke.sh   (from the repo root; ~1 min on CPU)
 set -u
@@ -27,32 +29,11 @@ python -m pytest tests/test_resilience.py tests/test_resume.py \
 echo "== chaos smoke 2/3: obs schema (static) =="
 python scripts/check_obs_schema.py || fail=1
 
-echo "== chaos smoke 3/3: end-to-end kill-and-resume =="
-work=$(mktemp -d)
-trap 'rm -rf "$work"' EXIT
-train=(python -m tpu_als.cli train --data synthetic:80x40x1500
-       --rank 4 --max-iter 6 --reg-param 0.05 --seed 7
-       --checkpoint-dir "$work/ck")
-
-TPU_ALS_PREEMPT_AT=3 "${train[@]}" 2>"$work/preempt.log"
-rc=$?
-if [ "$rc" -ne 43 ]; then
-    echo "FAIL: preempted train exited $rc, expected 43" >&2
-    tail -5 "$work/preempt.log" >&2
-    fail=1
-fi
-
-"${train[@]}" --resume auto --output "$work/model" 2>"$work/resume.log"
-rc=$?
-if [ "$rc" -ne 0 ] || [ ! -f "$work/model/manifest.json" ]; then
-    echo "FAIL: resumed train exited $rc (model present: $([ -f "$work/model/manifest.json" ] && echo yes || echo no))" >&2
-    tail -5 "$work/resume.log" >&2
-    fail=1
-fi
-grep -q "resuming from" "$work/resume.log" || {
-    echo "FAIL: resume did not discover the preemption checkpoint" >&2
-    fail=1
-}
+echo "== chaos smoke 3/3: end-to-end kill-and-resume (scenario) =="
+# the preempt-resume scenario asserts exit code 43 on the preempted
+# train, exit 0 + "resuming from" discovery + saved manifest.json on
+# the --resume auto rerun (tpu_als/scenario/library.py)
+python -m tpu_als.cli scenario run preempt-resume || fail=1
 
 if [ "$fail" -ne 0 ]; then
     echo "chaos smoke: FAIL" >&2

@@ -1006,6 +1006,69 @@ def cmd_observe(args):
         raise SystemExit(str(err))
 
 
+def cmd_scenario(args):
+    """Run (or list) a production-day scenario — composed chaos over
+    train + serve + stream with hard assertions judged from the obs
+    trail (tpu_als.scenario; docs/scenarios.md)."""
+    from tpu_als import scenario
+
+    if args.action == "list":
+        for name in scenario.names():
+            spec = scenario.SCENARIOS[name]
+            chaos = f"  [faults: {spec.fault_spec}]" if spec.fault_spec \
+                else ""
+            print(f"{name}{chaos}")
+            print(f"    {' '.join(spec.doc.split())}")
+            for p in spec.phases:
+                print(f"      - {p.name}: {p.doc}")
+        return
+
+    try:
+        spec = scenario.get_scenario(args.name)
+    except scenario.UnknownScenario as e:
+        print(f"tpu_als scenario: {e}", file=sys.stderr)
+        raise SystemExit(2) from e
+    overrides = {"slo_ms": args.slo_ms,
+                 "freshness_slo_ms": args.freshness_slo_ms,
+                 "seed": args.seed}
+    try:
+        result = scenario.run_scenario(spec, config=overrides)
+    except scenario.PhaseFailed as e:
+        # harness breakage (a phase body raised), as opposed to a judged
+        # assertion failure — still one clean line, still non-zero
+        print(f"tpu_als scenario: {e}", file=sys.stderr)
+        raise SystemExit(1) from e
+    print(scenario.render_result(result))
+    if args.as_json:
+        print(json.dumps(result, default=str))
+    if args.bench_json:
+        scenario.bank_result(result, args.bench_json)
+        print(f"banked {args.bench_json}", file=sys.stderr)
+    if not result["passed"]:
+        raise SystemExit(1)
+
+
+def _validate_fault_spec():
+    """Fail LOUDLY (typed one-liner, exit 2) on an unparseable
+    ``TPU_ALS_FAULT_SPEC`` before any command body imports the faults
+    module — whose import-time ``install_from_env()`` would otherwise
+    surface the same mistake as a raw traceback mid-command."""
+    import os
+
+    spec = os.environ.get("TPU_ALS_FAULT_SPEC", "").strip()
+    if not spec:
+        return
+    try:
+        # the import itself arms (and validates) the env spec
+        from tpu_als.resilience import faults
+
+        faults.parse_spec(spec)
+    except ValueError as e:   # FaultSpecError subclasses ValueError
+        print(f"tpu_als: FaultSpecError: TPU_ALS_FAULT_SPEC is "
+              f"unparseable: {e}", file=sys.stderr)
+        raise SystemExit(2) from e
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="tpu_als")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -1194,6 +1257,36 @@ def main(argv=None):
                          "provenance) here, e.g. BENCH_serve_cpu.json")
     sb.set_defaults(fn=cmd_serve_bench)
 
+    sc = sub.add_parser(
+        "scenario",
+        help="scripted production-day scenarios: composed chaos over "
+             "train + serve + stream, judged by hard assertions "
+             "evaluated from the obs trail (docs/scenarios.md)")
+    scsub = sc.add_subparsers(dest="action", required=True)
+    scr = scsub.add_parser(
+        "run", help="run one named scenario; exit 0 only if every "
+                    "assertion holds", parents=[obs_common])
+    scr.add_argument("name",
+                     help="scenario name (see `tpu_als scenario list`)")
+    scr.add_argument("--slo-ms", type=float, default=None,
+                     help="override the latency-SLO bound scenarios "
+                          "judge p99 against (traffic-spike)")
+    scr.add_argument("--freshness-slo-ms", type=float, default=None,
+                     help="override the rating-arrival -> servable "
+                          "bound (cold-start)")
+    scr.add_argument("--seed", type=int, default=None,
+                     help="override the scenario's default seed")
+    scr.add_argument("--bench-json", default=None, metavar="PATH",
+                     help="also bank the result JSON (with banked_at "
+                          "provenance) here, e.g. "
+                          "BENCH_scenario_traffic-spike.json")
+    scr.add_argument("--json", dest="as_json", action="store_true",
+                     help="also print the result as one JSON object")
+    scr.set_defaults(fn=cmd_scenario)
+    scl = scsub.add_parser(
+        "list", help="list the scenarios, their chaos and their phases")
+    scl.set_defaults(fn=cmd_scenario, obs_dir=None)
+
     f = sub.add_parser("foldin-bench", help="fold-in latency micro-benchmark",
                        parents=[obs_common])
     f.add_argument("--model", required=True)
@@ -1256,6 +1349,7 @@ def main(argv=None):
     os3.set_defaults(fn=cmd_observe)
 
     args = ap.parse_args(argv)
+    _validate_fault_spec()
     if getattr(args, "nonnegative", False) and \
             getattr(args, "cg_iters", 0) > 0:
         # solver precedence is nonnegative (NNLS) > cg (core/als.py);

@@ -92,6 +92,10 @@ METRICS = {
     "serving.publishes": (
         "counter", "publishes",
         "model generations atomically swapped into the serving engine"),
+    "scenario.freshness_seconds": (
+        "histogram", "seconds",
+        "cold-start scenario: rating-arrival -> servable latency (fold-"
+        "in + republish + first successful recommend for a NEW user)"),
 }
 
 # event type -> (required fields beyond ts/type, help text).  Extra
@@ -161,6 +165,21 @@ EVENTS = {
         ("counters", "gauges", "histograms"),
         "final registry state, appended once by finalize() so the JSONL "
         "alone reconstructs every counter/gauge/histogram"),
+    "scenario_start": (
+        ("scenario", "phases"),
+        "a scenario run began: its name, phase list, and effective "
+        "config (tpu_als.scenario.runner)"),
+    "scenario_phase": (
+        ("scenario", "phase", "seconds"),
+        "one scenario phase completed, with its wall-clock seconds"),
+    "scenario_assert": (
+        ("scenario", "check", "ok", "observed", "expected"),
+        "one scenario assertion judged: observed value vs bound (the "
+        "verdict is re-derivable from these events alone)"),
+    "scenario_end": (
+        ("scenario", "passed", "seconds"),
+        "a scenario run finished (or aborted on a phase failure, with "
+        "an extra 'error' field): the verdict and total seconds"),
 }
 
 

@@ -312,4 +312,18 @@ def _emit_fired(rule):
         pass  # chaos instrumentation must never mask the chaos itself
 
 
-install_from_env()
+try:
+    install_from_env()
+except FaultSpecError as _e:
+    # the import-time arm must not kill every importer of the package
+    # with a traceback (pytest collection, library embedders) — but an
+    # unparseable spec silently disarming chaos would be worse.  Leave
+    # the harness DISARMED with a warning nobody can miss; the CLI front
+    # door (cli._validate_fault_spec) re-parses and exits loudly with
+    # the typed error before any command body runs.  Explicit
+    # install_from_env()/install() calls still raise.
+    import warnings as _warnings
+
+    _warnings.warn(
+        f"{ENV_VAR} is unparseable and was IGNORED (faults disarmed): "
+        f"{_e}", RuntimeWarning)

@@ -1,0 +1,39 @@
+"""Production-day scenario harness: composed chaos with hard assertions.
+
+The robustness primitives (fault injection, preemption, degraded
+serving, fold-in, checkpoint resume) are each proven in isolation;
+this package composes them into named, scripted end-to-end scenarios —
+``tpu_als scenario run <name>`` — whose pass/fail verdicts are
+evaluated from the obs metrics/events the run emits.  See
+docs/scenarios.md.
+"""
+
+from tpu_als.scenario.library import SCENARIOS, get_scenario, names
+from tpu_als.scenario.runner import bank_result, render_result, run_scenario
+from tpu_als.scenario.spec import (
+    Assertion,
+    Phase,
+    PhaseFailed,
+    RunContext,
+    ScenarioError,
+    ScenarioFailed,
+    ScenarioSpec,
+    UnknownScenario,
+)
+
+__all__ = [
+    "Assertion",
+    "Phase",
+    "PhaseFailed",
+    "RunContext",
+    "SCENARIOS",
+    "ScenarioError",
+    "ScenarioFailed",
+    "ScenarioSpec",
+    "UnknownScenario",
+    "bank_result",
+    "get_scenario",
+    "names",
+    "render_result",
+    "run_scenario",
+]

@@ -117,7 +117,8 @@ def tpu_ready(attempts=6, wait_s=90, probe_timeout_s=120, budget_s=0):
     retry = _load_retry_module()
     code = "import jax; d = jax.devices(); print(len(d), d[0].device_kind)"
     events = []
-    deadline = (time.monotonic() + budget_s) if budget_s else None
+    t_start = time.monotonic()
+    deadline = (t_start + budget_s) if budget_s else None
 
     def _remaining():
         return deadline - time.monotonic() if deadline else float("inf")
@@ -160,6 +161,19 @@ def tpu_ready(attempts=6, wait_s=90, probe_timeout_s=120, budget_s=0):
         # just discover the exhaustion one full wait later
         time.sleep(max(0.0, min(seconds, _remaining())))
 
+    def exhausted(reason):
+        # the TERMINAL record after the per-attempt bench_retry trail:
+        # the probe gave up for good (tpu_als.obs.schema
+        # 'bench_probe_exhausted' shape) — the BENCH_r05 failure mode
+        # now ends with a machine-readable verdict, not a silent null
+        ev = {"ts": round(time.time(), 6), "type": "bench_probe_exhausted",
+              "attempts": attempts,
+              "elapsed_seconds": round(time.monotonic() - t_start, 3),
+              "reason": reason}
+        events.append(ev)
+        log(json.dumps(ev))
+        return reason
+
     policy = retry.RetryPolicy(max_attempts=attempts, base_delay=wait_s,
                                factor=1.0, max_delay=wait_s, jitter=0.0,
                                sleep=budget_sleep)
@@ -168,16 +182,17 @@ def tpu_ready(attempts=6, wait_s=90, probe_timeout_s=120, budget_s=0):
                          on_attempt=on_attempt)
         return True, "", events
     except retry.RetryExhausted as e:
-        return False, str(e.last), events
+        return False, exhausted(str(e.last)), events
     except ProbeBudgetExhausted as e:
         # RuntimeError is outside the policy's retry_on, so it lands
-        # here directly; record it as one final structured event
+        # here directly; record the attempt that hit the wall, then the
+        # terminal verdict
         ev = {"ts": round(time.time(), 6), "type": "bench_retry",
               "attempt": len(events) + 1, "attempts": attempts,
               "elapsed_seconds": round(budget_s, 3), "reason": str(e)}
         events.append(ev)
         log(json.dumps(ev))
-        return False, str(e), events
+        return False, exhausted(str(e)), events
 
 
 # headline sweep step -> the flag overrides it measured

@@ -1,0 +1,40 @@
+#!/bin/bash
+# Bench regression gate: validate the committed BENCH_*.json /
+# MULTICHIP_*.json result banks and fail CI on quality drift.  Checks
+# (tpu_als/obs/regress.py — pure stdlib):
+#
+#   - the LATEST round of every bench series against the best prior
+#     round, beyond a noise band (default 10%, unit-direction aware),
+#   - ``value: null`` banks with no sweep-fallback recovery,
+#   - multichip rounds whose latest attempt is not ok,
+#   - direct banks missing tz-aware ``banked_at`` provenance.
+#
+# regress.py is loaded STANDALONE (importlib by file path), not through
+# the tpu_als package, so the gate runs on hosts with no jax at all —
+# `tpu_als observe regress` is the same logic behind the full CLI.
+#
+# Typed exit codes:  0 OK   1 REGRESSION   2 NULL BANK   3 PROVENANCE
+#
+# Usage: scripts/bench_gate.sh [root] [--noise F] [--strict] [--json]
+#        (root defaults to the repo root — the committed banks)
+set -u
+
+cd "$(dirname "$0")/.."
+exec python -c '
+import argparse, importlib.util, json, os, sys
+
+spec = importlib.util.spec_from_file_location(
+    "tpu_als_obs_regress", os.path.join("tpu_als", "obs", "regress.py"))
+regress = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(regress)
+
+ap = argparse.ArgumentParser(prog="bench_gate.sh")
+ap.add_argument("root", nargs="?", default=".")
+ap.add_argument("--noise", type=float, default=0.10)
+ap.add_argument("--strict", action="store_true")
+ap.add_argument("--json", action="store_true")
+a = ap.parse_args()
+result = regress.check(a.root, noise=a.noise, strict=a.strict)
+print(json.dumps(result) if a.json else regress.render(result))
+sys.exit(result["exit_code"])
+' "$@"

@@ -13,6 +13,16 @@ probe) and fails when a LITERAL name is not declared in
 non-literal outside ``tpu_als/obs/`` itself (a computed name defeats
 the static check — route it through a declared vocabulary instead).
 
+Beyond the emit sites, the pass also covers the READ side — the
+``histogram_quantile / histogram_count / counter_value`` accessors
+skip the registry's call-time schema check (they can't mint a series,
+so a typo'd name silently reads NaN/0 forever) — and the scenario
+layer's declarative ``Assertion(metric= / event= / num= / den=)``
+literals, which only meet the registry indirectly at evaluation time.
+Non-literal names are a violation for WRITE methods only; dynamic
+reads (the scenario evaluator resolving declared assertion fields) are
+allowed because their literals are validated at the declaration site.
+
 Run directly (exit 1 + file:line diagnostics on violation) or from the
 tier-1 suite (tests/test_obs.py).  ``--paths`` overrides the scanned
 tree (the negative test exercises the failure mode on a fixture file).
@@ -33,11 +43,29 @@ sys.path.insert(0, REPO)
 
 from tpu_als.obs import schema  # noqa: E402
 
-# a counter/gauge/histogram/emit call with either a literal first
-# argument (named groups q/name) or anything else (group expr)
+# a counter/gauge/histogram/emit (write) or quantile/count/value (read
+# accessor) call with either a literal first argument (named groups
+# q/name) or anything else (group expr); longest alternatives first so
+# 'histogram_quantile' never half-matches as 'histogram'
 CALL_RE = re.compile(
-    r"\.(?P<method>counter|gauge|histogram|emit)\(\s*"
+    r"\.(?P<method>histogram_quantile|histogram_count|histogram"
+    r"|counter_value|counter|gauge|emit)\(\s*"
     r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<expr>[^)\s][^),]*))")
+
+# accessor method -> the metric kind its name must be declared as; a
+# non-literal name is allowed for these (read-only: can't mint a series)
+ACCESSOR_KIND = {"histogram_quantile": "histogram",
+                 "histogram_count": "histogram",
+                 "counter_value": "counter"}
+
+# scenario-spec literals: Assertion(metric=/event=/num=/den=) bind to
+# the registry only at evaluation time — validate them where declared.
+# "$key"-prefixed values resolve from scenario config, not the schema.
+ASSERT_KW_RE = re.compile(
+    r"\b(?P<kw>metric|event|num)\s*=\s*"
+    r"(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)")
+ASSERT_DEN_RE = re.compile(r"\bden\s*=\s*\((?P<body>[^)]*)\)")
+_STR_RE = re.compile(r"['\"]([^'\"]+)['\"]")
 
 # inline event dicts: a line carrying both a "ts" key and a literal
 # "type" value (the hand-built shape allowed where importing tpu_als is
@@ -60,6 +88,24 @@ def _py_files(paths):
                         yield os.path.join(root, name)
 
 
+def _assertion_blocks(text):
+    """Yield (start_pos, block_text) for every ``Assertion(...)`` call,
+    matched by paren balance (good enough for our code: no parens inside
+    the string literals these blocks carry)."""
+    for m in re.finditer(r"\bAssertion\s*\(", text):
+        start = m.end() - 1
+        depth = 0
+        for i in range(start, min(len(text), start + 4000)):
+            ch = text[i]
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    yield m.start(), text[start:i + 1]
+                    break
+
+
 def check_file(path):
     errors = []
     with open(path, encoding="utf-8") as f:
@@ -76,7 +122,7 @@ def check_file(path):
         method, name = m.group("method"), m.group("name")
         where = f"{rel}:{line_of(m.start())}"
         if name is None:
-            if not in_obs:
+            if not in_obs and method not in ACCESSOR_KIND:
                 errors.append(
                     f"{where}: {method}() with a non-literal name "
                     f"({m.group('expr').strip()!r}) — the static check "
@@ -89,15 +135,42 @@ def check_file(path):
                     f"{where}: emit of undeclared event type {name!r} "
                     "(declare it in tpu_als.obs.schema.EVENTS)")
         else:
+            want_kind = ACCESSOR_KIND.get(method, method)
             decl = schema.METRICS.get(name)
             if decl is None:
                 errors.append(
                     f"{where}: {method} of undeclared metric {name!r} "
                     "(declare it in tpu_als.obs.schema.METRICS)")
-            elif decl[0] != method:
+            elif decl[0] != want_kind:
                 errors.append(
                     f"{where}: metric {name!r} is declared as a "
-                    f"{decl[0]}, used as a {method}")
+                    f"{decl[0]}, used as a {want_kind} ({method})")
+
+    for pos, block in _assertion_blocks(text):
+        where = f"{rel}:{line_of(pos)}"
+        for m in ASSERT_KW_RE.finditer(block):
+            kw, name = m.group("kw"), m.group("name")
+            if name.startswith("$"):     # resolved from scenario config
+                continue
+            if kw == "event":
+                if name not in schema.EVENTS:
+                    errors.append(
+                        f"{where}: Assertion(event={name!r}) names an "
+                        "undeclared event type (declare it in "
+                        "tpu_als.obs.schema.EVENTS)")
+            elif name not in schema.METRICS:
+                errors.append(
+                    f"{where}: Assertion({kw}={name!r}) names an "
+                    "undeclared metric (declare it in "
+                    "tpu_als.obs.schema.METRICS)")
+        for m in ASSERT_DEN_RE.finditer(block):
+            for name in _STR_RE.findall(m.group("body")):
+                if not name.startswith("$") \
+                        and name not in schema.METRICS:
+                    errors.append(
+                        f"{where}: Assertion(den=...) entry {name!r} is "
+                        "not a declared metric (declare it in "
+                        "tpu_als.obs.schema.METRICS)")
 
     for lineno, line in enumerate(text.splitlines(), 1):
         if not INLINE_TS_RE.search(line):

@@ -1,16 +1,20 @@
 #!/bin/bash
 # Scenario smoke: the production-day harness's CI gate, CPU-only (no
-# accelerator, no network).  Three stages, fail-fast:
+# accelerator, no network).  Four stages, fail-fast:
 #
 #   1. the scenario test tier (tests/test_scenarios.py — harness
 #      mechanics, error paths, degraded-serving coverage, the pytest
 #      port of kill-and-resume),
 #   2. the static obs-schema check (the scenario_* event vocabulary
-#      must stay declared),
+#      AND the scenario Assertion(metric=/event=) literals must stay
+#      declared),
 #   3. every named scenario run END TO END through the real CLI —
 #      composed chaos over train + serve + stream, each judged by its
 #      own hard assertions evaluated from the obs trail; any FAIL
-#      verdict exits non-zero.
+#      verdict exits non-zero,
+#   4. the bench regression gate over the committed result banks
+#      (scripts/bench_gate.sh — regressions, null banks, missing
+#      provenance all exit non-zero).
 #
 # Usage: scripts/scenario_smoke.sh   (from the repo root; ~2 min on CPU)
 set -u
@@ -19,14 +23,14 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 fail=0
 
-echo "== scenario smoke 1/3: scenario test tier =="
+echo "== scenario smoke 1/4: scenario test tier =="
 python -m pytest tests/test_scenarios.py -q -m 'not slow' \
     -p no:cacheprovider || fail=1
 
-echo "== scenario smoke 2/3: obs schema (static) =="
+echo "== scenario smoke 2/4: obs schema (static) =="
 python scripts/check_obs_schema.py || fail=1
 
-echo "== scenario smoke 3/3: every scenario, end to end =="
+echo "== scenario smoke 3/4: every scenario, end to end =="
 names=$(python -m tpu_als.cli scenario list | grep -v '^ ' \
         | cut -d' ' -f1)
 if [ -z "$names" ]; then
@@ -41,6 +45,9 @@ for name in $names; do
         break        # fail-fast: later scenarios would bury the verdict
     }
 done
+
+echo "== scenario smoke 4/4: bench regression gate =="
+bash scripts/bench_gate.sh || fail=1
 
 if [ "$fail" -ne 0 ]; then
     echo "scenario smoke: FAIL" >&2

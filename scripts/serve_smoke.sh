@@ -1,6 +1,6 @@
 #!/bin/bash
 # Serving smoke: the online-serving subsystem's CI gate, CPU-only (no
-# accelerator, no network).  Three stages, fail-fast:
+# accelerator, no network).  Four stages, fail-fast:
 #
 #   1. the serving test tier — int8-index bitwise property sweep,
 #      admission queue, engine loop, serving fault points, and the
@@ -11,7 +11,10 @@
 #   3. one END-TO-END open-loop serve-bench: 5 seconds of synthetic
 #      load on CPU against a loose SLO, the result banked with
 #      banked_at provenance and sanity-checked (non-empty histograms,
-#      SLO met, nothing shed).
+#      SLO met, nothing shed),
+#   4. the bench regression gate over the committed result banks
+#      (scripts/bench_gate.sh — regressions, null banks, missing
+#      provenance all exit non-zero).
 #
 # Usage: scripts/serve_smoke.sh   (from the repo root; ~1 min on CPU)
 set -u
@@ -20,14 +23,14 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 fail=0
 
-echo "== serve smoke 1/3: serving test tier =="
+echo "== serve smoke 1/4: serving test tier =="
 python -m pytest tests/test_serving.py tests/test_serve_sharded.py \
     tests/test_topk_foldin.py -q -m 'not slow' -p no:cacheprovider || fail=1
 
-echo "== serve smoke 2/3: obs schema (static) =="
+echo "== serve smoke 2/4: obs schema (static) =="
 python scripts/check_obs_schema.py || fail=1
 
-echo "== serve smoke 3/3: end-to-end open-loop serve-bench =="
+echo "== serve smoke 3/4: end-to-end open-loop serve-bench =="
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 python -m tpu_als.cli serve-bench \
@@ -63,6 +66,9 @@ print(f"serve-bench: p50={r['p50_ms']}ms p99={r['value']}ms "
 sys.exit(1 if problems else 0)
 EOF
 fi
+
+echo "== serve smoke 4/4: bench regression gate =="
+bash scripts/bench_gate.sh || fail=1
 
 if [ "$fail" -ne 0 ]; then
     echo "serve smoke: FAIL" >&2

@@ -1,0 +1,227 @@
+"""Stage attribution (tpu_als/perf/attribution.py + obs/trace.py +
+``observe attribution``).
+
+The contracts under test, in acceptance order:
+
+- the decomposed fence-timed twin computes the SAME iteration as the
+  production fused step (bitwise factors),
+- disarmed (the default), the attribution machinery leaves the
+  production step's jaxpr byte-for-byte unchanged and records nothing
+  — the "<2% overhead when disabled" bound pinned structurally,
+- armed, ``core.als.train`` swaps in the twin and per-stage seconds
+  land in ``train.stage_seconds{stage=...}`` histograms,
+- ``measure_attributed`` coverage (sum of stages / wall) clears the
+  acceptance bound, and the report joins measured seconds against the
+  roofline floor by stage name.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_als import obs
+from tpu_als.cli import main as cli_main
+from tpu_als.core.als import AlsConfig, init_factors, make_step, train
+from tpu_als.core.ratings import build_csr_buckets
+from tpu_als.obs import trace
+from tpu_als.perf import attribution
+from tpu_als.perf.attribution import AttributionUnsupported
+from tpu_als.perf.roofline import roofline
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    obs.reset()
+    trace.disable_stage_attribution()
+    yield
+    obs.reset()
+    trace.disable_stage_attribution()
+
+
+def _problem(nU=300, nI=200, nnz=5000, seed=0):
+    gen = np.random.default_rng(seed)
+    u = gen.integers(0, nU, nnz)
+    i = gen.integers(0, nI, nnz)
+    r = gen.uniform(0.5, 5.0, nnz).astype(np.float32)
+    ucsr = build_csr_buckets(u, i, r, nU, min_width=4, chunk_elems=1 << 12)
+    icsr = build_csr_buckets(i, u, r, nI, min_width=4, chunk_elems=1 << 12)
+    return ucsr, icsr
+
+
+def _factors(cfg, nU, nI):
+    ku, kv = jax.random.split(jax.random.PRNGKey(cfg.seed))
+    return init_factors(ku, nU, cfg.rank), init_factors(kv, nI, cfg.rank)
+
+
+# -- the twin computes the production iteration ----------------------------
+
+@pytest.mark.parametrize("implicit", [True, False])
+def test_attributed_step_matches_production_bitwise(implicit):
+    ucsr, icsr = _problem()
+    cfg = AlsConfig(rank=8, implicit_prefs=implicit)
+    nU, nI = ucsr.num_rows, icsr.num_rows
+    ub = jax.device_put(ucsr.device_buckets())
+    ib = jax.device_put(icsr.device_buckets())
+    step = make_step(ub, ib, nU, nI, cfg,
+                     ucsr.chunk_elems, icsr.chunk_elems)
+    # the production step DONATES its factor buffers; regenerate the
+    # (deterministic) initial factors for each run
+    Uf, Vf = step(*step(*_factors(cfg, nU, nI)))
+    with trace.stage_attribution():
+        astep = attribution.make_attributed_step(
+            ub, ib, nU, nI, cfg, ucsr.chunk_elems, icsr.chunk_elems)
+        Ua, Va = astep(*astep(*_factors(cfg, nU, nI)))
+    assert np.array_equal(np.asarray(Ua), np.asarray(Uf))
+    assert np.array_equal(np.asarray(Va), np.asarray(Vf))
+
+
+def test_unsupported_paths_raise_typed():
+    ucsr, icsr = _problem(nU=40, nI=30, nnz=400)
+    ub = jax.device_put(ucsr.device_buckets())
+    ib = jax.device_put(icsr.device_buckets())
+    with pytest.raises(AttributionUnsupported):
+        attribution.make_attributed_step(
+            ub, ib, ucsr.num_rows, icsr.num_rows,
+            AlsConfig(rank=4, cg_iters=3),
+            ucsr.chunk_elems, icsr.chunk_elems)
+
+
+# -- disarmed: the production path is untouched ----------------------------
+
+def test_disarmed_leaves_production_step_jaxpr_unchanged():
+    """The '<2% overhead when disabled' acceptance, pinned structurally:
+    arming state must not leak into the production step's traced graph
+    (the only disarmed cost is one armed-check boolean in train())."""
+    ucsr, icsr = _problem(nU=60, nI=40, nnz=800)
+    cfg = AlsConfig(rank=4, max_iter=2)
+    nU, nI = ucsr.num_rows, icsr.num_rows
+    ub = jax.device_put(ucsr.device_buckets())
+    ib = jax.device_put(icsr.device_buckets())
+    step = make_step(ub, ib, nU, nI, cfg,
+                     ucsr.chunk_elems, icsr.chunk_elems)
+    U0, V0 = _factors(cfg, nU, nI)
+    disarmed = str(jax.make_jaxpr(step)(U0, V0))
+    with trace.stage_attribution():
+        armed = str(jax.make_jaxpr(step)(U0, V0))
+    assert disarmed == armed
+    # disarmed train() takes the production step verbatim...
+    U1, V1 = train(ucsr, icsr, cfg)
+    U2, V2 = step(*step(U0, V0))
+    assert np.array_equal(np.asarray(U1), np.asarray(U2))
+    assert np.array_equal(np.asarray(V1), np.asarray(V2))
+    # ...and records no stage histograms at all
+    assert not any(k.startswith("train.stage_seconds")
+                   for k in obs.snapshot()["histograms"])
+
+
+def test_env_flag_arms_attribution(monkeypatch):
+    monkeypatch.delenv(trace._ENV_FLAG, raising=False)
+    assert not trace.stage_attribution_armed()
+    monkeypatch.setenv(trace._ENV_FLAG, "1")
+    assert trace.stage_attribution_armed()
+    monkeypatch.setenv(trace._ENV_FLAG, "0")
+    assert not trace.stage_attribution_armed()
+
+
+# -- armed: train() swaps in the twin and records stages -------------------
+
+def test_armed_train_records_stage_seconds_and_matches():
+    ucsr, icsr = _problem(nU=60, nI=40, nnz=800)
+    cfg = AlsConfig(rank=4, max_iter=2, implicit_prefs=True)
+    U_plain, V_plain = train(ucsr, icsr, cfg)
+    obs.reset()
+    with trace.stage_attribution():
+        U_att, V_att = train(ucsr, icsr, cfg)
+    assert np.array_equal(np.asarray(U_att), np.asarray(U_plain))
+    assert np.array_equal(np.asarray(V_att), np.asarray(V_plain))
+    hists = {k: v for k, v in obs.snapshot()["histograms"].items()
+             if k.startswith("train.stage_seconds")}
+    stages = {k.split('stage="')[1].rstrip('"}') for k in hists}
+    # solve + scatter appear on every path; yty on the implicit path;
+    # the NE stage name depends on the resolved backend
+    assert {"solve", "scatter", "yty", "gather_stream"} <= stages
+    assert stages & {"normal_eq", "gather_fused_ne"}
+    # 2 iterations x (item half + user half) solves at least once each
+    assert all(v["count"] >= 2 for v in hists.values())
+
+
+# -- measurement + the gap-table join --------------------------------------
+
+def test_measure_attributed_coverage():
+    ucsr, icsr = _problem(nU=500, nI=300, nnz=20000)
+    cfg = AlsConfig(rank=16, implicit_prefs=True)
+    m = attribution.measure_attributed(ucsr, icsr, cfg, iters=2, warmup=1)
+    assert m["wall_s_per_iter"] > 0 and m["stage_seconds"]
+    assert m["sum_stage_s_per_iter"] == pytest.approx(
+        sum(m["stage_seconds"].values()))
+    # the acceptance bound: stage seconds sum within 10% of the wall
+    # iteration time (fences can only lose time, never double-count)
+    assert 0.9 <= m["coverage"] <= 1.01, m
+    assert m["unattributed_s_per_iter"] == pytest.approx(
+        m["wall_s_per_iter"] - m["sum_stage_s_per_iter"])
+    assert m["fused_s_per_iter"] > 0
+
+
+def test_attribution_report_joins_by_stage_name():
+    measured = {
+        "stage_seconds": {"solve": 0.004, "mystery": 0.001},
+        "wall_s_per_iter": 0.01, "sum_stage_s_per_iter": 0.005,
+        "coverage": 0.5, "unattributed_s_per_iter": 0.005,
+        "resolved_solve_path": "einsum", "iters": 2, "warmup": 1,
+        "fused_s_per_iter": 0.002,
+    }
+    rl = roofline(1000, 500, 20000, 8, dtype="float32", implicit=True,
+                  padding_waste=0.2)
+    rep = attribution.attribution_report(measured, rl)
+    rows = {r["stage"]: r for r in rep["rows"]}
+    # measured+modeled: gap and % both populated
+    solve = rows["solve"]
+    assert solve["gap_x"] == pytest.approx(0.004 / solve["floor_s"])
+    assert solve["pct_of_iter"] == pytest.approx(40.0)
+    # modeled-only (never measured on this run): measured side is None
+    assert rows["gather_stream"]["measured_s"] is None
+    assert rows["gather_stream"]["gap_x"] is None
+    assert rows["gather_stream"]["floor_s"] > 0
+    # measured-only (the model has no such stage): floor side is None
+    assert rows["mystery"]["floor_s"] is None
+    assert rows["mystery"]["pct_of_iter"] == pytest.approx(10.0)
+    assert rep["attribution_overhead_x"] == pytest.approx(5.0)
+    text = attribution.render_attribution(rep)
+    assert "gap x" in text and "mystery" in text
+    assert "production fused step" in text
+    # None cells render as '-', not as a crash or a fake zero
+    assert " -" in text
+
+
+# -- the CLI surface (ISSUE acceptance) ------------------------------------
+
+def test_cli_observe_attribution(tmp_path, capsys):
+    rep = cli_main(["observe", "attribution",
+                    "--data", "synthetic:500x300x20000", "--rank", "16",
+                    "--iters", "2", "--warmup", "1", "--json",
+                    "--obs-dir", str(tmp_path / "obs")])
+    out = json.loads(capsys.readouterr().out)
+    assert out["coverage"] >= 0.9          # sum within 10% of the wall
+    stages = {r["stage"] for r in out["rows"]}
+    assert {"solve", "scatter", "gather_stream"} <= stages
+    measured = [r for r in out["rows"] if r["measured_s"] is not None]
+    assert measured and all(r["pct_of_iter"] is not None for r in measured)
+    assert rep["coverage"] == out["coverage"]
+    # the run dir carries the attribution event + stage histograms
+    events = [json.loads(ln) for ln in
+              open(tmp_path / "obs" / "events.jsonl") if ln.strip()]
+    attr = [e for e in events if e["type"] == "attribution"]
+    assert len(attr) == 1 and attr[0]["coverage"] == out["coverage"]
+    snap = [e for e in events if e["type"] == "snapshot"][-1]
+    assert any(k.startswith("train.stage_seconds")
+               for k in snap["histograms"])
+    # human rendering: the gap table header and footer lines
+    cli_main(["observe", "attribution",
+              "--data", "synthetic:120x80x1500", "--rank", "4",
+              "--iters", "1", "--warmup", "1"])
+    text = capsys.readouterr().out
+    assert "ALS stage attribution" in text
+    assert "gap x" in text and "roofline floor" in text

@@ -46,8 +46,10 @@ def test_probe_budget_caps_total_wallclock(monkeypatch):
     # was clipped to the remaining budget and the next attempt stopped
     assert elapsed < 5.0, elapsed
     assert len(calls) < 6
-    # exhaustion is one structured event, after the real attempts
+    # exhaustion ends with the terminal bench_probe_exhausted verdict,
+    # after the real attempts' bench_retry records
     assert events and "budget" in events[-1]["reason"]
+    assert events[-1]["type"] == "bench_probe_exhausted"
 
 
 def test_probe_budget_zero_keeps_full_retry_envelope(monkeypatch):
@@ -58,7 +60,14 @@ def test_probe_budget_zero_keeps_full_retry_envelope(monkeypatch):
     assert not ok
     assert "budget" not in err       # exhausted attempts, not budget
     assert len(calls) == 3
-    assert len(events) == 3
+    # one bench_retry per attempt + the terminal verdict
+    assert len(events) == 4
+    assert [e["type"] for e in events[:3]] == ["bench_retry"] * 3
+    term = events[-1]
+    assert term["type"] == "bench_probe_exhausted"
+    assert term["attempts"] == 3
+    assert term["reason"] == err
+    assert term["elapsed_seconds"] >= 0
 
 
 def _bank(d, name, payload):

@@ -98,6 +98,54 @@ def test_hist_state_and_quantiles():
     assert empty.state()["count"] == 0 and empty.state()["p50"] is None
 
 
+def test_hist_quantile_bucket_edges():
+    import math
+
+    # empty: NaN for EVERY q — including q=0, where target is 0 and a
+    # naive `acc >= target` would report the first grid bound
+    empty = _Hist()
+    for q in (0.0, 0.5, 1.0):
+        assert math.isnan(empty.quantile(q))
+    # single sample ON a grid bound (le semantics put it AT the bound):
+    # every quantile reports exactly that bound, never the next one up
+    h = _Hist()
+    h.observe(1.0)                       # == BUCKET_BOUNDS[24]
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.quantile(q) == 1.0
+    # exact-boundary observation deeper in the grid behaves the same
+    h = _Hist()
+    h.observe(BUCKET_BOUNDS[30])
+    assert h.quantile(0.5) == BUCKET_BOUNDS[30]
+    # q=0 with only a LATE bucket populated: the empty prefix must not
+    # satisfy the target — the answer is the min's bucket, not bound[0]
+    h = _Hist()
+    h.observe(10.0)
+    assert h.quantile(0.0) == 10.0 != BUCKET_BOUNDS[0]
+    # overflow-only series: the observed max at every quantile
+    h = _Hist()
+    h.observe(5e7)
+    assert h.quantile(0.0) == h.quantile(1.0) == 5e7
+
+
+def test_registry_quantile_accessors_edge_cases():
+    import math
+
+    reg = MetricsRegistry()
+    # a never-observed series reads NaN / 0, never raises
+    assert math.isnan(reg.histogram_quantile("serve.request_seconds", 0.5))
+    assert reg.histogram_count("serve.request_seconds") == 0
+    assert reg.counter_value("serve.requests") == 0
+    # single observation at an exact bound round-trips through the
+    # label-keyed accessor
+    reg.histogram("serve.request_seconds", 1.0, strategy="ring")
+    assert reg.histogram_quantile("serve.request_seconds", 0.5,
+                                  strategy="ring") == 1.0
+    assert reg.histogram_count("serve.request_seconds",
+                               strategy="ring") == 1
+    # label mismatch is a distinct (empty) series
+    assert math.isnan(reg.histogram_quantile("serve.request_seconds", 0.5))
+
+
 # -- schema validation at call time ----------------------------------------
 
 def test_undeclared_or_miskinded_names_raise():
@@ -406,6 +454,35 @@ def test_check_obs_schema_catches_violations(tmp_path):
     assert "rogue_inline_event" in p.stderr
 
 
+def test_check_obs_schema_catches_accessor_and_assertion_drift(tmp_path):
+    """The read-side extension: typo'd accessor names and undeclared
+    Assertion(metric=/event=/den=) literals are violations; a dynamic
+    accessor read (the scenario evaluator) is NOT."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'q = reg.histogram_quantile("no.such.hist", 0.5)\n'
+        'c = reg.counter_value("serve.request_seconds")\n'
+        'ok = reg.histogram_quantile(a.metric, a.q)\n'
+        'x = Assertion("n", "quantile", metric="not.declared", q=0.5)\n'
+        'y = Assertion("n", "event", event="not_an_event")\n'
+        'z = Assertion("n", "ratio", num="serving.shed",\n'
+        '              den=("serving.requests", "bogus.counter"))\n'
+        'w = Assertion("n", "fact", fact="anything_goes")\n')
+    p = subprocess.run([sys.executable, CHECKER, "--paths", str(bad)],
+                       capture_output=True, text=True)
+    assert p.returncode == 1
+    assert "no.such.hist" in p.stderr
+    # kind mismatch through the accessor alias
+    assert "used as a counter (counter_value)" in p.stderr
+    assert "not.declared" in p.stderr
+    assert "not_an_event" in p.stderr
+    assert "bogus.counter" in p.stderr
+    # the dynamic read and the fact-kind assertion are clean
+    assert "a.metric" not in p.stderr
+    assert "anything_goes" not in p.stderr
+    assert p.stderr.count(str(bad.name)) == 5
+
+
 # -- bench.py probe events -------------------------------------------------
 
 def test_bench_retry_events_are_schema_valid(monkeypatch):
@@ -425,10 +502,11 @@ def test_bench_retry_events_are_schema_valid(monkeypatch):
     ok, err, events = bench.tpu_ready(attempts=2, wait_s=0,
                                       probe_timeout_s=5)
     assert not ok and "tunnel down" in err
-    assert [e["attempt"] for e in events] == [1, 2]
+    # per-attempt retry records, then the terminal exhaustion verdict
+    assert [e["attempt"] for e in events[:-1]] == [1, 2]
+    assert events[-1]["type"] == "bench_probe_exhausted"
     for ev in events:
-        assert ev["type"] == "bench_retry"
-        schema.check_event("bench_retry", {
+        schema.check_event(ev["type"], {
             k: v for k, v in ev.items() if k not in ("ts", "type")})
 
 
@@ -471,6 +549,29 @@ def test_cli_train_then_observe_summarize(tmp_path, capsys):
     samples = _parse_prom(
         open(os.path.join(obs_dir, "metrics.prom")).read())
     assert 'tpu_als_train_comm_bytes_per_iter{strategy="ring"}' in samples
+
+
+def test_observe_tail_event_filter(tmp_path, capsys):
+    run = str(tmp_path / "obs")
+    reg = MetricsRegistry()
+    reg.configure(run)
+    for i in range(5):
+        reg.emit("warning", what=f"w{i}", reason="x")
+        with reg.span("noise"):
+            pass
+    reg.finalize()
+    # filtered BEFORE the tail slice: the last 3 warnings, not whatever
+    # warnings happen to sit in the last 3 raw lines
+    lines = report.cmd_tail(run, n=3, event="warning").splitlines()
+    assert [json.loads(ln)["what"] for ln in lines] == ["w2", "w3", "w4"]
+    assert all(json.loads(ln)["type"] == "warning" for ln in lines)
+    # the CLI surface
+    cli_main(["observe", "tail", run, "-n", "2", "--event", "span"])
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(out) == 2
+    assert all(json.loads(ln)["type"] == "span" for ln in out)
+    # a type with no occurrences filters to empty output, not an error
+    assert report.cmd_tail(run, n=5, event="flight_record") == ""
 
 
 def test_observe_summarize_missing_dir_errors(tmp_path):

@@ -549,11 +549,15 @@ def test_bench_tpu_ready_failure_events(monkeypatch):
     ok, err, events = bench.tpu_ready(attempts=2, wait_s=0.01,
                                       probe_timeout_s=1)
     assert not ok and "hung" in err
-    assert [e["attempt"] for e in events] == [1, 2]
-    for e in events:
+    assert [e["attempt"] for e in events[:-1]] == [1, 2]
+    for e in events[:-1]:
         assert e["type"] == "bench_retry" and e["attempts"] == 2
         assert "hung" in e["reason"] and "ts" in e
         assert "TimeoutError" not in e["reason"]   # raw reason contract
+    # exhaustion ends the trail with an explicit terminal verdict
+    last = events[-1]
+    assert last["type"] == "bench_probe_exhausted"
+    assert last["attempts"] == 2 and "hung" in last["reason"]
 
 
 # ---------------------------------------------------------------------------

@@ -69,7 +69,7 @@ def _cli(args, env_extra=None):
 
 def test_registry_has_the_issue_scenarios():
     for name in ("traffic-spike", "preempt-under-serve", "torn-publish",
-                 "cold-start", "preempt-resume"):
+                 "cold-start", "preempt-resume", "flight-recorder"):
         assert scenario.get_scenario(name).name == name
 
 
@@ -245,6 +245,23 @@ def test_cold_start_scenario_passes():
     assert result["passed"], result["assertions"]
     assert result["facts"]["new_user_served"] is True
     assert 0 < result["facts"]["freshness_ms"] <= 5000
+
+
+def test_flight_recorder_scenario_passes(_fresh):
+    """ISSUE 7 acceptance: forced SLO breaches leave flight_record
+    events with full per-request span breakdowns (>= last 8 requests),
+    asserted from the obs trail by the scenario's own assertions."""
+    reg = _fresh
+    result = scenario.run_scenario(scenario.get_scenario("flight-recorder"))
+    assert result["passed"], result["assertions"]
+    assert result["facts"]["complete_breach_records"] >= 8
+    assert result["facts"]["hard_failures"] == 0
+    records = [e for e in reg._events if e["type"] == "flight_record"]
+    assert len(records) >= 8
+    for r in records:
+        assert r["trigger"] == "slo_breach"
+        assert all(r["spans"][k] is not None for k in
+                   ("admission", "queue_wait", "score", "respond"))
 
 
 def test_preempt_under_serve_acceptance():

@@ -415,3 +415,105 @@ def test_serve_bench_cli_exact_path(capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["config"]["path"] == "exact"
     assert out["scored"] > 0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (per-request span breakdowns dumped on SLO breach)
+
+
+def test_flight_recorder_ring_and_watermark(_fresh):
+    from tpu_als.obs.trace import SPAN_KEYS, FlightRecorder
+
+    fr = FlightRecorder(capacity=4)
+    for i in range(6):
+        fr.record("ok", {"score": 0.001 * (i + 1)}, e2e_seconds=0.01)
+    assert len(fr) == 4                       # bounded ring
+    assert fr.dump("slo_breach") == 4
+    evs = [e for e in _fresh._events if e["type"] == "flight_record"]
+    # capacity evicted seqs 1-2; unknown span keys are dropped, the
+    # record always carries the full SPAN_KEYS vocabulary
+    assert [e["seq"] for e in evs] == [3, 4, 5, 6]
+    assert all(set(e["spans"]) == set(SPAN_KEYS) for e in evs)
+    assert all(e["trigger"] == "slo_breach" for e in evs)
+    # monotonic watermark: a repeat trigger re-emits nothing
+    assert fr.dump("slo_breach") == 0
+    fr.record("ok", {"score": 1.0})
+    assert fr.dump("shed") == 1               # only the new record
+    evs = [e for e in _fresh._events if e["type"] == "flight_record"]
+    assert len(evs) == 5 and evs[-1]["trigger"] == "shed"
+
+
+def test_engine_slo_breach_dumps_span_breakdowns(rng, _fresh):
+    """The acceptance shape: a forced breach (microsecond SLO) leaves
+    the last N per-request traces in the obs trail, each with the full
+    admission/queue_wait/score/respond breakdown."""
+    eng, _, _ = _engine(rng, slo_s=1e-7)
+    n = 10
+    with eng:
+        for j in range(n):
+            eng.recommend(j, timeout=5.0)
+    evs = [e for e in _fresh._events if e["type"] == "flight_record"]
+    assert len(evs) >= 8
+    for e in evs:
+        assert e["trigger"] == "slo_breach" and e["status"] == "ok"
+        for k in ("admission", "queue_wait", "score", "respond"):
+            assert e["spans"][k] is not None and e["spans"][k] >= 0
+        # rescore is fused into the int8 top-k kernel: recorded None
+        assert e["spans"]["rescore"] is None
+        assert e["e2e_seconds"] > 0 and e["path"] == "int8"
+    # and the spans roughly compose the e2e they explain
+    spans = evs[-1]["spans"]
+    parts = sum(v for v in spans.values() if v is not None)
+    assert parts <= evs[-1]["e2e_seconds"] * 1.5
+
+
+def test_engine_loose_slo_dumps_nothing(rng, _fresh):
+    eng, _, _ = _engine(rng, slo_s=60.0)
+    with eng:
+        eng.recommend(0, timeout=5.0)
+    assert not [e for e in _fresh._events if e["type"] == "flight_record"]
+    # recording is still always-on: the trace sits in the ring, undumped
+    assert len(eng.flight) == 1
+
+
+def test_engine_shed_dumps_flight_record(rng, _fresh):
+    eng, _, _ = _engine(rng, max_queue=2)
+    with pytest.raises(Overloaded):
+        for _ in range(50):                   # engine loop not running
+            eng.submit(0)
+    evs = [e for e in _fresh._events if e["type"] == "flight_record"]
+    assert len(evs) == 1
+    assert evs[0]["status"] == "shed" and evs[0]["trigger"] == "shed"
+    assert evs[0]["spans"]["admission"] is not None
+    assert evs[0]["spans"]["score"] is None   # never reached the scorer
+
+
+def test_engine_expired_ticket_flight_record(rng, _fresh):
+    eng, _, _ = _engine(rng, slo_s=1e-7)
+    t_dead = eng.submit(0, deadline_s=0.0)
+    t_ok = eng.submit(1)
+    time.sleep(0.01)
+    _drain_one(eng)
+    with pytest.raises(DeadlineExceeded):
+        t_dead.result(timeout=1.0)
+    t_ok.result(timeout=1.0)
+    evs = [e for e in _fresh._events if e["type"] == "flight_record"]
+    statuses = {e["status"] for e in evs}
+    assert statuses == {"expired", "ok"}
+    exp = next(e for e in evs if e["status"] == "expired")
+    assert exp["spans"]["queue_wait"] is not None
+    assert exp["spans"]["score"] is None
+
+
+def test_serve_bench_forced_breach_emits_flight_records(capsys):
+    """ISSUE acceptance: serve-bench under a forced SLO breach reports
+    flight_record events covering at least the last 8 requests."""
+    from tpu_als.cli import main
+
+    main(["serve-bench", "--users", "100", "--items", "300",
+          "--rank", "4", "--qps", "300", "--duration", "0.1",
+          "--slo-ms", "0.000001", "--buckets", "8"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["slo_met"] is False
+    assert out["scored"] >= 8
+    assert out["flight_records"] >= min(out["scored"], 8)

@@ -818,7 +818,11 @@ def cmd_serve_bench(args):
         k=args.k, buckets=buckets, shortlist_k=args.shortlist_k,
         max_queue=args.max_queue, max_wait_s=args.max_wait_ms / 1e3,
         default_deadline_s=(args.deadline_ms / 1e3
-                            if args.deadline_ms else None))
+                            if args.deadline_ms else None),
+        # the SLO is also the flight-recorder breach trigger: a request
+        # slower than this dumps the last N per-request traces as
+        # flight_record events (docs/observability.md)
+        slo_s=args.slo_ms / 1e3)
     engine.publish(U, V, quantize=not args.exact)
     with obs.span("serve_bench.warmup"):
         engine.warmup()
@@ -877,6 +881,9 @@ def cmd_serve_bench(args):
         "queue_wait_p99_ms": round(
             obs.histogram_quantile("serving.enqueue_seconds", 0.99) * 1e3,
             3),
+        "flight_records": sum(
+            1 for e in obs.default_registry()._events
+            if e.get("type") == "flight_record"),
         "config": {
             "path": path, "users": args.users, "items": args.items,
             "rank": args.rank, "k": args.k,
@@ -961,8 +968,74 @@ def cmd_tt_train(args):
 
 def cmd_observe(args):
     """Inspect a run directory written by the other subcommands — the
-    analog of pointing the Spark UI at an event-log directory — or
-    (``roofline``) print the analytical per-stage bytes/FLOPs floor."""
+    analog of pointing the Spark UI at an event-log directory — or run
+    one of the measurement-side tools: ``roofline`` (the analytical
+    per-stage floor), ``attribution`` (measured per-stage seconds
+    joined against that floor), ``regress`` (the bench-series gate)."""
+    if args.action == "regress":
+        from tpu_als.obs import regress as regress_mod
+
+        result = regress_mod.check(args.root, noise=args.noise,
+                                   strict=args.strict)
+        if args.as_json:
+            print(json.dumps(result))
+        else:
+            print(regress_mod.render(result))
+        if result["exit_code"]:
+            raise SystemExit(result["exit_code"])
+        return result
+
+    if args.action == "attribution":
+        from tpu_als import obs
+        from tpu_als.core.als import AlsConfig
+        from tpu_als.core.ratings import build_csr_buckets, remap_ids
+        from tpu_als.perf.attribution import (
+            attribution_report,
+            measure_attributed,
+            render_attribution,
+        )
+        from tpu_als.perf.roofline import roofline
+
+        if args.obs_dir:
+            from tpu_als import obs as _obs
+
+            _obs.configure(args.obs_dir,
+                           config={k: v for k, v in vars(args).items()
+                                   if k != "fn"})
+        frame = _load_data(args.data)
+        u, _ = remap_ids(np.asarray(frame["user"]))
+        i, _ = remap_ids(np.asarray(frame["item"]))
+        r = np.asarray(frame["rating"], dtype=np.float32)
+        nU, nI = int(u.max()) + 1, int(i.max()) + 1
+        ucsr = build_csr_buckets(u, i, r, nU)
+        icsr = build_csr_buckets(i, u, r, nI)
+        cfg = AlsConfig(rank=args.rank, implicit_prefs=not args.explicit,
+                        reg_param=args.reg, alpha=args.alpha,
+                        compute_dtype=args.dtype,
+                        solve_backend=args.solve_backend)
+        measured = measure_attributed(ucsr, icsr, cfg, iters=args.iters,
+                                      warmup=args.warmup)
+        ne_path = ("gather_fused"
+                   if measured["resolved_solve_path"].startswith(
+                       "gatherfused") else "einsum")
+        rl = roofline(nU, nI, len(r), args.rank, dtype=args.dtype,
+                      implicit=not args.explicit, ne_path=ne_path,
+                      user_counts=ucsr.counts, item_counts=icsr.counts)
+        rep = attribution_report(measured, rl)
+        obs.emit("attribution", stages=rep["rows"],
+                 wall_s_per_iter=rep["wall_s_per_iter"],
+                 coverage=rep["coverage"],
+                 resolved_solve_path=rep["resolved_solve_path"],
+                 config=rl["config"])
+        if args.as_json:
+            print(json.dumps(rep))
+        else:
+            print(render_attribution(rep))
+        if args.obs_dir:
+            obs.finalize()
+            obs.deconfigure()
+        return rep
+
     if args.action == "roofline":
         from tpu_als.perf.roofline import (
             HEADLINE,
@@ -1001,7 +1074,8 @@ def cmd_observe(args):
         if args.action == "summarize":
             print(report.cmd_summarize(args.run_dir, as_json=args.as_json))
         else:
-            print(report.cmd_tail(args.run_dir, n=args.lines))
+            print(report.cmd_tail(args.run_dir, n=args.lines,
+                                  event=args.event))
     except FileNotFoundError as err:
         raise SystemExit(str(err))
 
@@ -1308,6 +1382,9 @@ def main(argv=None):
     os2 = osub.add_parser("tail", help="print the last N raw events")
     os2.add_argument("run_dir")
     os2.add_argument("-n", "--lines", type=int, default=20)
+    os2.add_argument("--event", default=None, metavar="TYPE",
+                     help="only events of this type (e.g. flight_record, "
+                          "scenario_assert) — the last N AFTER filtering")
     os2.set_defaults(fn=cmd_observe)
     os3 = osub.add_parser(
         "roofline",
@@ -1347,6 +1424,52 @@ def main(argv=None):
                           "headline 1.184 when the config is untouched)")
     os3.add_argument("--json", dest="as_json", action="store_true")
     os3.set_defaults(fn=cmd_observe)
+    os4 = osub.add_parser(
+        "attribution",
+        help="MEASURE where an iteration's seconds go: fence-timed "
+             "per-stage seconds joined against the roofline floor "
+             "(the measured counterpart of `observe roofline`)")
+    os4.add_argument("--data", default="synthetic:943x1682x100000",
+                     help="same specs as train --data; default is the "
+                          "ml-100k shape synthetically (CPU-friendly); "
+                          "use ml-100k:PATH for the real ratings")
+    os4.add_argument("--rank", type=int, default=16)
+    os4.add_argument("--iters", type=int, default=3,
+                     help="fence-timed iterations (after --warmup "
+                          "compile-absorbing ones)")
+    os4.add_argument("--warmup", type=int, default=1)
+    os4.add_argument("--explicit", action="store_true",
+                     help="explicit feedback (default: implicit)")
+    os4.add_argument("--dtype", default="float32",
+                     choices=["float32", "bfloat16"])
+    os4.add_argument("--reg", type=float, default=0.1)
+    os4.add_argument("--alpha", type=float, default=1.0)
+    os4.add_argument("--solve-backend", default="auto",
+                     choices=["auto", "unfused", "gather_fused"],
+                     help="exact paths only (the CG/fused-kernel "
+                          "ablations have no decomposed twin)")
+    os4.add_argument("--obs-dir", default=None, metavar="DIR",
+                     help="also write the stage histograms + "
+                          "attribution event as a run dir")
+    os4.add_argument("--json", dest="as_json", action="store_true")
+    os4.set_defaults(fn=cmd_observe)
+    os5 = osub.add_parser(
+        "regress",
+        help="bench regression gate over the committed BENCH_*/"
+             "MULTICHIP_* series: regressions beyond a noise band, "
+             "value:null banks, missing banked_at provenance; typed "
+             "exit code (1=regression 2=null 3=provenance)")
+    os5.add_argument("root", nargs="?", default=".",
+                     help="directory holding the bench artifacts "
+                          "(default: cwd)")
+    os5.add_argument("--noise", type=float, default=0.10,
+                     help="relative band a latest-vs-best-prior move "
+                          "must exceed to count as a regression")
+    os5.add_argument("--strict", action="store_true",
+                     help="historical nulls/unparseable rounds become "
+                          "errors instead of warnings")
+    os5.add_argument("--json", dest="as_json", action="store_true")
+    os5.set_defaults(fn=cmd_observe)
 
     args = ap.parse_args(argv)
     _validate_fault_spec()

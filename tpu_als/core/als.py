@@ -438,6 +438,20 @@ def train(user_csr, item_csr, cfg: AlsConfig, callback=None, init=None,
     ib = jax.device_put(item_csr.device_buckets())
     step = make_step(ub, ib, num_users, num_items, cfg,
                      user_csr.chunk_elems, item_csr.chunk_elems)
+    # stage attribution (obs/trace.py): armed via TPU_ALS_STAGE_ATTRIBUTION
+    # or obs.trace.enable_stage_attribution(), the fused step above is
+    # replaced by its decomposed fence-timed twin and per-stage seconds
+    # land in train.stage_seconds histograms.  Disarmed (the default),
+    # this one boolean check per train() call is the entire cost — the
+    # jitted step is untouched (pinned in tests/test_attribution.py).
+    from tpu_als.obs.trace import stage_attribution_armed
+
+    if stage_attribution_armed():
+        from tpu_als.perf.attribution import make_attributed_step
+
+        step = make_attributed_step(ub, ib, num_users, num_items, cfg,
+                                    user_csr.chunk_elems,
+                                    item_csr.chunk_elems)
 
     for it in range(start_iter, cfg.max_iter):
         U, V = step(U, V)

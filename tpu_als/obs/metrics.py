@@ -92,7 +92,9 @@ class _Hist:
         acc = 0
         for i, c in enumerate(self.counts):
             acc += c
-            if acc >= target:
+            # acc > 0 guards q=0: target is 0 there, and an empty prefix
+            # must not report the first bucket's bound as the minimum
+            if acc >= target and acc > 0:
                 if i < len(BUCKET_BOUNDS):
                     return BUCKET_BOUNDS[i]
                 return self.max

@@ -1,0 +1,232 @@
+"""Bench regression gate: judge the committed bench-series artifacts.
+
+The repo banks one JSON artifact per sweep round (``BENCH_rNN.json``,
+``MULTICHIP_rNN.json``) plus direct single-point banks
+(``BENCH_serve_cpu.json``).  Nothing ever read them back — which is how
+``BENCH_r05.json`` came to carry ``value: null`` after six silent probe
+hangs.  This module is the reader: ``check()`` classifies every
+artifact, reconstructs each series, and returns typed findings with a
+typed exit code so sweeps and CI fail loudly instead of committing
+nulls.
+
+Exit codes (the max severity found wins):
+
+- 0  OK — warnings at most (historical nulls, unparseable rounds)
+- 1  REGRESSION — the latest effective value is worse than the best
+     previous one beyond the noise band (direction from the unit:
+     ``iters/sec`` up is good, ``ms``/``s`` down is good), or the
+     latest multichip round is failing
+- 2  NULL BANK — the LATEST round banked ``value: null`` with no
+     same-round fallback, or a direct bank carries a null value
+- 3  PROVENANCE — a direct bank is missing a timezone-aware
+     ``banked_at`` stamp (the bench contract since PR 2)
+
+Historical nulls are warnings, not errors: the series already happened
+and the gate's job is to stop the NEXT null, not to make the committed
+history unfixable (``--strict`` upgrades them).  A null round whose
+wrapper carries a same-round ``last_builder_measured`` sweep fallback
+(the PR 5 banking rule) counts as measured at that value.
+
+Pure stdlib — ``scripts/bench_gate.sh`` and the ``observe regress`` CLI
+run this without jax.
+"""
+
+from __future__ import annotations
+
+import datetime
+import glob
+import json
+import os
+import re
+
+EXIT_OK = 0
+EXIT_REGRESSION = 1
+EXIT_NULL_BANK = 2
+EXIT_PROVENANCE = 3
+
+# units where a larger number is a worse result
+_LOWER_BETTER = ("ms", "s", "seconds", "sec", "s/iter", "seconds/iter")
+
+_ROUND_RE = re.compile(r"^(?P<series>.+)_r(?P<n>\d+)\.json$")
+
+
+def _finding(severity, code, where, message):
+    return {"severity": severity, "code": code, "where": where,
+            "message": message}
+
+
+def _effective_value(payload):
+    """The value a wrapper round actually measured: ``value``, else the
+    same-round sweep fallback (``last_builder_measured.value``)."""
+    if payload.get("value") is not None:
+        return float(payload["value"]), "value"
+    fb = payload.get("last_builder_measured") or {}
+    if fb.get("value") is not None:
+        return float(fb["value"]), "sweep_fallback"
+    return None, None
+
+
+def _tz_aware(stamp):
+    try:
+        dt = datetime.datetime.fromisoformat(
+            str(stamp).replace("Z", "+00:00"))
+    except ValueError:
+        return False
+    return dt.tzinfo is not None
+
+
+def _check_bench_series(name, rounds, noise, strict, findings):
+    """``rounds``: sorted [(n, fname, doc)] of ``{n, rc, parsed}``
+    wrappers.  Appends findings; returns nothing."""
+    last_n = rounds[-1][0]
+    points = []                     # (n, value, source, unit)
+    for n, fname, doc in rounds:
+        payload = doc.get("parsed")
+        if payload is None:
+            sev = "error" if strict else "warning"
+            findings.append(_finding(
+                sev, EXIT_NULL_BANK if strict else EXIT_OK, fname,
+                f"round {n} banked no parseable bench payload "
+                f"(rc={doc.get('rc')})"))
+            continue
+        value, source = _effective_value(payload)
+        if value is None:
+            latest = n == last_n
+            sev = "error" if (latest or strict) else "warning"
+            findings.append(_finding(
+                sev, EXIT_NULL_BANK if sev == "error" else EXIT_OK, fname,
+                f"round {n} banked value: null with no same-round "
+                f"fallback ({payload.get('error') or 'no error recorded'})"
+                + ("" if latest else " [historical]")))
+            continue
+        if source == "sweep_fallback":
+            findings.append(_finding(
+                "info", EXIT_OK, fname,
+                f"round {n} value {value} recovered via "
+                "last_builder_measured sweep fallback"))
+        points.append((n, value, source, payload.get("unit")))
+
+    if len(points) < 2:
+        return
+    unit = points[-1][3] or ""
+    lower_better = unit in _LOWER_BETTER
+    latest_n, latest, _, _ = points[-1]
+    prior = [v for _, v, _, _ in points[:-1]]
+    best = min(prior) if lower_better else max(prior)
+    regressed = (latest > best * (1.0 + noise) if lower_better
+                 else latest < best * (1.0 - noise))
+    if regressed:
+        direction = "above" if lower_better else "below"
+        findings.append(_finding(
+            "error", EXIT_REGRESSION, f"{name}_r{latest_n:02d}.json",
+            f"series {name}: latest {latest} {unit} is {direction} the "
+            f"best prior {best} {unit} beyond the {noise:.0%} noise band"))
+
+
+def _check_multichip_series(name, rounds, strict, findings):
+    """Pass/fail rounds (``{n_devices, rc, ok, skipped}``): the latest
+    must be passing; historical failures are warnings."""
+    last_n = rounds[-1][0]
+    for n, fname, doc in rounds:
+        if doc.get("skipped"):
+            continue
+        if not doc.get("ok"):
+            latest = n == last_n
+            sev = "error" if (latest or strict) else "warning"
+            findings.append(_finding(
+                sev, EXIT_REGRESSION if sev == "error" else EXIT_OK, fname,
+                f"round {n} multichip run failing (rc={doc.get('rc')})"
+                + ("" if latest else " [historical]")))
+
+
+def _check_direct_bank(fname, doc, findings):
+    """Single-point bank (``{metric, value, unit, ..., banked_at}``)."""
+    if doc.get("value") is None:
+        findings.append(_finding(
+            "error", EXIT_NULL_BANK, fname,
+            f"direct bank {doc.get('metric')!r} carries value: null"))
+    stamp = doc.get("banked_at")
+    if stamp is None:
+        findings.append(_finding(
+            "error", EXIT_PROVENANCE, fname,
+            f"direct bank {doc.get('metric')!r} is missing banked_at "
+            "provenance"))
+    elif not _tz_aware(stamp):
+        findings.append(_finding(
+            "error", EXIT_PROVENANCE, fname,
+            f"direct bank {doc.get('metric')!r} banked_at={stamp!r} is "
+            "not a timezone-aware ISO stamp"))
+
+
+def check(root=".", noise=0.10, strict=False, files=None):
+    """Gate every bench artifact under ``root`` (or the explicit
+    ``files`` list).  Returns ``{"findings", "exit_code", "series",
+    "checked"}`` — exit_code is the max error code found (0 when only
+    warnings/info survive)."""
+    if files is None:
+        files = sorted(glob.glob(os.path.join(root, "BENCH_*.json"))
+                       + glob.glob(os.path.join(root, "MULTICHIP_*.json")))
+    findings = []
+    series = {}                     # name -> [(n, fname, doc)]
+    checked = []
+    for path in files:
+        fname = os.path.basename(path)
+        checked.append(fname)
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            findings.append(_finding(
+                "error", EXIT_NULL_BANK, fname,
+                f"unreadable bench artifact: {e}"))
+            continue
+        m = _ROUND_RE.match(fname)
+        if m and isinstance(doc, dict) and "rc" in doc:
+            series.setdefault(m.group("series"), []).append(
+                (int(m.group("n")), fname, doc))
+        elif isinstance(doc, dict) and "metric" in doc and "value" in doc:
+            _check_direct_bank(fname, doc, findings)
+        else:
+            findings.append(_finding(
+                "warning", EXIT_OK, fname,
+                "unrecognized bench artifact shape (neither a _rNN "
+                "round wrapper nor a metric/value bank)"))
+
+    for name, rounds in sorted(series.items()):
+        rounds.sort()
+        if any("parsed" in doc for _, _, doc in rounds):
+            _check_bench_series(name, rounds, noise, strict, findings)
+        else:
+            _check_multichip_series(name, rounds, strict, findings)
+
+    exit_code = max(
+        (f["code"] for f in findings if f["severity"] == "error"),
+        default=EXIT_OK)
+    return {
+        "findings": findings,
+        "exit_code": exit_code,
+        "series": {name: [fname for _, fname, _ in rounds]
+                   for name, rounds in sorted(series.items())},
+        "checked": checked,
+        "noise": float(noise),
+        "strict": bool(strict),
+    }
+
+
+def render(result):
+    """Human-readable verdict for ``tpu_als observe regress``."""
+    lines = [f"bench regression gate — {len(result['checked'])} "
+             f"artifact(s), noise band {result['noise']:.0%}"
+             + (" [strict]" if result["strict"] else "")]
+    if not result["checked"]:
+        lines.append("  (no BENCH_*/MULTICHIP_* artifacts found)")
+    for f in result["findings"]:
+        lines.append(f"  {f['severity'].upper():<8}{f['where']}: "
+                     f"{f['message']}")
+    if not result["findings"]:
+        lines.append("  all clean")
+    verdict = {EXIT_OK: "OK", EXIT_REGRESSION: "REGRESSION",
+               EXIT_NULL_BANK: "NULL BANK",
+               EXIT_PROVENANCE: "PROVENANCE"}[result["exit_code"]]
+    lines.append(f"verdict: {verdict} (exit {result['exit_code']})")
+    return "\n".join(lines)

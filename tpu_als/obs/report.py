@@ -205,6 +205,12 @@ def cmd_summarize(target, as_json=False):
     return render_summary(summary, manifest)
 
 
-def cmd_tail(target, n=20):
+def cmd_tail(target, n=20, event=None):
+    """Last ``n`` raw events, optionally only those of one declared
+    type (``event=``) — filtered BEFORE the tail slice, so
+    ``--event flight_record -n 8`` is the last 8 flight records, not
+    whatever flight records happen to sit in the last 8 lines."""
     events = load_events(target)
+    if event is not None:
+        events = [ev for ev in events if ev.get("type") == event]
     return "\n".join(json.dumps(ev) for ev in events[-n:])

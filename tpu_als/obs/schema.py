@@ -96,6 +96,12 @@ METRICS = {
         "histogram", "seconds",
         "cold-start scenario: rating-arrival -> servable latency (fold-"
         "in + republish + first successful recommend for a NEW user)"),
+    "train.stage_seconds": (
+        "histogram", "seconds",
+        "fence-timed seconds of one attributed ALS stage (obs.trace."
+        "stage), labeled stage=<perf.roofline stage name> so "
+        "`observe attribution` can join measured time against the "
+        "modeled floor"),
 }
 
 # event type -> (required fields beyond ts/type, help text).  Extra
@@ -180,6 +186,22 @@ EVENTS = {
         ("scenario", "passed", "seconds"),
         "a scenario run finished (or aborted on a phase failure, with "
         "an extra 'error' field): the verdict and total seconds"),
+    "bench_probe_exhausted": (
+        ("attempts", "elapsed_seconds", "reason"),
+        "bench.py gave up on the backend probe: every attempt in the "
+        "retry/budget policy failed (the terminal record after the "
+        "per-attempt bench_retry trail)"),
+    "flight_record": (
+        ("seq", "trigger", "status", "spans"),
+        "one per-request trace dumped by the serving flight recorder "
+        "on an SLO breach, shed, or degraded-mode answer: spans is the "
+        "admission/queue_wait/score/rescore/respond breakdown in "
+        "seconds (serving.engine.FlightRecorder)"),
+    "attribution": (
+        ("stages", "wall_s_per_iter", "coverage"),
+        "one per `observe attribution` run: measured per-stage seconds "
+        "joined against the roofline floor (the planner's measured-"
+        "probe input format)"),
 }
 
 

@@ -1,0 +1,335 @@
+"""Stage attribution: measure where an ALS iteration's seconds GO.
+
+``perf/roofline.py`` models what each stage of an iteration *should*
+cost from bytes and FLOPs; this module measures what each stage
+*actually* costs and joins the two into a gap table — the measured-probe
+input format ROADMAP item 5's cost-model-driven planner consumes.
+
+The production step (``core.als._step_jit``) is ONE jitted call — XLA
+fuses across stage boundaries and the host sees a single opaque
+dispatch, so it cannot be fence-timed from outside.  Attribution
+therefore runs a DECOMPOSED twin of ``local_half_step``: the same
+gather / normal-equation / solve / scatter (+ yty) computation split
+into one jitted call per stage, each wrapped in an
+``obs.trace.stage`` fence (``block_until_ready`` boundaries), with all
+iteration-invariant prep (chunk reshapes, dtype casts of the rating
+stream) hoisted to build time so the fences bracket real per-iteration
+work.  Stage names match the roofline's exactly (``gather_stream``,
+``normal_eq`` / ``gather_fused_ne``, ``solve``, ``scatter``, ``yty``),
+so the join is by name.
+
+The decomposed twin loses cross-stage fusion, so its wall clock is an
+upper bound on the fused step's — ``measure_attributed`` times the real
+fused step alongside and reports both.  The production ``train()`` loop
+only ever reaches this module when ``obs.trace.stage_attribution_armed``
+is true; disarmed, the fused step is byte-for-byte untouched (pinned by
+an unchanged-jaxpr test).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tpu_als.core.als import (
+    AlsConfig,
+    init_factors,
+    make_step,
+    resolve_solve_path,
+)
+from tpu_als.core.ratings import trainer_chunk
+from tpu_als.obs import trace
+from tpu_als.ops.solve import (
+    compute_yty,
+    normal_eq_explicit,
+    normal_eq_implicit,
+    solve_nnls,
+    solve_spd,
+)
+
+
+class AttributionUnsupported(ValueError):
+    """The resolved solve path has no decomposed twin (CG / fused-kernel
+    ablation configs) — attribution covers the production exact paths."""
+
+
+_gather = jax.jit(lambda V_comp, c: V_comp[c])
+_yty = jax.jit(compute_yty)
+_ne_explicit = jax.jit(normal_eq_explicit)
+_ne_implicit = jax.jit(normal_eq_implicit)
+_solve_spd = jax.jit(lambda A, b, count: solve_spd(A, b, count))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter(out, rows, x):
+    # padding rows carry index num_rows -> out of bounds -> dropped
+    return out.at[rows].set(x, mode="drop", unique_indices=True)
+
+
+def _bucket_plan(buckets, rank, cfg, chunk_elems, gather):
+    """Iteration-invariant prep, hoisted out of the timed loop: the same
+    chunk split ``local_half_step`` computes, pre-sliced into per-chunk
+    device arrays with the rating stream pre-cast to compute dtype."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    plan = []
+    for b in buckets:
+        nb, w = b.cols.shape
+        chunk = trainer_chunk(nb, w, rank, chunk_elems, fused_gather=gather)
+        nchunks = nb // chunk
+        cols = b.cols.reshape(nchunks, chunk, w)
+        vals = b.vals.astype(cdt).reshape(nchunks, chunk, w)
+        mask = b.mask.astype(cdt).reshape(nchunks, chunk, w)
+        plan.append({
+            "nb": nb, "rows": b.rows,
+            "chunks": [(cols[k], vals[k], mask[k]) for k in range(nchunks)],
+        })
+    return plan
+
+
+def make_attributed_step(user_buckets, item_buckets, num_users, num_items,
+                         cfg: AlsConfig, user_chunk_elems=1 << 19,
+                         item_chunk_elems=1 << 19, sink=None):
+    """Build the decomposed fence-timed twin of ``core.als.make_step``.
+
+    Same signature contract: returns ``step(U, V) -> (U, V)`` computing
+    the identical iteration (item half then user half), but as per-stage
+    jitted calls bracketed by ``obs.trace.stage`` fences.  Per-stage
+    seconds land in ``train.stage_seconds{stage=...}`` and, when a
+    ``sink`` dict is given, accumulate into it keyed by stage name.
+    """
+    resolved = resolve_solve_path(cfg, cfg.rank)
+    path = resolved["resolved_solve_path"]
+    gather = path.startswith("gatherfused")
+    if cfg.cg_iters > 0 or path == "fused_pallas":
+        raise AttributionUnsupported(
+            f"no decomposed twin for resolved solve path {path!r} "
+            "(attribution covers the exact einsum / gather-fused paths)")
+    gather_interpret = not resolved["on_tpu"]
+    r = cfg.rank
+    cdt = jnp.dtype(cfg.compute_dtype)
+    reg = jnp.float32(cfg.reg_param)
+    alpha = jnp.float32(cfg.alpha)
+
+    if cfg.nonnegative:
+        solve_fn = jax.jit(
+            functools.partial(solve_nnls, sweeps=cfg.nnls_sweeps))
+    else:
+        solve_fn = _solve_spd
+
+    item_plan = _bucket_plan(item_buckets, r, cfg, item_chunk_elems, gather)
+    user_plan = _bucket_plan(user_buckets, r, cfg, user_chunk_elems, gather)
+
+    def ne_fused(V_comp, c, v, m, YtY):
+        from tpu_als.ops.pallas_gather_ne import (
+            gather_normal_eq_explicit,
+            gather_normal_eq_implicit,
+        )
+
+        if cfg.implicit_prefs:
+            return gather_normal_eq_implicit(
+                V_comp, c, v, m, reg, alpha, YtY.astype(jnp.float32),
+                interpret=gather_interpret)
+        return gather_normal_eq_explicit(
+            V_comp, c, v, m, reg, interpret=gather_interpret)
+
+    def half_step(V_full, plan, num_rows, YtY):
+        with trace.stage("gather_stream", sink) as keep:
+            V_comp = keep(V_full.astype(cdt))
+        with trace.stage("scatter", sink) as keep:
+            out = keep(jnp.zeros((num_rows, r), dtype=jnp.float32))
+        for b in plan:
+            xs = []
+            for c, v, m in b["chunks"]:
+                if gather:
+                    with trace.stage("gather_fused_ne", sink) as keep:
+                        A, rhs, count = keep(ne_fused(V_comp, c, v, m, YtY))
+                else:
+                    with trace.stage("gather_stream", sink) as keep:
+                        Vg = keep(_gather(V_comp, c))
+                    with trace.stage("normal_eq", sink) as keep:
+                        if cfg.implicit_prefs:
+                            A, rhs, count = keep(_ne_implicit(
+                                Vg, v, m, reg, alpha,
+                                YtY.astype(jnp.float32)))
+                        else:
+                            A, rhs, count = keep(_ne_explicit(Vg, v, m, reg))
+                with trace.stage("solve", sink) as keep:
+                    xs.append(keep(solve_fn(A.astype(jnp.float32),
+                                            rhs.astype(jnp.float32), count)))
+            with trace.stage("scatter", sink) as keep:
+                out = keep(_scatter(out, b["rows"],
+                                    jnp.concatenate(xs, axis=0)
+                                    .reshape(b["nb"], r)))
+        return out
+
+    def step(U, V):
+        if cfg.implicit_prefs:
+            with trace.stage("yty", sink) as keep:
+                YtY_u = keep(_yty(U))
+            V = half_step(U, item_plan, num_items, YtY_u)
+            with trace.stage("yty", sink) as keep:
+                YtY_v = keep(_yty(V))
+            U = half_step(V, user_plan, num_users, YtY_v)
+        else:
+            V = half_step(U, item_plan, num_items, None)
+            U = half_step(V, user_plan, num_users, None)
+        return U, V
+
+    return step
+
+
+def measure_attributed(user_csr, item_csr, cfg: AlsConfig, iters=2,
+                       warmup=1, compare_fused=True):
+    """Run ``iters`` fence-timed attributed iterations (after ``warmup``
+    un-timed ones to absorb compiles) and return per-stage seconds.
+
+    Also times the PRODUCTION fused step on the same problem (same
+    warmup discipline) so the report can state the attribution twin's
+    overhead honestly.  Returns a dict with ``stage_seconds`` (per-iter,
+    keyed by roofline stage name), ``wall_s_per_iter``, ``coverage``
+    (sum of stages / wall — the ≥0.9 acceptance bound),
+    ``unattributed_s_per_iter``, and ``fused_s_per_iter``.
+    """
+    num_users, num_items = user_csr.num_rows, item_csr.num_rows
+    ub = jax.device_put(user_csr.device_buckets())
+    ib = jax.device_put(item_csr.device_buckets())
+    key = jax.random.PRNGKey(cfg.seed)
+    ku, kv = jax.random.split(key)
+
+    sink = {}
+    with trace.stage_attribution():
+        astep = make_attributed_step(
+            ub, ib, num_users, num_items, cfg,
+            user_csr.chunk_elems, item_csr.chunk_elems, sink=sink)
+        U = init_factors(ku, num_users, cfg.rank)
+        V = init_factors(kv, num_items, cfg.rank)
+        for _ in range(warmup):
+            U, V = astep(U, V)
+        jax.block_until_ready((U, V))
+        sink.clear()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            U, V = astep(U, V)
+        jax.block_until_ready((U, V))
+        wall = (time.perf_counter() - t0) / iters
+
+    stage_seconds = {k: v / iters for k, v in sink.items()}
+    attributed = sum(stage_seconds.values())
+    out = {
+        "stage_seconds": stage_seconds,
+        "wall_s_per_iter": wall,
+        "sum_stage_s_per_iter": attributed,
+        "coverage": attributed / wall if wall else 0.0,
+        "unattributed_s_per_iter": wall - attributed,
+        "resolved_solve_path": resolve_solve_path(
+            cfg, cfg.rank)["resolved_solve_path"],
+        "iters": int(iters), "warmup": int(warmup),
+    }
+    if compare_fused:
+        step = make_step(ub, ib, num_users, num_items, cfg,
+                         user_csr.chunk_elems, item_csr.chunk_elems)
+        U = init_factors(ku, num_users, cfg.rank)
+        V = init_factors(kv, num_items, cfg.rank)
+        for _ in range(warmup):
+            U, V = step(U, V)
+        jax.block_until_ready((U, V))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            U, V = step(U, V)
+        jax.block_until_ready((U, V))
+        out["fused_s_per_iter"] = (time.perf_counter() - t0) / iters
+    return out
+
+
+def attribution_report(measured, rl):
+    """Join measured per-stage seconds against a ``roofline()`` report.
+
+    One row per stage present in EITHER side (a modeled stage with no
+    measurement — e.g. ``collective`` on one device — shows measured
+    None; a measured stage the model lacks shows floor None), each with
+    gap × (measured / modeled floor) and % of the measured iteration.
+    """
+    wall = measured["wall_s_per_iter"]
+    stage_s = dict(measured["stage_seconds"])
+    rows = []
+    for s in rl["stages"]:
+        m = stage_s.pop(s["name"], None)
+        rows.append({
+            "stage": s["name"], "measured_s": m,
+            "floor_s": s["floor_seconds"], "bound": s["bound"],
+            "gap_x": (m / s["floor_seconds"]
+                      if m is not None and s["floor_seconds"] else None),
+            "pct_of_iter": (100.0 * m / wall
+                            if m is not None and wall else None),
+        })
+    for name, m in sorted(stage_s.items()):
+        rows.append({"stage": name, "measured_s": m, "floor_s": None,
+                     "bound": None, "gap_x": None,
+                     "pct_of_iter": 100.0 * m / wall if wall else None})
+    report = {
+        "config": rl["config"],
+        "rows": rows,
+        "wall_s_per_iter": wall,
+        "sum_stage_s_per_iter": measured["sum_stage_s_per_iter"],
+        "unattributed_s_per_iter": measured["unattributed_s_per_iter"],
+        "coverage": measured["coverage"],
+        "roofline_floor_s_per_iter": rl["roofline_floor_s_per_iter"],
+        "resolved_solve_path": measured["resolved_solve_path"],
+        "iters": measured["iters"],
+    }
+    if "fused_s_per_iter" in measured:
+        report["fused_s_per_iter"] = measured["fused_s_per_iter"]
+        report["attribution_overhead_x"] = (
+            wall / measured["fused_s_per_iter"]
+            if measured["fused_s_per_iter"] else None)
+    return report
+
+
+def render_attribution(report):
+    """Human-readable gap table for ``tpu_als observe attribution``."""
+    c = report["config"]
+    lines = [
+        ("ALS stage attribution — measured vs modeled floor — "
+         f"{c['n_users']}x{c['n_items']} nnz={c['nnz']} rank={c['rank']} "
+         f"{c['dtype']} {'implicit' if c['implicit'] else 'explicit'} "
+         f"waste={c['padding_waste']:.3f} "
+         f"path={report['resolved_solve_path']}"),
+        f"({report['iters']} fence-timed iterations, warm)",
+        "",
+        f"{'stage':<16}{'measured s':>12}{'floor s':>12}"
+        f"{'gap x':>9}{'% iter':>8}",
+    ]
+
+    def num(v, fmt, width):
+        return f"{v:>{width}{fmt}}" if v is not None else f"{'-':>{width}}"
+
+    for row in report["rows"]:
+        lines.append(
+            f"{row['stage']:<16}"
+            + num(row["measured_s"], ".5f", 12)
+            + num(row["floor_s"], ".5f", 12)
+            + num(row["gap_x"], ".1f", 9)
+            + num(row["pct_of_iter"], ".1f", 8))
+    cov = 100.0 * report["coverage"]
+    lines += [
+        f"{'sum of stages':<16}"
+        f"{report['sum_stage_s_per_iter']:>12.5f}{'':>12}{'':>9}"
+        f"{cov:>8.1f}",
+        f"{'unattributed':<16}"
+        f"{report['unattributed_s_per_iter']:>12.5f}{'':>12}{'':>9}"
+        f"{100.0 - cov:>8.1f}",
+        "",
+        f"wall (attributed twin):  {report['wall_s_per_iter']:.5f} s/iter",
+        f"roofline floor:          "
+        f"{report['roofline_floor_s_per_iter']:.5f} s/iter",
+    ]
+    if report.get("fused_s_per_iter"):
+        lines.append(
+            f"production fused step:   {report['fused_s_per_iter']:.5f} "
+            f"s/iter  (twin overhead "
+            f"{report['attribution_overhead_x']:.2f}x; the fused step "
+            "is the real speed, the twin is where the time goes)")
+    return "\n".join(lines)

@@ -21,6 +21,9 @@ into one assertable run each:
 ``preempt-resume``       the chaos_smoke kill-and-resume flow: CLI train
                          preempted at an iteration boundary exits 43,
                          ``--resume auto`` finishes cleanly.
+``flight-recorder``      every request breaches a microsecond SLO; the
+                         engine's flight recorder dumps per-request span
+                         breakdowns as ``flight_record`` events.
 
 All run on CPU in seconds (they are tier-1 tests via
 tests/test_scenarios.py) and bank ``BENCH_scenario_<name>.json`` on
@@ -612,6 +615,88 @@ def _preempt_resume():
 
 
 # ---------------------------------------------------------------------------
+# flight-recorder
+
+
+def _fr_publish(ctx):
+    from tpu_als.serving import ServingEngine
+
+    c = ctx.config
+    rng = np.random.default_rng(c["seed"])
+    U = rng.normal(size=(c["users"], c["rank"])).astype(np.float32)
+    V = rng.normal(size=(c["items"], c["rank"])).astype(np.float32)
+    # a microsecond SLO no real request can meet: every served batch is
+    # a breach, so the recorder's dump path runs on ordinary traffic
+    engine = ServingEngine(k=c["k"], slo_s=c["slo_us"] / 1e6)
+    engine.publish(U, V)
+    engine.warmup()
+    engine.start()
+    ctx.defer(engine.stop)
+    ctx.state.update(engine=engine, U=U, rng=rng,
+                     counts={"answered": 0, "shed": 0, "expired": 0,
+                             "hard_failures": 0})
+
+
+def _fr_load(ctx):
+    c, s = ctx.config, ctx.state
+    _submit_open_loop(s["engine"], s["U"], c["qps"], c["load_s"],
+                      s["rng"], s["counts"])
+    ctx.facts.update(s["counts"])
+
+
+def _fr_collect(ctx):
+    from tpu_als import obs
+    from tpu_als.obs.trace import SPAN_KEYS
+
+    reg = obs.default_registry()
+    records = [e for e in reg._events
+               if e.get("type") == "flight_record"]
+    # the acceptance shape: an slo_breach dump whose record carries the
+    # FULL per-request span breakdown (rescore stays None — it is fused
+    # into the int8 top-k kernel and not separately fenceable)
+    complete = [
+        r for r in records
+        if r.get("trigger") == "slo_breach" and r.get("status") == "ok"
+        and set(r.get("spans") or ()) == set(SPAN_KEYS)
+        and all(r["spans"][k] is not None
+                for k in ("admission", "queue_wait", "score", "respond"))]
+    ctx.facts["flight_records"] = len(records)
+    ctx.facts["complete_breach_records"] = len(complete)
+
+
+def _flight_recorder():
+    return ScenarioSpec(
+        name="flight-recorder",
+        doc="force an SLO breach on every request (microsecond slo_us) "
+            "and assert the serving flight recorder dumps full "
+            "per-request span breakdowns as flight_record events.",
+        defaults=dict(seed=0, users=200, items=800, rank=16, k=10,
+                      slo_us=1.0, qps=200.0, load_s=0.1),
+        phases=(
+            Phase("publish-and-warmup", _fr_publish,
+                  "synthetic factors behind a microsecond SLO"),
+            Phase("load", _fr_load,
+                  "open-loop traffic; every answer is a breach"),
+            Phase("collect", _fr_collect,
+                  "count dumped records, check span completeness"),
+        ),
+        assertions=(
+            Assertion("flight_records_dumped", "event",
+                      event="flight_record", op=">=", value=8,
+                      doc="the last-N trace ring reached the obs trail"),
+            Assertion("span_breakdown_complete", "fact",
+                      fact="complete_breach_records", op=">=", value=8,
+                      doc="each record carries admission/queue_wait/"
+                          "score/respond timings"),
+            Assertion("requests_served", "counter",
+                      metric="serving.requests", op=">=", value=12),
+            Assertion("no_hard_failures", "fact", fact="hard_failures",
+                      op="==", value=0),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 _BUILDERS = (
@@ -620,6 +705,7 @@ _BUILDERS = (
     _torn_publish,
     _cold_start,
     _preempt_resume,
+    _flight_recorder,
 )
 
 SCENARIOS = {s.name: s for s in (b() for b in _BUILDERS)}

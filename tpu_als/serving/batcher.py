@@ -66,7 +66,7 @@ class Ticket:
     """
 
     __slots__ = ("payload", "k", "deadline", "t_submit", "t_dequeue",
-                 "_event", "_result", "_error")
+                 "t_admit", "_event", "_result", "_error")
 
     def __init__(self, payload, k, deadline):
         self.payload = payload
@@ -74,6 +74,7 @@ class Ticket:
         self.deadline = deadline        # absolute perf_counter time, or None
         self.t_submit = time.perf_counter()
         self.t_dequeue = None
+        self.t_admit = None    # admission DURATION (engine submit -> queued)
         self._event = threading.Event()
         self._result = None
         self._error = None

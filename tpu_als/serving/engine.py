@@ -28,6 +28,13 @@ tickets.  The pieces the rest of the stack plugs into:
 - **Metrics.**  enqueue/score/e2e latency histograms, queue-depth
   gauge, shed/expired/fallback counters — all through ``tpu_als.obs``
   (see docs/serving.md for the vocabulary).
+- **Flight recorder.**  Every request outcome is recorded into a
+  bounded ring (:class:`~tpu_als.obs.trace.FlightRecorder`) with its
+  admission / queue-wait / score / rescore / respond span breakdown; on
+  an SLO breach (``slo_s``), a shed, or a degraded-mode (exact-fallback)
+  answer, the ring's not-yet-dumped tail is emitted as ``flight_record``
+  events — so a p99 outlier leaves the last N request traces in the obs
+  trail instead of vanishing into a histogram bucket.
 """
 
 from __future__ import annotations
@@ -41,12 +48,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_als import obs
+from tpu_als.obs.trace import FlightRecorder
 from tpu_als.ops.topk import chunked_topk_scores
 from tpu_als.resilience import faults
 from tpu_als.serving.batcher import (
     DEFAULT_BUCKETS,
     DeadlineExceeded,
     MicroBatcher,
+    Overloaded,
     bucket_for,
 )
 from tpu_als.serving.index import Int8CandidateIndex
@@ -86,14 +95,22 @@ class ServingEngine:
     bucket); per-request ``k`` may be smaller and is trimmed at
     completion.  ``buckets`` are the padded batch shapes; keep the set
     small — each is one executable per (path, catalog shape).
+
+    ``slo_s``: end-to-end latency objective; a completed request slower
+    than this triggers a flight-recorder dump (``flight_record`` events
+    carrying the last ``flight_capacity`` per-request traces).  None
+    disables the breach trigger; shed and degraded dumps stay on.
     """
 
     def __init__(self, k=10, buckets=DEFAULT_BUCKETS, shortlist_k=64,
                  max_queue=1024, max_wait_s=0.002,
-                 default_deadline_s=None, item_chunk=8192):
+                 default_deadline_s=None, item_chunk=8192,
+                 slo_s=None, flight_capacity=64):
         self.k = int(k)
         self.shortlist_k = int(shortlist_k)
         self.item_chunk = int(item_chunk)
+        self.slo_s = float(slo_s) if slo_s is not None else None
+        self.flight = FlightRecorder(flight_capacity)
         self.batcher = MicroBatcher(
             buckets=buckets, max_queue=max_queue, max_wait_s=max_wait_s,
             default_deadline_s=default_deadline_s)
@@ -176,6 +193,7 @@ class ServingEngine:
         when shedding, ``NoModelPublished`` before the first publish,
         ``ValueError`` on a malformed payload.
         """
+        t_enter = time.perf_counter()
         m = self._model
         if m is None:
             raise NoModelPublished("publish(U, V) before submitting")
@@ -192,7 +210,15 @@ class ServingEngine:
                 raise ValueError(
                     f"fold-in payload shape {payload.shape} != "
                     f"({m.rank},) (the published rank)")
-        t = self.batcher.submit(payload, k=k, deadline_s=deadline_s)
+        try:
+            t = self.batcher.submit(payload, k=k, deadline_s=deadline_s)
+        except Overloaded:
+            # a shed never queues: its trace is the admission span alone
+            self.flight.record(
+                "shed", {"admission": time.perf_counter() - t_enter})
+            self.flight.dump("shed")
+            raise
+        t.t_admit = time.perf_counter() - t_enter
         obs.counter("serving.requests")
         return t
 
@@ -238,6 +264,12 @@ class ServingEngine:
                 for t in batch:
                     if not t.done():
                         t.fail(e)
+                        self.flight.record(
+                            "failed",
+                            {"admission": t.t_admit,
+                             "queue_wait": (t.t_dequeue - t.t_submit
+                                            if t.t_dequeue else None)},
+                            error=type(e).__name__)
                 if not isinstance(e, faults.InjectedFault):
                     obs.emit("warning", what="serving.batch",
                              reason=f"{type(e).__name__}: {e}")
@@ -253,6 +285,12 @@ class ServingEngine:
         for t in batch:
             if t.deadline is not None and now > t.deadline:
                 obs.counter("serving.expired")
+                self.flight.record(
+                    "expired",
+                    {"admission": t.t_admit,
+                     "queue_wait": (t.t_dequeue - t.t_submit
+                                    if t.t_dequeue else None)},
+                    e2e_seconds=now - t.t_submit)
                 t.fail(DeadlineExceeded(
                     "deadline passed while queued "
                     f"({now - t.t_submit:.4f}s since submit)"))
@@ -292,10 +330,29 @@ class ServingEngine:
                 item_chunk=min(self.item_chunk, max(m.V.shape[0], 1)))
         s = np.asarray(s)
         ix = np.asarray(ix)
-        obs.histogram("serving.score_seconds",
-                      time.perf_counter() - t0, path=path)
+        score_s = time.perf_counter() - t0
+        obs.histogram("serving.score_seconds", score_s, path=path)
         done = time.perf_counter()
+        breached = False
         for j, t in enumerate(live):
             kk = t.k or self.k
             t.complete((s[j, :kk], ix[j, :kk]))
-            obs.histogram("serving.e2e_seconds", done - t.t_submit)
+            e2e = done - t.t_submit
+            obs.histogram("serving.e2e_seconds", e2e)
+            # rescore is fused into the int8 top-k executable (one
+            # jitted call — serving/index.py), so it is not separable
+            # from score without un-fusing the kernel; None records that
+            self.flight.record(
+                "ok",
+                {"admission": t.t_admit,
+                 "queue_wait": (t.t_dequeue - t.t_submit
+                                if t.t_dequeue else None),
+                 "score": score_s,
+                 "respond": time.perf_counter() - done},
+                e2e_seconds=e2e, path=path)
+            if self.slo_s is not None and e2e > self.slo_s:
+                breached = True
+        if breached:
+            self.flight.dump("slo_breach")
+        elif index is not None and not use_index:
+            self.flight.dump("degraded")

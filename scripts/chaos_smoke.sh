@@ -1,6 +1,6 @@
 #!/bin/bash
 # Chaos smoke: the resilience subsystem's CI gate, CPU-only (no
-# accelerator, no network).  Three stages, fail-fast:
+# accelerator, no network).  Four stages, fail-fast:
 #
 #   1. the fast chaos matrix — every fault point exercised with at least
 #      one injected failure (tests/test_resilience.py, tier-1 subset)
@@ -13,27 +13,41 @@
 #      of this flow, shared with tests/test_scenarios.py): preempt the
 #      CLI at an iteration boundary (deterministic TPU_ALS_PREEMPT_AT
 #      knob), assert the distinct exit code 43, resume with
-#      --resume auto, assert success + checkpoint discovery.
+#      --resume auto, assert success + checkpoint discovery,
+#   4. the numerical-guardrail scenarios (solver-divergence +
+#      poisoned-stream: injected NaN -> rollback -> clean-band RMSE;
+#      poisoned stream -> every bad record quarantined), then the bench
+#      regression gate (scripts/bench_gate.sh — the PR 7 gate
+#      scenario_smoke and serve_smoke already run): chaos changes must
+#      not regress the headline perf path either.
 #
-# Usage: scripts/chaos_smoke.sh   (from the repo root; ~1 min on CPU)
+# Usage: scripts/chaos_smoke.sh   (from the repo root; ~2 min on CPU)
 set -u
 
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 fail=0
 
-echo "== chaos smoke 1/3: fault-point matrix (fast tier) =="
+echo "== chaos smoke 1/4: fault-point matrix (fast tier) =="
 python -m pytest tests/test_resilience.py tests/test_resume.py \
     -q -m 'not slow' -p no:cacheprovider || fail=1
 
-echo "== chaos smoke 2/3: obs schema (static) =="
+echo "== chaos smoke 2/4: obs schema (static) =="
 python scripts/check_obs_schema.py || fail=1
 
-echo "== chaos smoke 3/3: end-to-end kill-and-resume (scenario) =="
+echo "== chaos smoke 3/4: end-to-end kill-and-resume (scenario) =="
 # the preempt-resume scenario asserts exit code 43 on the preempted
 # train, exit 0 + "resuming from" discovery + saved manifest.json on
 # the --resume auto rerun (tpu_als/scenario/library.py)
 python -m tpu_als.cli scenario run preempt-resume || fail=1
+
+echo "== chaos smoke 4/4: guardrail scenarios + bench regression gate =="
+# the two numerical-health scenarios (tpu_als/scenario/library.py) are
+# the end-to-end proof of the guardrails contract; the bench gate then
+# pins the disarmed headline path against BENCH_BASELINE.json
+python -m tpu_als.cli scenario run solver-divergence || fail=1
+python -m tpu_als.cli scenario run poisoned-stream || fail=1
+scripts/bench_gate.sh || fail=1
 
 if [ "$fail" -ne 0 ]; then
     echo "chaos smoke: FAIL" >&2

@@ -23,12 +23,20 @@ Non-literal names are a violation for WRITE methods only; dynamic
 reads (the scenario evaluator resolving declared assertion fields) are
 allowed because their literals are validated at the declaration site.
 
+The fault-injection vocabulary gets the same treatment: every literal
+``faults.check( / .armed( / .hits("point")`` site and every scenario
+``fault_spec="..."`` declaration is validated against
+``tpu_als.resilience.faults.FAULT_POINTS`` (specs additionally through
+``parse_spec``, so trigger-grammar drift fails here too) — a typo'd
+point name is otherwise a fault that silently never fires, the exact
+cold-path gap this script exists to close.
+
 Run directly (exit 1 + file:line diagnostics on violation) or from the
 tier-1 suite (tests/test_obs.py).  ``--paths`` overrides the scanned
 tree (the negative test exercises the failure mode on a fixture file).
 
-Deliberately jax-free and import-light: only tpu_als.obs.schema is
-imported, which itself imports nothing.
+Deliberately jax-free and import-light: only tpu_als.obs.schema and
+tpu_als.resilience.faults are imported, both stdlib-only.
 """
 
 from __future__ import annotations
@@ -42,6 +50,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from tpu_als.obs import schema  # noqa: E402
+from tpu_als.resilience import faults  # noqa: E402
 
 # a counter/gauge/histogram/emit (write) or quantile/count/value (read
 # accessor) call with either a literal first argument (named groups
@@ -66,6 +75,16 @@ ASSERT_KW_RE = re.compile(
     r"(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)")
 ASSERT_DEN_RE = re.compile(r"\bden\s*=\s*\((?P<body>[^)]*)\)")
 _STR_RE = re.compile(r"['\"]([^'\"]+)['\"]")
+
+# fault-point literals: consultation sites (check/armed/hits) must name
+# a declared point; scenario fault_spec= strings (possibly implicit-
+# concat inside parens) must survive parse_spec whole
+FAULT_CALL_RE = re.compile(
+    r"\bfaults\.(?P<method>check|armed|hits)\(\s*"
+    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<expr>[^)\s][^),]*))")
+FAULT_SPEC_RE = re.compile(
+    r"\bfault_spec\s*=\s*(?P<body>\([^)]*\)|['\"][^'\"]*['\"])",
+    re.DOTALL)
 
 # inline event dicts: a line carrying both a "ts" key and a literal
 # "type" value (the hand-built shape allowed where importing tpu_als is
@@ -171,6 +190,35 @@ def check_file(path):
                         f"{where}: Assertion(den=...) entry {name!r} is "
                         "not a declared metric (declare it in "
                         "tpu_als.obs.schema.METRICS)")
+
+    in_faults = in_obs or path.replace(os.sep, "/").endswith(
+        "tpu_als/resilience/faults.py")
+    for m in FAULT_CALL_RE.finditer(text) if not in_obs else ():
+        method, name = m.group("method"), m.group("name")
+        where = f"{rel}:{line_of(m.start())}"
+        if name is None:
+            if not in_faults:
+                errors.append(
+                    f"{where}: faults.{method}() with a non-literal "
+                    f"point ({m.group('expr').strip()!r}) — the static "
+                    "check cannot validate it; use a literal from "
+                    "tpu_als.resilience.faults.FAULT_POINTS")
+        elif name not in faults.FAULT_POINTS:
+            errors.append(
+                f"{where}: faults.{method} of undeclared fault point "
+                f"{name!r} (declare it in "
+                "tpu_als.resilience.faults.FAULT_POINTS)")
+
+    for m in FAULT_SPEC_RE.finditer(text) if not in_obs else ():
+        where = f"{rel}:{line_of(m.start())}"
+        spec = "".join(_STR_RE.findall(m.group("body")))
+        if not spec:
+            continue                         # non-literal: runtime checks it
+        try:
+            faults.parse_spec(spec)
+        except faults.FaultSpecError as e:
+            errors.append(f"{where}: fault_spec {spec!r} does not parse: "
+                          f"{e}")
 
     for lineno, line in enumerate(text.splitlines(), 1):
         if not INLINE_TS_RE.search(line):

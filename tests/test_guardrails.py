@@ -1,0 +1,346 @@
+"""Numerical-health guardrails (docs/resilience.md): mode arming,
+divergence sentinels, bounded rollback-and-retry, the disarmed
+byte-identity pin, and the poisoned-input quarantine.
+
+The disarmed pin is the load-bearing test: guardrails may not perturb
+the production training step's traced graph — the ne_audit/attribution
+discipline — so `--guardrails off` costs one mode check per train()
+call and nothing on device.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_als import ALS, ColumnarFrame, obs
+from tpu_als.core.als import AlsConfig, init_factors, make_step, train
+from tpu_als.core.ratings import (
+    RATING_ABS_MAX,
+    build_csr_buckets,
+    invalid_rating_mask,
+)
+from tpu_als.io.stream import stream_ingest
+from tpu_als.resilience import faults, guardrails
+from tpu_als.resilience.guardrails import Monitor, TrainDiverged
+from tpu_als.resilience.retry import RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    monkeypatch.delenv(guardrails.ENV_VAR, raising=False)
+    guardrails.clear_mode()
+    faults.clear()
+    obs.reset()
+    yield
+    guardrails.clear_mode()
+    faults.clear()
+    obs.reset()
+
+
+def _events(etype):
+    return [e for e in obs.default_registry()._events if e["type"] == etype]
+
+
+def _problem(nU=60, nI=40, nnz=800, seed=0):
+    gen = np.random.default_rng(seed)
+    u = gen.integers(0, nU, nnz)
+    i = gen.integers(0, nI, nnz)
+    r = gen.uniform(0.5, 5.0, nnz).astype(np.float32)
+    ucsr = build_csr_buckets(u, i, r, nU, min_width=4, chunk_elems=1 << 12)
+    icsr = build_csr_buckets(i, u, r, nI, min_width=4, chunk_elems=1 << 12)
+    return ucsr, icsr
+
+
+def _factors(cfg, nU, nI):
+    ku, kv = jax.random.split(jax.random.PRNGKey(cfg.seed))
+    return init_factors(ku, nU, cfg.rank), init_factors(kv, nI, cfg.rank)
+
+
+# -- mode arming ------------------------------------------------------------
+
+def test_mode_resolution(monkeypatch):
+    assert guardrails.guardrails_mode() == "off"
+    assert not guardrails.armed()
+    monkeypatch.setenv(guardrails.ENV_VAR, "warn")
+    assert guardrails.guardrails_mode() == "warn"
+    # an explicit set_mode wins over the env
+    guardrails.set_mode("recover")
+    assert guardrails.guardrails_mode() == "recover"
+    guardrails.clear_mode()
+    assert guardrails.guardrails_mode() == "warn"
+
+
+def test_garbage_modes_raise(monkeypatch):
+    with pytest.raises(ValueError, match="unknown guardrails mode"):
+        guardrails.set_mode("loud")
+    monkeypatch.setenv(guardrails.ENV_VAR, "recove")
+    with pytest.raises(ValueError, match=guardrails.ENV_VAR):
+        guardrails.guardrails_mode()
+
+
+def test_scoped_restores_on_exit():
+    with guardrails.scoped("warn"):
+        assert guardrails.guardrails_mode() == "warn"
+        with guardrails.scoped("recover"):
+            assert guardrails.guardrails_mode() == "recover"
+        assert guardrails.guardrails_mode() == "warn"
+    assert guardrails.guardrails_mode() == "off"
+
+
+# -- sentinels --------------------------------------------------------------
+
+def test_health_stats_values(rng):
+    U = rng.normal(size=(7, 4)).astype(np.float32)
+    V = rng.normal(size=(5, 4)).astype(np.float32)
+    s = np.asarray(guardrails.health_stats(jnp.array(U), jnp.array(V)))
+    assert s[0] == 1.0
+    np.testing.assert_allclose(
+        s[1], np.sqrt((U * U).sum(1).max()), rtol=1e-5)
+    np.testing.assert_allclose(
+        s[2], np.sqrt((V * V).sum(1).max()), rtol=1e-5)
+    np.testing.assert_allclose(
+        s[3], np.sqrt((U * U).sum() + (V * V).sum()), rtol=1e-5)
+    U[3, 1] = np.nan
+    s = np.asarray(guardrails.health_stats(jnp.array(U), jnp.array(V)))
+    assert s[0] == 0.0
+
+
+def test_judge_trips_each_sentinel(rng):
+    cfg = AlsConfig(rank=4)
+    mon = Monitor(cfg, "warn")
+    U = jnp.array(rng.normal(size=(6, 4)).astype(np.float32))
+    V = jnp.array(rng.normal(size=(5, 4)).astype(np.float32))
+    assert mon.judge(1, U, V) is None          # healthy baseline
+    assert mon.judge(2, U * jnp.nan, V) == "nonfinite"
+    assert mon.judge(3, U.at[0].set(1e5), V) == "norm_band"
+    # trend: large global-norm jump but every row inside the band
+    assert mon.judge(4, U * 300.0, V * 300.0) == "trend"
+    evs = _events("guardrail_tripped")
+    assert [e["sentinel"] for e in evs] == ["nonfinite", "norm_band",
+                                            "trend"]
+    assert all(e["mode"] == "warn" for e in evs)
+
+
+def test_judge_trend_baseline_only_advances_when_healthy(rng):
+    cfg = AlsConfig(rank=4)
+    mon = Monitor(cfg, "warn")
+    U = jnp.array(rng.normal(size=(6, 4)).astype(np.float32))
+    V = jnp.array(rng.normal(size=(5, 4)).astype(np.float32))
+    assert mon.judge(1, U, V) is None
+    base = mon._prev_fro
+    assert mon.judge(2, U * jnp.nan, V) == "nonfinite"
+    assert mon._prev_fro == base               # tripped -> baseline frozen
+    assert mon.judge(3, U * 2.0, V * 2.0) is None
+    assert mon._prev_fro > base
+
+
+# -- rollback ---------------------------------------------------------------
+
+def test_rollback_perturbs_snapshot_and_bumps_reg(rng):
+    cfg = AlsConfig(rank=4, seed=3, reg_param=0.1)
+    mon = Monitor(cfg, "recover")
+    U = jnp.array(rng.normal(size=(6, 4)).astype(np.float32))
+    V = jnp.array(rng.normal(size=(5, 4)).astype(np.float32))
+    mon.keep_last_good(U, V)
+    U2, V2, scale = mon.rollback(2, "nonfinite")
+    assert scale == guardrails.REG_BUMP_FACTOR
+    # perturbed, but still within PERTURB_SCALE noise of the snapshot
+    assert not np.array_equal(np.asarray(U2), np.asarray(U))
+    np.testing.assert_allclose(np.asarray(U2), np.asarray(U), atol=1e-2)
+    np.testing.assert_allclose(np.asarray(V2), np.asarray(V), atol=1e-2)
+    assert obs.counter_value("train.rollbacks") == 1
+    ev = _events("train_rollback")[0]
+    assert ev["attempt"] == 1 and ev["sentinel"] == "nonfinite"
+    np.testing.assert_allclose(ev["reg_param"],
+                               0.1 * guardrails.REG_BUMP_FACTOR)
+
+
+def test_rollback_is_deterministic(rng):
+    U = jnp.array(rng.normal(size=(6, 4)).astype(np.float32))
+    V = jnp.array(rng.normal(size=(5, 4)).astype(np.float32))
+    outs = []
+    for _ in range(2):
+        mon = Monitor(AlsConfig(rank=4, seed=3), "recover")
+        mon.keep_last_good(U, V)
+        U2, _, _ = mon.rollback(2, "trend")
+        outs.append(np.asarray(U2))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_rollback_budget_exhaustion_raises_typed(rng):
+    mon = Monitor(AlsConfig(rank=4), "recover",
+                  policy=RetryPolicy(max_attempts=1, base_delay=0.0,
+                                     jitter=0.0))
+    U = jnp.array(rng.normal(size=(6, 4)).astype(np.float32))
+    V = jnp.array(rng.normal(size=(5, 4)).astype(np.float32))
+    mon.keep_last_good(U, V)
+    mon.rollback(2, "nonfinite")
+    with pytest.raises(TrainDiverged) as ei:
+        mon.rollback(2, "nonfinite")
+    assert ei.value.rollbacks == 1 and ei.value.sentinel == "nonfinite"
+
+
+def test_rollback_without_snapshot_raises(rng):
+    mon = Monitor(AlsConfig(rank=4), "recover")
+    with pytest.raises(TrainDiverged):
+        mon.rollback(1, "nonfinite")
+
+
+def test_retry_does_not_overwrite_snapshot(rng):
+    mon = Monitor(AlsConfig(rank=4), "recover")
+    U = jnp.array(rng.normal(size=(6, 4)).astype(np.float32))
+    V = jnp.array(rng.normal(size=(5, 4)).astype(np.float32))
+    mon.keep_last_good(U, V)
+    mon.keep_last_good(U * jnp.nan, V, retry=True)
+    assert np.all(np.isfinite(np.asarray(mon._snap[0])))
+
+
+# -- disarmed: the production path is untouched -----------------------------
+
+def test_disarmed_step_jaxpr_is_byte_identical():
+    """Arming state must not leak into the production step's traced
+    graph: the sentinels are a separate jitted reduction consulted at
+    the host-side iteration boundary, never woven into _step_jit."""
+    ucsr, icsr = _problem()
+    cfg = AlsConfig(rank=4, max_iter=2)
+    nU, nI = ucsr.num_rows, icsr.num_rows
+    ub = jax.device_put(ucsr.device_buckets())
+    ib = jax.device_put(icsr.device_buckets())
+    step = make_step(ub, ib, nU, nI, cfg,
+                     ucsr.chunk_elems, icsr.chunk_elems)
+    U0, V0 = _factors(cfg, nU, nI)
+    disarmed = str(jax.make_jaxpr(step)(U0, V0))
+    with guardrails.scoped("recover"):
+        armed = str(jax.make_jaxpr(step)(U0, V0))
+    assert disarmed == armed
+
+
+def test_warn_mode_factors_bitwise_match_disarmed():
+    ucsr, icsr = _problem()
+    cfg = AlsConfig(rank=4, max_iter=3)
+    U_off, V_off = train(ucsr, icsr, cfg)
+    with guardrails.scoped("warn"):
+        U_w, V_w = train(ucsr, icsr, cfg)
+    assert np.array_equal(np.asarray(U_off), np.asarray(U_w))
+    assert np.array_equal(np.asarray(V_off), np.asarray(V_w))
+    assert not _events("guardrail_tripped")    # healthy fit: no noise
+
+
+# -- end-to-end recovery from an injected mid-train NaN ---------------------
+
+def test_recover_mode_rolls_back_injected_nan():
+    ucsr, icsr = _problem(nU=80, nI=60, nnz=1500)
+    cfg = AlsConfig(rank=4, max_iter=4, reg_param=0.1)
+    faults.install("solve.gram=corrupt@nth=2")
+    with guardrails.scoped("recover"):
+        U, V = train(ucsr, icsr, cfg)
+    assert np.all(np.isfinite(np.asarray(U)))
+    assert np.all(np.isfinite(np.asarray(V)))
+    assert obs.counter_value("train.rollbacks") == 1
+    assert [e["sentinel"] for e in _events("guardrail_tripped")] \
+        == ["nonfinite"]
+    assert _events("train_rollback")[0]["iteration"] == 2
+
+
+def test_warn_mode_emits_but_never_rolls_back():
+    ucsr, icsr = _problem()
+    cfg = AlsConfig(rank=4, max_iter=3)
+    faults.install("solve.gram=corrupt@nth=2")
+    with guardrails.scoped("warn"):
+        train(ucsr, icsr, cfg)
+    assert _events("guardrail_tripped")
+    assert obs.counter_value("train.rollbacks") == 0
+    assert not _events("train_rollback")
+
+
+def test_recover_mode_raises_train_diverged_when_budget_spent():
+    # the fault fires on EVERY iteration: each retry re-trips until the
+    # rollback budget is gone, then the typed error surfaces
+    ucsr, icsr = _problem()
+    cfg = AlsConfig(rank=4, max_iter=4)
+    faults.install("solve.gram=corrupt@every=1")
+    with guardrails.scoped("recover"):
+        with pytest.raises(TrainDiverged):
+            train(ucsr, icsr, cfg)
+
+
+# -- poisoned-input quarantine ----------------------------------------------
+
+def test_invalid_rating_mask():
+    r = np.array([1.0, np.nan, np.inf, -np.inf, RATING_ABS_MAX,
+                  RATING_ABS_MAX * 2, -RATING_ABS_MAX * 2],
+                 dtype=np.float32)
+    np.testing.assert_array_equal(
+        invalid_rating_mask(r),
+        [False, True, True, True, False, True, True])
+
+
+def test_stream_quarantine_catches_every_bad_class(tmp_path):
+    lines = ["u0,i0,1.0", "u1,i1,2.0", "badline", "u2,i2,nan",
+             "u3,i3,1e40", "u4,i4,1e9", "u5,i5,3.0"]
+    p = tmp_path / "r.csv"
+    p.write_text("\n".join(lines) + "\n")
+    u, i, r, ul, il = stream_ingest(str(p), quarantine=True)
+    # exactly the clean rows survive, in order (labels may retain an
+    # interned entry for a post-parse-scrubbed row; the ROWS are gone)
+    np.testing.assert_allclose(r, [1.0, 2.0, 3.0])
+    assert [ul[k].decode() for k in u] == ["u0", "u1", "u5"]
+    assert [il[k].decode() for k in i] == ["i0", "i1", "i5"]
+    assert obs.counter_value("ingest.quarantined_rows") == 4
+    ev = _events("ingest_quarantined")[0]
+    # the strict native parser rejects 'nan'/'1e40' as malformed text;
+    # the huge-but-finite 1e9 parses and is scrubbed post-parse
+    assert ev["rows"] == 4
+    assert ev["reasons"]["malformed"] == 3
+    assert ev["reasons"]["out_of_range"] == 1
+    sink = (p.parent / "r.csv.quarantine" / "host0.bad").read_text()
+    for bad in ("badline", "u2,i2,nan", "u3,i3,1e40"):
+        assert bad in sink
+
+
+def test_stream_without_quarantine_still_raises(tmp_path):
+    p = tmp_path / "r.csv"
+    p.write_text("u0,i0,1.0\nbadline\n")
+    with pytest.raises(ValueError):
+        stream_ingest(str(p))
+    p.write_text("u0,i0,1.0\nu1,i1,2.0\n")
+    u, i, r, ul, il = stream_ingest(str(p))
+    assert obs.counter_value("ingest.quarantined_rows") == 0
+    assert not _events("ingest_quarantined")
+
+
+def test_estimator_armed_scrubs_poisoned_ratings(rng):
+    n = 200
+    u = rng.integers(0, 30, n)
+    i = rng.integers(0, 20, n)
+    r = rng.uniform(1.0, 5.0, n).astype(np.float32)
+    r[7] = np.nan
+    r[13] = 1e9
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    als = ALS(rank=4, maxIter=2, guardrails="warn")
+    model = als.fit(frame)
+    uf = np.stack([np.asarray(f) for f in model.userFactors["features"]])
+    assert np.all(np.isfinite(uf))
+    assert obs.counter_value("ingest.quarantined_rows") == 2
+    ev = _events("ingest_quarantined")[0]
+    assert ev["path"] == "<api>" and ev["rows"] == 2
+    assert ev["reasons"]["nonfinite"] == 1
+    assert ev["reasons"]["out_of_range"] == 1
+
+
+def test_estimator_disarmed_rejects_poisoned_ratings(rng):
+    n = 50
+    u = rng.integers(0, 10, n)
+    i = rng.integers(0, 8, n)
+    r = rng.uniform(1.0, 5.0, n).astype(np.float32)
+    r[3] = np.nan
+    frame = ColumnarFrame({"user": u, "item": i, "rating": r})
+    with pytest.raises(ValueError, match="non-finite"):
+        ALS(rank=4, maxIter=2).fit(frame)
+
+
+def test_estimator_rejects_unknown_guardrails_mode():
+    with pytest.raises(ValueError, match="unknown guardrails mode"):
+        ALS(guardrails="loud")

@@ -483,6 +483,31 @@ def test_check_obs_schema_catches_accessor_and_assertion_drift(tmp_path):
     assert p.stderr.count(str(bad.name)) == 5
 
 
+def test_check_obs_schema_catches_fault_point_drift(tmp_path):
+    """The fault-vocabulary extension: typo'd faults.check/armed/hits
+    literals and unparseable scenario fault_spec strings are violations;
+    a declared point and a well-formed spec are not."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'mode = faults.check("solve.grim")\n'
+        'ok = faults.armed("solve.gram")\n'
+        'n = faults.hits(point_var)\n'
+        'spec = ScenarioSpec(fault_spec="ingest.record=corrupt@every=5")\n'
+        'bad = ScenarioSpec(fault_spec="no.such.point=raise")\n'
+        'ugly = ScenarioSpec(fault_spec="solve.gram-corrupt")\n')
+    p = subprocess.run([sys.executable, CHECKER, "--paths", str(bad)],
+                       capture_output=True, text=True)
+    assert p.returncode == 1
+    assert "solve.grim" in p.stderr
+    assert "non-literal point" in p.stderr
+    assert "no.such.point" in p.stderr
+    assert "solve.gram-corrupt" in p.stderr
+    # the declared point (line 2) and well-formed spec (line 4) are clean
+    assert "4 violation(s)" in p.stderr
+    assert f"{bad.name}:2" not in p.stderr
+    assert f"{bad.name}:4" not in p.stderr
+
+
 # -- bench.py probe events -------------------------------------------------
 
 def test_bench_retry_events_are_schema_valid(monkeypatch):

@@ -5,17 +5,22 @@ reconstructions and known QP solutions (ALSSuite / NNLSSuite); here the
 batched solvers are checked against direct dense solves and scipy's nnls.
 """
 
+import functools
+
 import numpy as np
 import pytest
 
 import jax.numpy as jnp
 
 from tpu_als.ops.solve import (
+    ADAPTIVE_JITTER_RUNGS,
+    SolveUnstable,
     compute_yty,
     normal_eq_explicit,
     normal_eq_implicit,
     solve_nnls,
     solve_spd,
+    solve_spd_checked,
 )
 
 
@@ -120,3 +125,154 @@ def test_compute_yty(rng):
     np.testing.assert_allclose(
         np.asarray(compute_yty(jnp.array(V))), V.T @ V, rtol=1e-4, atol=1e-4
     )
+
+
+# ---------------------------------------------------------------------------
+# adversarial solves (docs/resilience.md guardrails): the Gram batches a
+# poisoned or degenerate shard actually produces — near-singular,
+# rank-deficient, bf16, huge-magnitude — checked through the adaptive
+# escalation ladder and across the solve backends.
+
+
+def near_singular_batch(rng, n, r, k=1, eps=0.0):
+    """Gram matrices of true rank ``k`` (< r) plus ``eps`` on the diagonal:
+    the system a cold entity with a handful of collinear neighbors hands
+    the solver."""
+    G = rng.normal(size=(n, k, r)).astype(np.float32)
+    A = np.einsum("nkr,nks->nrs", G, G) + eps * np.eye(r, dtype=np.float32)
+    b = np.einsum("nkr,nk->nr", G, rng.normal(size=(n, k)).astype(np.float32))
+    return A.astype(np.float32), b.astype(np.float32)
+
+
+def heavy_rung_residual(A, x, b, count):
+    """Relative residual against the heaviest-rung system — the contract
+    the adaptive ladder guarantees (solve_spd docstring)."""
+    r = A.shape[-1]
+    eye = np.eye(r, dtype=np.float32)
+    A0 = np.where((count <= 0)[:, None, None], eye, A)
+    Ac = A0 + ADAPTIVE_JITTER_RUNGS[-1] * eye
+    res = np.einsum("nrs,ns->nr", Ac, x) - b
+    return np.linalg.norm(res, axis=-1) / (np.linalg.norm(b, axis=-1) + 1.0)
+
+
+def _interpret_backends(monkeypatch, backend):
+    """Route the Pallas kernels through interpret mode so the backend
+    dispatch is exercised off-TPU (the test_pallas_lanes.py idiom)."""
+    if backend == "lanes":
+        from tpu_als.ops import pallas_lanes
+
+        monkeypatch.setattr(
+            pallas_lanes, "spd_solve_lanes",
+            functools.partial(pallas_lanes.spd_solve_lanes, interpret=True))
+    elif backend == "pallas":
+        from tpu_als.ops import pallas_solve
+
+        monkeypatch.setattr(
+            pallas_solve, "spd_solve_pallas",
+            functools.partial(pallas_solve.spd_solve_pallas, interpret=True))
+
+
+@pytest.mark.parametrize("backend", ["xla", "lanes", "pallas"])
+def test_adaptive_rescues_rank_deficient(rng, backend, monkeypatch):
+    # true rank 1 << r and ZERO base jitter: the plain Cholesky breaks
+    # down, the ladder's jitter rungs must save every row — on every
+    # backend, because escalation sits above the dispatch
+    _interpret_backends(monkeypatch, backend)
+    n, r = 8, 8
+    A, b = near_singular_batch(rng, n, r, k=1)
+    count = np.ones(n, dtype=np.float32)
+    x = np.asarray(solve_spd(jnp.array(A), jnp.array(b), jnp.array(count),
+                             jitter=0.0, backend=backend, adaptive=True))
+    assert np.all(np.isfinite(x))
+    assert np.all(heavy_rung_residual(A, x, b, count) <= 1e-2)
+
+
+def test_adaptive_rescues_near_singular(rng):
+    # barely-above-singular (eps ~ f32 noise floor of the entries):
+    # Cholesky may "succeed" with garbage — the residual check has to
+    # catch that, not just NaNs
+    n, r = 16, 8
+    A, b = near_singular_batch(rng, n, r, k=2, eps=1e-7)
+    count = np.ones(n, dtype=np.float32)
+    x = np.asarray(solve_spd(jnp.array(A), jnp.array(b), jnp.array(count),
+                             jitter=0.0, adaptive=True))
+    assert np.all(np.isfinite(x))
+    assert np.all(heavy_rung_residual(A, x, b, count) <= 1e-2)
+
+
+def test_adaptive_is_identity_on_healthy_batch(rng):
+    # well-conditioned batch: the lax.cond healthy branch returns the
+    # plain solve's answer unchanged — adaptive mode must not perturb a
+    # fit that never needed it
+    n, r = 16, 8
+    M = rng.normal(size=(n, r, r)).astype(np.float32)
+    A = M @ np.transpose(M, (0, 2, 1)) + 0.5 * np.eye(r, dtype=np.float32)
+    b = rng.normal(size=(n, r)).astype(np.float32)
+    count = np.ones(n, dtype=np.float32)
+    x_plain = np.asarray(solve_spd(jnp.array(A), jnp.array(b),
+                                   jnp.array(count)))
+    x_adapt = np.asarray(solve_spd(jnp.array(A), jnp.array(b),
+                                   jnp.array(count), adaptive=True))
+    np.testing.assert_array_equal(x_plain, x_adapt)
+
+
+def test_solve_spd_bf16_inputs(rng):
+    # bf16 Gram/bias (the gather-fused step's accumulation dtype under
+    # mixed precision): the solve must stay finite and land within bf16's
+    # ~3-decimal-digit precision of the f32 oracle
+    n, r = 8, 8
+    M = rng.normal(size=(n, r, r)).astype(np.float32)
+    A = M @ np.transpose(M, (0, 2, 1)) + 2.0 * np.eye(r, dtype=np.float32)
+    b = rng.normal(size=(n, r)).astype(np.float32)
+    count = np.ones(n, dtype=np.float32)
+    x = np.asarray(
+        solve_spd(jnp.array(A, dtype=jnp.bfloat16),
+                  jnp.array(b, dtype=jnp.bfloat16),
+                  jnp.array(count)).astype(jnp.float32))
+    assert np.all(np.isfinite(x))
+    x_ref = np.stack([np.linalg.solve(A[k], b[k]) for k in range(n)])
+    np.testing.assert_allclose(x, x_ref, rtol=0.15, atol=0.15)
+
+
+def test_solve_spd_huge_magnitude_ratings(rng):
+    # ratings at the RATING_ABS_MAX quarantine boundary (1e6): b scales
+    # by 1e6, A entries by up to ~1e2 rating-independent — solutions must
+    # stay finite and scale linearly, no f32 overflow in the residual path
+    n, r = 8, 6
+    M = rng.normal(size=(n, r, r)).astype(np.float32)
+    A = M @ np.transpose(M, (0, 2, 1)) + 0.5 * np.eye(r, dtype=np.float32)
+    b = rng.normal(size=(n, r)).astype(np.float32) * 1e6
+    count = np.ones(n, dtype=np.float32)
+    x = np.asarray(solve_spd(jnp.array(A), jnp.array(b), jnp.array(count),
+                             adaptive=True))
+    assert np.all(np.isfinite(x))
+    x_ref = np.stack([np.linalg.solve(A[k], b[k]) for k in range(n)])
+    np.testing.assert_allclose(x, x_ref, rtol=1e-3, atol=1e-3 * 1e6)
+
+
+def test_solve_spd_checked_passes_healthy(rng):
+    n, r = 8, 6
+    M = rng.normal(size=(n, r, r)).astype(np.float32)
+    A = M @ np.transpose(M, (0, 2, 1)) + 0.5 * np.eye(r, dtype=np.float32)
+    b = rng.normal(size=(n, r)).astype(np.float32)
+    count = np.ones(n, dtype=np.float32)
+    x = np.asarray(solve_spd_checked(jnp.array(A), jnp.array(b),
+                                     jnp.array(count)))
+    x_ref = np.stack([np.linalg.solve(A[k], b[k]) for k in range(n)])
+    np.testing.assert_allclose(x, x_ref, rtol=1e-3, atol=1e-3)
+
+
+def test_solve_spd_checked_raises_on_unsalvageable(rng):
+    # a NaN-poisoned Gram with count > 0 defeats every rung (jitter can't
+    # fix non-finite entries, CG propagates them): the typed SolveUnstable
+    # must fire with the bad-row count
+    n, r = 6, 5
+    M = rng.normal(size=(n, r, r)).astype(np.float32)
+    A = M @ np.transpose(M, (0, 2, 1)) + 0.5 * np.eye(r, dtype=np.float32)
+    A[2] = np.nan
+    b = rng.normal(size=(n, r)).astype(np.float32)
+    count = np.ones(n, dtype=np.float32)
+    with pytest.raises(SolveUnstable) as ei:
+        solve_spd_checked(jnp.array(A), jnp.array(b), jnp.array(count))
+    assert ei.value.bad_rows == 1
+    assert ei.value.total_rows == n

@@ -213,17 +213,29 @@ class ALS(_ALSParams, Estimator):
     of gathering full factors to process 0 per checkpoint — the O(N·r)
     cross-host gather disappears from the checkpoint path; resume reads
     the sharded directory transparently.  Single-process fits ignore the
-    knob (they hold entity-space factors already).
+    knob (they hold entity-space factors already);
+    ``guardrails`` — numerical-health guardrails mode for this fit
+    (``'off'``/``'warn'``/``'recover'``; ``None``, the default, inherits
+    ``TPU_ALS_GUARDRAILS``): armed fits quarantine non-finite /
+    out-of-range ratings instead of aborting, and ``'recover'`` adds the
+    sentinel / adaptive-solve / rollback ladder — docs/resilience.md.
     """
 
     def __init__(self, *, mesh=None, gatherStrategy="all_gather",
                  checkpointDir=None, resumeFrom=None,
                  fitCallback=None, fitCallbackInterval=1,
                  dataMode="replicated", cgIters=0, cgMode="matfree",
-                 checkpointSharded=False,
+                 checkpointSharded=False, guardrails=None,
                  **kwargs):
         super().__init__()
         self.mesh = mesh
+        if guardrails is not None and guardrails not in ("off", "warn",
+                                                         "recover"):
+            raise ValueError(f"unknown guardrails mode {guardrails!r} "
+                             "(expected 'off', 'warn' or 'recover')")
+        # None = inherit TPU_ALS_GUARDRAILS / programmatic set_mode;
+        # an explicit mode is scoped around this estimator's fit only
+        self.guardrails = guardrails
         if int(cgIters) < 0:
             raise ValueError("cgIters must be >= 0 (0 = exact solve)")
         if cgMode not in ("matfree", "dense"):
@@ -333,7 +345,31 @@ class ALS(_ALSParams, Estimator):
             import jax
 
             multiproc = jax.process_count() > 1
-        if nonfinite and not multiproc:
+        from tpu_als.resilience import guardrails as _guardrails
+
+        gmode = (self.guardrails if self.guardrails is not None
+                 else _guardrails.guardrails_mode())
+        if not multiproc and gmode != "off":
+            # guardrails armed: quarantine poisoned ratings instead of
+            # aborting — the API-path mirror of stream_ingest's
+            # poisoned-record sink (same invalid_rating_mask contract,
+            # core.ratings; also catches huge-magnitude finite values)
+            from tpu_als import obs
+            from tpu_als.core.ratings import invalid_rating_mask
+
+            bad = invalid_rating_mask(r)
+            nbad = int(bad.sum())
+            if nbad:
+                keep = ~bad
+                u_raw = np.asarray(u_raw)[keep]
+                i_raw = np.asarray(i_raw)[keep]
+                r = r[keep]
+                obs.counter("ingest.quarantined_rows", nbad)
+                obs.emit("ingest_quarantined", path="<api>", rows=nbad,
+                         reasons={"malformed": 0, "nonfinite": nonfinite,
+                                  "out_of_range": nbad - nonfinite},
+                         sink=None)
+        elif nonfinite and not multiproc:
             raise ValueError(
                 f"ratingCol {ratingCol!r} contains {nonfinite} "
                 "non-finite value(s) (nan/inf); clean the input "
@@ -417,26 +453,34 @@ class ALS(_ALSParams, Estimator):
             init = (c_U, c_V)
             start_iter = int(manifest.get("iteration") or 0)
 
-        if self.mesh is not None:
-            import jax
+        # scoping to the RESOLVED mode is a no-op when inheriting the
+        # env/global setting and an override when guardrails= was given
+        with _guardrails.scoped(gmode):
+            if self.mesh is not None:
+                import jax
 
-            from tpu_als.api.fitting import fit_multiprocess, fit_sharded
+                from tpu_als.api.fitting import (
+                    fit_multiprocess,
+                    fit_sharded,
+                )
 
-            mode_fit = (fit_multiprocess if jax.process_count() > 1
-                        else fit_sharded)
-            U, V = mode_fit(self, u_idx, i_idx, r, user_map, item_map,
-                            cfg, init, start_iter)
-        else:
-            from tpu_als import obs
+                mode_fit = (fit_multiprocess if jax.process_count() > 1
+                            else fit_sharded)
+                U, V = mode_fit(self, u_idx, i_idx, r, user_map, item_map,
+                                cfg, init, start_iter)
+            else:
+                from tpu_als import obs
 
-            callback = self._checkpoint_callback(user_map, item_map)
-            with obs.span("train.block"):
-                ucsr = build_csr_buckets(u_idx, i_idx, r, len(user_map))
-                icsr = build_csr_buckets(i_idx, u_idx, r, len(item_map))
-            with obs.span("train.fit"):
-                U, V = _train(ucsr, icsr, cfg, callback=callback,
-                              init=init, start_iter=start_iter)
-                U, V = np.asarray(U), np.asarray(V)
+                callback = self._checkpoint_callback(user_map, item_map)
+                with obs.span("train.block"):
+                    ucsr = build_csr_buckets(u_idx, i_idx, r,
+                                             len(user_map))
+                    icsr = build_csr_buckets(i_idx, u_idx, r,
+                                             len(item_map))
+                with obs.span("train.fit"):
+                    U, V = _train(ucsr, icsr, cfg, callback=callback,
+                                  init=init, start_iter=start_iter)
+                    U, V = np.asarray(U), np.asarray(V)
 
         return self._make_model(user_map, item_map, U, V)
 

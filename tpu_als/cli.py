@@ -378,7 +378,8 @@ def cmd_train(args):
               cgIters=args.cg_iters,
               checkpointDir=args.checkpoint_dir,
               checkpointInterval=args.checkpoint_interval,
-              resumeFrom=_resolve_resume(args))
+              resumeFrom=_resolve_resume(args),
+              guardrails=args.guardrails)
     print(f"training on {len(train):,} ratings "
           f"({len(test):,} held out)", file=sys.stderr)
     try:
@@ -494,7 +495,8 @@ def _train_multiprocess(args):
               cgIters=args.cg_iters,
               checkpointDir=args.checkpoint_dir,
               checkpointInterval=args.checkpoint_interval,
-              resumeFrom=_resolve_resume(args))
+              resumeFrom=_resolve_resume(args),
+              guardrails=args.guardrails)
     ctx = contextlib.nullcontext()
     if args.profile_dir:
         from tpu_als.utils.observe import trace
@@ -1204,6 +1206,15 @@ def main(argv=None):
                         "path, or 'auto' to discover the newest VALID "
                         "generation under --checkpoint-dir (corrupt "
                         "generations are quarantined to .corrupt/)")
+    t.add_argument("--guardrails", default=None,
+                   choices=("off", "warn", "recover"),
+                   help="numerical-health guardrails (docs/resilience.md):"
+                        " 'warn' reads divergence sentinels each "
+                        "iteration and emits guardrail_tripped events; "
+                        "'recover' adds adaptive solve-jitter escalation "
+                        "and bounded rollback from the last-good factor "
+                        "snapshot; default inherits TPU_ALS_GUARDRAILS "
+                        "(unset = off)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("evaluate", help="score a dataset with a saved model",

@@ -78,6 +78,17 @@ class AlsConfig:
     # accumulated across streamed shards, which a matvec can't replay
     # without re-streaming the ring per CG step).
     cg_mode: str = "matfree"
+    # THE solve pre-regularization floor: the absolute jitter added to
+    # every per-row Gram matrix before factorization (ops.solve — one
+    # knob for solve_spd / solve_cg / solve_cg_matfree / solve_nnls, and
+    # the base rung of the adaptive escalation ladder).  Static: a
+    # different jitter is a different compiled step.
+    jitter: float = 1e-6
+    # residual-checked jitter escalation + CG fallback inside solve_spd
+    # (ops.solve ADAPTIVE_JITTER_RUNGS).  OFF by default — the plain
+    # step's jaxpr must stay byte-identical; the guardrails 'recover'
+    # mode (resilience.guardrails) flips it on for its own step build.
+    adaptive_solve: bool = False
 
 
 def resolve_solve_path(cfg: AlsConfig, rank, matfree_capable=True):
@@ -276,7 +287,9 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
                             reg, interpret=gather_interpret)
                 with jax.named_scope("solve"):
                     return solve_spd(A.astype(jnp.float32),
-                                     rhs.astype(jnp.float32), count)
+                                     rhs.astype(jnp.float32), count,
+                                     jitter=cfg.jitter,
+                                     adaptive=cfg.adaptive_solve)
             with jax.named_scope("gather_factors"):
                 Vg = V_comp[c]
             # warm start for the inexact (CG) solvers: the solved side's
@@ -295,7 +308,8 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
                     return solve_cg_matfree(
                         Vg, v, m, reg,
                         implicit=cfg.implicit_prefs, alpha=alpha,
-                        YtY=YtY, x0=x0, iters=cfg.cg_iters)
+                        YtY=YtY, x0=x0, iters=cfg.cg_iters,
+                        jitter=cfg.jitter)
             if fused:
                 from tpu_als.ops.pallas_fused import fused_normal_solve
 
@@ -323,11 +337,13 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
             rhs = rhs.astype(jnp.float32)
             with jax.named_scope("solve"):
                 if cfg.nonnegative:
-                    return solve_nnls(A, rhs, count, sweeps=cfg.nnls_sweeps)
+                    return solve_nnls(A, rhs, count, sweeps=cfg.nnls_sweeps,
+                                      jitter=cfg.jitter)
                 if cg:
                     return solve_cg(A, rhs, count, x0=x0,
-                                    iters=cfg.cg_iters)
-                return solve_spd(A, rhs, count)
+                                    iters=cfg.cg_iters, jitter=cfg.jitter)
+                return solve_spd(A, rhs, count, jitter=cfg.jitter,
+                                 adaptive=cfg.adaptive_solve)
 
         if nchunks == 1:
             x = solve_chunk((cols[0], vals[0], mask[0], rows[0]))
@@ -453,10 +469,66 @@ def train(user_csr, item_csr, cfg: AlsConfig, callback=None, init=None,
                                     user_csr.chunk_elems,
                                     item_csr.chunk_elems)
 
-    for it in range(start_iter, cfg.max_iter):
+    # numerical-health guardrails (resilience/guardrails.py): armed via
+    # --guardrails warn|recover / TPU_ALS_GUARDRAILS.  Same discipline as
+    # stage attribution above — disarmed, this one mode check is the
+    # entire cost and the jitted step is byte-identical (pinned in
+    # tests/test_guardrails.py).  Armed, sentinels are a SEPARATE small
+    # jitted reduction read at the callback boundary; the production
+    # step is never modified.  'recover' additionally builds its step
+    # with the adaptive solve ladder so ill-conditioned Gram rows heal
+    # in-device before a sentinel ever has to trip.
+    from tpu_als.resilience import faults
+    from tpu_als.resilience.guardrails import Monitor, guardrails_mode
+
+    gmode = guardrails_mode()
+    monitor = None
+    if gmode != "off":
+        monitor = Monitor(cfg, gmode)
+        if gmode == "recover" and not stage_attribution_armed():
+            step = make_step(ub, ib, num_users, num_items,
+                             _dc_replace(cfg, adaptive_solve=True),
+                             user_csr.chunk_elems, item_csr.chunk_elems)
+    gram_fault = faults.armed("solve.gram")
+
+    it = start_iter
+    retry = False
+    while it < cfg.max_iter:
+        if monitor is not None:
+            monitor.keep_last_good(U, V, retry=retry)
         U, V = step(U, V)
+        if gram_fault and faults.check("solve.gram") == "corrupt":
+            # chaos hook: poison one factor row post-step, host-level —
+            # exactly what a blown Gram solve leaves behind
+            U = U.at[0].set(jnp.nan)
+        if monitor is not None:
+            trip = monitor.judge(it + 1, U, V)
+            if trip is not None and monitor.mode == "recover":
+                U, V, reg_scale = monitor.rollback(it + 1, trip)
+                # rebuild with bumped reg: reg_param is a TRACED scalar
+                # stripped from the jit cache key (make_step docstring),
+                # so this is a cache hit, not a recompile
+                step = make_step(
+                    ub, ib, num_users, num_items,
+                    _dc_replace(cfg, adaptive_solve=True,
+                                reg_param=cfg.reg_param * reg_scale),
+                    user_csr.chunk_elems, item_csr.chunk_elems)
+                retry = True
+                continue
+        if (monitor is not None and retry and monitor.mode == "recover"
+                and monitor.reg_scale != 1.0):
+            # the reg bump is TRANSIENT: the retried iteration cleared,
+            # so drop back to the configured regularization — a
+            # permanent bump would quietly change the model the user
+            # asked for (also a jit cache hit, same as above)
+            monitor.reg_scale = 1.0
+            step = make_step(ub, ib, num_users, num_items,
+                             _dc_replace(cfg, adaptive_solve=True),
+                             user_csr.chunk_elems, item_csr.chunk_elems)
+        retry = False
+        it += 1
         if callback is not None:
-            callback(it + 1, U, V)
+            callback(it, U, V)
     return U, V
 
 

@@ -38,6 +38,7 @@ def fold_in(
     nonnegative=False,
     nnls_sweeps=32,
     YtY=None,
+    jitter=1e-6,
 ):
     """Solve factors for a batch of touched entities against fixed ``V``.
 
@@ -55,11 +56,12 @@ def fold_in(
     return _fold_in_jit(V, cols, vals, mask, reg_param,
                         implicit_prefs=implicit_prefs, alpha=alpha,
                         nonnegative=nonnegative, nnls_sweeps=nnls_sweeps,
-                        YtY=YtY)
+                        YtY=YtY, jitter=jitter)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("implicit_prefs", "nonnegative", "nnls_sweeps")
+    jax.jit,
+    static_argnames=("implicit_prefs", "nonnegative", "nnls_sweeps", "jitter"),
 )
 def _fold_in_jit(
     V,
@@ -72,6 +74,7 @@ def _fold_in_jit(
     nonnegative=False,
     nnls_sweeps=32,
     YtY=None,
+    jitter=1e-6,
 ):
     Vg = V[cols]
     if implicit_prefs:
@@ -81,5 +84,5 @@ def _fold_in_jit(
     else:
         A, b, count = normal_eq_explicit(Vg, vals, mask, reg_param)
     if nonnegative:
-        return solve_nnls(A, b, count, sweeps=nnls_sweeps)
-    return solve_spd(A, b, count)
+        return solve_nnls(A, b, count, sweeps=nnls_sweeps, jitter=jitter)
+    return solve_spd(A, b, count, jitter=jitter)

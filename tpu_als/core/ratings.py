@@ -28,6 +28,24 @@ from typing import NamedTuple
 
 import numpy as np
 
+# the shared rating-sanity bound for poisoned-input quarantine
+# (resilience/guardrails): any |rating| above this is treated as data
+# corruption, not signal.  Real rating scales are O(1)-O(100); implicit
+# confidence counts can be large but a value past 1e6 overwhelms the f32
+# normal-equation accumulators (r^2 terms reach 1e12) and is always a
+# poisoned record in practice.
+RATING_ABS_MAX = 1e6
+
+
+def invalid_rating_mask(r, max_abs=RATING_ABS_MAX):
+    """Boolean mask of ratings that must be quarantined: non-finite or
+    magnitude above ``max_abs``.  numpy-only — shared by the streaming
+    ingest quarantine (io.stream) and the estimator's input scrub
+    (api.estimator), so both sides of the guardrail agree on what
+    'poisoned' means."""
+    r = np.asarray(r)
+    return ~np.isfinite(r) | (np.abs(r) > max_abs)
+
 
 class Bucket(NamedTuple):
     """One fixed-width padded CSR bucket.  A pytree of arrays.

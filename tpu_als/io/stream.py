@@ -44,6 +44,7 @@ import time
 import numpy as np
 
 from tpu_als import obs
+from tpu_als.core.ratings import invalid_rating_mask
 from tpu_als.io._native_build import build_native
 from tpu_als.resilience import faults
 from tpu_als.resilience.retry import RetryPolicy, retry_call
@@ -139,9 +140,88 @@ def _read_chunk(f, pos, want, policy):
     return retry_call(_read, policy=policy, what="ingest.read_chunk")
 
 
+class _Quarantine:
+    """Poisoned-record sink for one :func:`stream_ingest` call
+    (resilience guardrails).  Mirrors checkpoint's ``.corrupt/``
+    convention: the bad records are moved ASIDE — appended verbatim to a
+    sink file for forensics — not silently dropped, with one
+    ``ingest.quarantined_rows`` counter bump and ONE
+    ``ingest_quarantined`` event at end of call (the per-chunk obs cost
+    discipline)."""
+
+    REASONS = ("malformed", "nonfinite", "out_of_range")
+
+    def __init__(self, sink):
+        self.sink = str(sink)
+        self.counts = dict.fromkeys(self.REASONS, 0)
+        self._fh = None
+
+    @property
+    def total(self):
+        return sum(self.counts.values())
+
+    def _handle(self):
+        if self._fh is None:
+            d = os.path.dirname(self.sink)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fh = open(self.sink, "ab")
+        return self._fh
+
+    def line(self, raw, reason):
+        """Quarantine one raw text line the parser rejected."""
+        self.counts[reason] += 1
+        self._handle().write(raw.rstrip(b"\n") + b"\n")
+
+    def rows(self, u, i, r, reason):
+        """Quarantine post-parse rows (non-finite / out-of-range rating
+        values the parser accepted as text).  The original line is gone
+        by now, so the sink gets a synthesized record."""
+        self.counts[reason] += int(len(r))
+        fh = self._handle()
+        for uu, ii, rr in zip(u.tolist(), i.tolist(), r.tolist()):
+            fh.write((f"# post-parse {reason}: local_u={uu} "
+                      f"local_i={ii} rating={rr}\n").encode())
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def _quarantine_sink(path, host_index, quarantine):
+    """Resolve the sink file: ``True`` derives
+    ``<path>.quarantine/host<k>.bad`` next to the input (the
+    ``.corrupt/`` sibling convention); a path-like is used as-is."""
+    if quarantine is True:
+        return os.path.join(str(path) + ".quarantine",
+                            f"host{int(host_index)}.bad")
+    return os.fspath(quarantine)
+
+
+def _poison_records(buf, delim):
+    """``ingest.record`` fault point (armed only — disarmed ingest never
+    walks records): ``corrupt`` rewrites the scheduled record's rating
+    column to ``nan`` BEFORE parsing, so the injected poison is a
+    genuinely malformed text record exercising the same quarantine path
+    real stream corruption would."""
+    d = delim.encode()[:1]
+    out = []
+    changed = False
+    for line in buf.split(b"\n"):
+        if line.strip() and faults.check("ingest.record") == "corrupt":
+            cols = line.split(d)
+            if len(cols) >= 3:
+                cols[2] = b"nan"
+                line = d.join(cols)
+                changed = True
+        out.append(line)
+    return b"\n".join(out) if changed else buf
+
+
 def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
                   require_cols=3, skip_header=0, chunk_bytes=32 << 20,
-                  retry_policy=None):
+                  retry_policy=None, quarantine=None):
     """Stream this host's byte range into (users, items, ratings, vocab).
 
     Returns ``(u_local, i_local, ratings, user_labels, item_labels)``
@@ -154,10 +234,23 @@ def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
     unparsed (Amazon-2023 csv: ``user_id,parent_asin,rating,timestamp``
     -> ``require_cols=4``).  A malformed line raises ``ValueError`` (the
     fastcsv strictness contract: no silent zero/merged rows).
+
+    ``quarantine`` (guardrails, docs/resilience.md): ``None`` keeps the
+    strict contract above; ``True`` (sink at
+    ``<path>.quarantine/host<k>.bad``) or an explicit sink path routes
+    malformed lines and non-finite / out-of-range ratings to the sink
+    instead of raising.  Bad lines re-run through the SAME native parser
+    one line at a time (the parser is its own strictness oracle — no
+    Python reimplementation to drift), so a poisoned record never
+    changes which good records parse.  Quarantined lines still consume
+    their owner's byte range, so the exactly-once split claims are
+    untouched.  Cost: zero until a chunk actually fails the batch parse.
     """
     lib = _load()
     policy = retry_policy if retry_policy is not None \
         else RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=1.0)
+    q = None if quarantine is None else _Quarantine(
+        _quarantine_sink(path, host_index, quarantine))
     size = os.path.getsize(path)
     start, end = host_byte_range(size, host_index, num_hosts)
     handle = lib.sc_create()
@@ -201,7 +294,7 @@ def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
                     continue
                 carry, buf = buf[cut + 1:], buf[:cut + 1]
                 _ingest_chunk(lib, handle, buf, delim, require_cols,
-                              out_u, out_i, out_r, path)
+                              out_u, out_i, out_r, path, q)
             # finish the line straddling `end` (ours: it starts in-range)
             # — or, when the range ends exactly at a line start, take the
             # next host's first line (it skips through its first newline,
@@ -213,11 +306,13 @@ def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
             last = carry + tail
             if last.strip():
                 _ingest_chunk(lib, handle, last, delim, require_cols,
-                              out_u, out_i, out_r, path)
+                              out_u, out_i, out_r, path, q)
         user_labels = _export_labels(lib, handle, 0)
         item_labels = _export_labels(lib, handle, 1)
     finally:
         lib.sc_destroy(handle)
+        if q is not None:
+            q.close()
     cat = (lambda xs, dt: np.concatenate(xs) if xs
            else np.empty(0, dtype=dt))
     u_out = cat(out_u, np.int64)
@@ -231,12 +326,19 @@ def stream_ingest(path, host_index=0, num_hosts=1, *, delim=",",
     obs.emit("ingest", path=str(path), host_index=int(host_index),
              num_hosts=int(num_hosts), rows=rows, bytes=nbytes,
              seconds=round(seconds, 6), stall_seconds=round(stall, 6))
+    if q is not None and q.total:
+        obs.counter("ingest.quarantined_rows", q.total)
+        obs.emit("ingest_quarantined", path=str(path), rows=int(q.total),
+                 reasons=dict(q.counts), sink=q.sink,
+                 host_index=int(host_index))
     return (u_out, cat(out_i, np.int64),
             cat(out_r, np.float32), user_labels, item_labels)
 
 
 def _ingest_chunk(lib, handle, buf, delim, require_cols,
-                  out_u, out_i, out_r, path):
+                  out_u, out_i, out_r, path, q=None):
+    if faults.armed("ingest.record"):
+        buf = _poison_records(buf, delim)
     n = lib.sc_count_lines(buf, len(buf))
     if n == 0:
         return
@@ -249,15 +351,63 @@ def _ingest_chunk(lib, handle, buf, delim, require_cols,
         i.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         r.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
     if wrote == -2:
-        raise ValueError(
-            f"malformed ratings line in {path}: every data line must be "
-            f"str{delim}str{delim}float with exactly {require_cols} "
-            "columns (no quotes; ids non-empty; rating finite)")
-    if wrote != n:
+        if q is None:
+            raise ValueError(
+                f"malformed ratings line in {path}: every data line must "
+                f"be str{delim}str{delim}float with exactly "
+                f"{require_cols} columns (no quotes; ids non-empty; "
+                "rating finite)")
+        u, i, r = _salvage_chunk(lib, handle, buf, delim, require_cols,
+                                 q, path)
+    elif wrote != n:
         raise IOError(f"streamcsv parsed {wrote} rows, expected {n}")
+    if q is not None and len(r):
+        # post-parse scrub: values the parser accepts as valid text but
+        # the trainer must never see (huge-magnitude ratings; non-finite
+        # if the parser's float accepts them)
+        bad = invalid_rating_mask(r)
+        if bad.any():
+            nonfinite = ~np.isfinite(r)
+            if (bad & nonfinite).any():
+                q.rows(u[bad & nonfinite], i[bad & nonfinite],
+                       r[bad & nonfinite], "nonfinite")
+            oor = bad & ~nonfinite
+            if oor.any():
+                q.rows(u[oor], i[oor], r[oor], "out_of_range")
+            keep = ~bad
+            u, i, r = u[keep], i[keep], r[keep]
     out_u.append(u)
     out_i.append(i)
     out_r.append(r)
+
+
+def _salvage_chunk(lib, handle, buf, delim, require_cols, q, path):
+    """Per-line salvage of a chunk the batch parse rejected: each line
+    re-runs through the SAME native parser (its own strictness oracle),
+    rejected lines route to the quarantine sink.  Only ever runs on
+    chunks that actually contain a bad line, so the healthy-stream cost
+    is zero."""
+    us, is_, rs = [], [], []
+    u1 = np.empty(1, dtype=np.int64)
+    i1 = np.empty(1, dtype=np.int64)
+    r1 = np.empty(1, dtype=np.float32)
+    for line in buf.split(b"\n"):
+        if not line.strip():
+            continue
+        lbuf = line + b"\n"
+        wrote = lib.sc_ingest(
+            handle, lbuf, len(lbuf), delim.encode()[0], require_cols,
+            u1.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            i1.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            r1.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if wrote == 1:
+            us.append(int(u1[0]))
+            is_.append(int(i1[0]))
+            rs.append(float(r1[0]))
+        else:
+            q.line(line, "malformed")
+    return (np.array(us, dtype=np.int64), np.array(is_, dtype=np.int64),
+            np.array(rs, dtype=np.float32))
 
 
 def merge_vocabularies(per_host_labels):

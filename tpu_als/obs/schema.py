@@ -96,6 +96,16 @@ METRICS = {
         "histogram", "seconds",
         "cold-start scenario: rating-arrival -> servable latency (fold-"
         "in + republish + first successful recommend for a NEW user)"),
+    "train.rollbacks": (
+        "counter", "rollbacks",
+        "guardrail rollbacks: iterations retried from the last-good "
+        "factor snapshot after a sentinel trip (resilience.guardrails, "
+        "recover mode)"),
+    "ingest.quarantined_rows": (
+        "counter", "rows",
+        "rating records routed to the quarantine sink by stream_ingest "
+        "or the estimator's input scrub (malformed, non-finite, or "
+        "out-of-range) instead of aborting the ingest"),
     "train.stage_seconds": (
         "histogram", "seconds",
         "fence-timed seconds of one attributed ALS stage (obs.trace."
@@ -163,6 +173,22 @@ EVENTS = {
         ("path", "reason"),
         "load_factors moved a corrupt checkpoint generation aside to "
         ".corrupt/ (and fell back to .old when present)"),
+    "guardrail_tripped": (
+        ("iteration", "sentinel", "mode"),
+        "a numerical-health sentinel fired at a training iteration "
+        "boundary (resilience.guardrails; sentinel is one of "
+        "nonfinite|norm_band|trend)"),
+    "train_rollback": (
+        ("iteration", "attempt", "sentinel", "reg_param"),
+        "recover-mode guardrails restored the last-good factor "
+        "snapshot (seeded perturbation + regularization bump) and are "
+        "retrying the iteration"),
+    "ingest_quarantined": (
+        ("path", "rows", "reasons"),
+        "one per ingest call that quarantined records: total rows "
+        "routed to the sink and the per-reason breakdown "
+        "(malformed/nonfinite/out_of_range); mirrors checkpoint's "
+        ".corrupt/ convention"),
     "warning": (
         ("what", "reason"),
         "a degraded-but-continuing condition (e.g. profiler trace "

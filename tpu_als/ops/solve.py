@@ -39,6 +39,33 @@ import functools
 import jax
 import jax.numpy as jnp
 
+# The adaptive-solve escalation ladder (resilience guardrails, docs/
+# resilience.md): rungs are ABSOLUTE jitter levels tried above the
+# configured base jitter, in order, before the CG fallback.  Residuals
+# are judged against _ADAPTIVE_TOL relative to ||b|| — loose enough that
+# a healthy f32 Cholesky always clears it on the first rung (the armed
+# overhead is then one residual matvec), tight enough that a
+# numerically-singular factorization (NaN/Inf backsubstitution, or a
+# wildly wrong x from a near-zero pivot) fails it.
+ADAPTIVE_JITTER_RUNGS = (1e-4, 1e-2)
+_ADAPTIVE_TOL = 1e-2
+
+
+class SolveUnstable(ArithmeticError):
+    """Every rung of the adaptive solve ladder failed — the per-row
+    system is beyond what jitter escalation and the CG fallback can
+    stabilize (typed so callers distinguish 'the data is numerically
+    hostile' from a programming error)."""
+
+    def __init__(self, bad_rows, total_rows):
+        super().__init__(
+            f"adaptive SPD solve failed on {bad_rows} of {total_rows} "
+            f"rows after jitter escalation {ADAPTIVE_JITTER_RUNGS} and "
+            "the CG fallback — the Gram systems are numerically "
+            "unsalvageable (see docs/resilience.md guardrails)")
+        self.bad_rows = bad_rows
+        self.total_rows = total_rows
+
 
 def normal_eq_explicit(Vg, vals, mask, reg):
     """Normal equations for explicit-feedback ALS (ALS-WR weighting).
@@ -160,7 +187,44 @@ def prewarm_solve(rank):
     auto_solve_backend(rank)
 
 
-def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
+def _dispatch_spd(A, b, backend):
+    """One batched Cholesky solve of the (already pre-regularized) A —
+    the backend dispatch shared by the plain and adaptive solve_spd
+    paths, so every escalation rung runs on the SAME kernel the plain
+    solve would."""
+    if backend == "lanes":
+        from tpu_als.ops import pallas_lanes
+
+        # forced-lanes path: validate the panel width on this Mosaic first
+        # (cached per process; free after an eager prewarm).  Without this,
+        # selected_panel(r) returns DEFAULT_PANEL when available() never
+        # ran, and the panel=8 fused trailing update's extra [panel, r,
+        # LANES] scratch could hit a VMEM/Mosaic failure the auto path's
+        # probe-and-fallback would have avoided (ADVICE r2).  When the
+        # probe could NOT validate a width (off-TPU, probe failure, or
+        # probe-inside-trace degrade), run the rank-1 recurrence (panel=1)
+        # — never an unvalidated fused update.
+        r = A.shape[-1]
+        panel = (pallas_lanes.selected_panel(r)
+                 if pallas_lanes.available(r) else 1)
+        return pallas_lanes.spd_solve_lanes(A, b, panel=panel)
+    if backend == "lanes_blocked":
+        from tpu_als.ops.pallas_lanes_blocked import spd_solve_lanes_blocked
+
+        return spd_solve_lanes_blocked(A, b)
+    if backend == "pallas":
+        from tpu_als.ops.pallas_solve import spd_solve_pallas
+
+        return spd_solve_pallas(A, b)
+    L = jnp.linalg.cholesky(A)
+    y = jax.scipy.linalg.solve_triangular(L, b[..., None], lower=True)
+    x = jax.scipy.linalg.solve_triangular(
+        L, y, lower=True, trans=1
+    )[..., 0]
+    return x
+
+
+def solve_spd(A, b, count, jitter=1e-6, backend="auto", adaptive=False):
     """Batched SPD solve via Cholesky: x = A⁻¹ b for each row.
 
     Rows with ``count == 0`` (entities with no ratings in this shard — padding
@@ -181,45 +245,101 @@ def solve_spd(A, b, count, jitter=1e-6, backend="auto"):
     Each kernel engages only when its compile-and-validate probe passes
     on the local Mosaic version.  'lanes' / 'lanes_blocked' / 'pallas' /
     'xla' force a specific path.
+
+    ``adaptive=True`` (the guardrails recover path, docs/resilience.md):
+    the empty-row identity guard and ``jitter`` pre-regularization apply
+    as always, then the solution is RESIDUAL-CHECKED — rows whose
+    relative residual fails escalate through ADAPTIVE_JITTER_RUNGS
+    re-solves and finally a Jacobi-CG fallback, all under one
+    ``lax.cond`` so the healthy common case pays only the residual
+    matvec.  Escalation happens at THIS layer, above the backend
+    dispatch, so the xla / pallas_lanes / gather_fused paths all inherit
+    it.  A row the full ladder cannot save keeps its (non-finite or
+    residual-failing) CG answer — the host-side verdict and the typed
+    :class:`SolveUnstable` live in :func:`solve_spd_checked` and the
+    training sentinels (raising is impossible inside a trace).
     """
+    if A.dtype == jnp.bfloat16:
+        # no bf16 Cholesky lowering (and an 8-bit mantissa is hopeless for
+        # a factorization anyway): solve in f32, hand back bf16.  The
+        # Python-level dtype gate leaves the f32 training trace untouched.
+        return solve_spd(A.astype(jnp.float32), b.astype(jnp.float32),
+                         count, jitter=jitter, backend=backend,
+                         adaptive=adaptive).astype(jnp.bfloat16)
     r = A.shape[-1]
     eye = jnp.eye(r, dtype=A.dtype)
     empty = (count <= 0)[:, None, None]
-    A = jnp.where(empty, eye, A) + jitter * eye
+    A0 = jnp.where(empty, eye, A)
+    A = A0 + jitter * eye
     if backend == "auto":
         backend = auto_solve_backend(r)
     if backend not in ("lanes", "lanes_blocked", "pallas", "xla"):
         raise ValueError(f"unknown solve backend {backend!r} (expected "
                          "'auto', 'lanes', 'lanes_blocked', 'pallas' or "
                          "'xla')")
-    if backend == "lanes":
-        from tpu_als.ops import pallas_lanes
+    if not adaptive:
+        return _dispatch_spd(A, b, backend)
 
-        # forced-lanes path: validate the panel width on this Mosaic first
-        # (cached per process; free after an eager prewarm).  Without this,
-        # selected_panel(r) returns DEFAULT_PANEL when available() never
-        # ran, and the panel=8 fused trailing update's extra [panel, r,
-        # LANES] scratch could hit a VMEM/Mosaic failure the auto path's
-        # probe-and-fallback would have avoided (ADVICE r2).  When the
-        # probe could NOT validate a width (off-TPU, probe failure, or
-        # probe-inside-trace degrade), run the rank-1 recurrence (panel=1)
-        # — never an unvalidated fused update.
-        panel = (pallas_lanes.selected_panel(r)
-                 if pallas_lanes.available(r) else 1)
-        return pallas_lanes.spd_solve_lanes(A, b, panel=panel)
-    if backend == "lanes_blocked":
-        from tpu_als.ops.pallas_lanes_blocked import spd_solve_lanes_blocked
+    def _row_ok(x, Areg):
+        res = jnp.einsum("nrs,ns->nr", Areg, x,
+                         preferred_element_type=jnp.float32) - b
+        rnorm = jnp.linalg.norm(res, axis=-1)
+        bnorm = jnp.linalg.norm(b, axis=-1)
+        finite = jnp.all(jnp.isfinite(x), axis=-1)
+        return finite & (rnorm <= _ADAPTIVE_TOL * (bnorm + 1.0))
 
-        return spd_solve_lanes_blocked(A, b)
-    if backend == "pallas":
-        from tpu_als.ops.pallas_solve import spd_solve_pallas
+    x0 = _dispatch_spd(A, b, backend)
+    ok0 = _row_ok(x0, A)
 
-        return spd_solve_pallas(A, b)
-    L = jnp.linalg.cholesky(A)
-    y = jax.scipy.linalg.solve_triangular(L, b[..., None], lower=True)
-    x = jax.scipy.linalg.solve_triangular(
-        L, y, lower=True, trans=1
-    )[..., 0]
+    def _escalate(x_first):
+        xs, oks = x_first, ok0
+        for rung in ADAPTIVE_JITTER_RUNGS:
+            Ar = A0 + rung * eye
+            xr = _dispatch_spd(Ar, b, backend)
+            xs = jnp.where(oks[:, None], xs, xr)
+            oks = oks | _row_ok(xr, Ar)
+        # final rung: fixed-iteration Jacobi-CG on the heaviest-jittered
+        # system — factorization-free, so a Cholesky that breaks down on
+        # every rung still gets a descent answer
+        Ac = A0 + ADAPTIVE_JITTER_RUNGS[-1] * eye
+        diag = jnp.diagonal(Ac, axis1=-2, axis2=-1)
+
+        def matvec(p):
+            return jnp.einsum("nrs,ns->nr", Ac, p,
+                              preferred_element_type=jnp.float32)
+
+        warm = jnp.where(jnp.isfinite(xs), xs, 0.0)
+        xc = pcg(matvec, b, diag, x0=warm, iters=min(2 * r, 32))
+        return jnp.where(oks[:, None], xs, xc)
+
+    return jax.lax.cond(jnp.all(ok0), lambda x: x, _escalate, x0)
+
+
+def solve_spd_checked(A, b, count, jitter=1e-6, backend="auto"):
+    """Eager adaptive solve with a host-side verdict: runs the full
+    escalation ladder and raises the typed :class:`SolveUnstable` when
+    rows remain non-finite or residual-failing after every rung — the
+    'all rungs fail' contract a jitted caller cannot enforce itself."""
+    x = solve_spd(A, b, count, jitter=jitter, backend=backend,
+                  adaptive=True)
+    r = A.shape[-1]
+    eye = jnp.eye(r, dtype=A.dtype)
+    empty = (count <= 0)[:, None, None]
+    A0 = jnp.where(empty, eye, A)
+    # a row is salvaged if its answer satisfies ANY rung's system: a row
+    # solved cleanly at base jitter must not be judged against the
+    # heaviest-rung regularization it never needed
+    ok = jnp.zeros(x.shape[0], dtype=bool)
+    bnorm = jnp.linalg.norm(b, axis=-1)
+    for rung in (jitter,) + ADAPTIVE_JITTER_RUNGS:
+        res = jnp.einsum("nrs,ns->nr", A0 + rung * eye, x,
+                         preferred_element_type=jnp.float32) - b
+        ok = ok | (jnp.linalg.norm(res, axis=-1)
+                   <= _ADAPTIVE_TOL * (bnorm + 1.0))
+    bad = ~(jnp.all(jnp.isfinite(x), axis=-1) & ok)
+    nbad = int(jnp.sum(bad))
+    if nbad:
+        raise SolveUnstable(nbad, int(x.shape[0]))
     return x
 
 
@@ -256,7 +376,7 @@ def pcg(matvec, b, diag, x0=None, iters=3):
     return x
 
 
-def solve_cg(A, b, count, x0=None, iters=3):
+def solve_cg(A, b, count, x0=None, iters=3, jitter=1e-6):
     """Batched Jacobi-preconditioned conjugate gradient, fixed iterations.
 
     The Takács–Pilászy approach for ALS (Applications of the conjugate
@@ -281,7 +401,7 @@ def solve_cg(A, b, count, x0=None, iters=3):
     r = A.shape[-1]
     eye = jnp.eye(r, dtype=A.dtype)
     empty = (count <= 0)[:, None, None]
-    A = jnp.where(empty, eye, A) + 1e-6 * eye
+    A = jnp.where(empty, eye, A) + jitter * eye
     diag = jnp.diagonal(A, axis1=-2, axis2=-1)          # Jacobi precond
 
     def matvec(p):
@@ -353,8 +473,8 @@ def solve_cg_matfree(Vg, vals, mask, reg, implicit=False, alpha=1.0,
     return pcg(matvec, rhs, diag, x0=x0, iters=iters)
 
 
-@functools.partial(jax.jit, static_argnames=("sweeps",))
-def solve_nnls(A, b, count, sweeps=32):
+@functools.partial(jax.jit, static_argnames=("sweeps", "jitter"))
+def solve_nnls(A, b, count, sweeps=32, jitter=1e-6):
     """Batched nonnegative least squares via cyclic coordinate descent.
 
     Replaces the reference stack's projected-CG ``NNLSSolver``
@@ -366,7 +486,7 @@ def solve_nnls(A, b, count, sweeps=32):
     r = A.shape[-1]
     eye = jnp.eye(r, dtype=A.dtype)
     empty = (count <= 0)[:, None, None]
-    A = jnp.where(empty, eye, A) + 1e-6 * eye
+    A = jnp.where(empty, eye, A) + jitter * eye
     diag = jnp.diagonal(A, axis1=-2, axis2=-1)  # [n, r]
 
     x0 = jnp.zeros_like(b)

@@ -279,7 +279,8 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
             A = A + YtY[None]
         with jax.named_scope("ring_solve"):
             if cfg.nonnegative:
-                x = solve_nnls(A, bb, cnt, sweeps=cfg.nnls_sweeps)
+                x = solve_nnls(A, bb, cnt, sweeps=cfg.nnls_sweeps,
+                               jitter=cfg.jitter)
             elif cfg.cg_iters > 0 and cfg.solve_backend != "fused":
                 # same precedence as local_half_step (AlsConfig doc:
                 # nonnegative > 'fused' > cg) so one config means one
@@ -287,9 +288,11 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
                 # kernel, so 'fused' degrades to the exact solve here
                 x0 = (prev[jnp.clip(rows, 0, num_rows - 1)]
                       if prev is not None else None)
-                x = solve_cg(A, bb, cnt, x0=x0, iters=cfg.cg_iters)
+                x = solve_cg(A, bb, cnt, x0=x0, iters=cfg.cg_iters,
+                             jitter=cfg.jitter)
             else:
-                x = solve_spd(A, bb, cnt)
+                x = solve_spd(A, bb, cnt, jitter=cfg.jitter,
+                              adaptive=cfg.adaptive_solve)
         return V_c, x
 
     for b in ring_buckets:
@@ -431,13 +434,16 @@ def chunked_gather_half_step(V_shard, buckets, num_rows, n_shards, cfg,
             A = A + YtY[None]
         with jax.named_scope("gchunk_solve"):
             if cfg.nonnegative:
-                x = solve_nnls(A, bb, cnt, sweeps=cfg.nnls_sweeps)
+                x = solve_nnls(A, bb, cnt, sweeps=cfg.nnls_sweeps,
+                               jitter=cfg.jitter)
             elif cfg.cg_iters > 0 and cfg.solve_backend != "fused":
                 x0 = (prev[jnp.clip(rows, 0, num_rows - 1)]
                       if prev is not None else None)
-                x = solve_cg(A, bb, cnt, x0=x0, iters=cfg.cg_iters)
+                x = solve_cg(A, bb, cnt, x0=x0, iters=cfg.cg_iters,
+                             jitter=cfg.jitter)
             else:
-                x = solve_spd(A, bb, cnt)
+                x = solve_spd(A, bb, cnt, jitter=cfg.jitter,
+                              adaptive=cfg.adaptive_solve)
         return x
 
     for b in buckets:

@@ -115,9 +115,15 @@ def make_attributed_step(user_buckets, item_buckets, num_users, num_items,
 
     if cfg.nonnegative:
         solve_fn = jax.jit(
-            functools.partial(solve_nnls, sweeps=cfg.nnls_sweeps))
-    else:
+            functools.partial(solve_nnls, sweeps=cfg.nnls_sweeps,
+                              jitter=cfg.jitter))
+    elif cfg.jitter == 1e-6:
         solve_fn = _solve_spd
+    else:
+        # non-default jitter (AlsConfig.jitter is the one knob): the twin
+        # must solve the same regularized system as the production step
+        solve_fn = jax.jit(
+            functools.partial(solve_spd, jitter=cfg.jitter))
 
     item_plan = _bucket_plan(item_buckets, r, cfg, item_chunk_elems, gather)
     user_plan = _bucket_plan(user_buckets, r, cfg, user_chunk_elems, gather)

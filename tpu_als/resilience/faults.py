@@ -29,6 +29,16 @@ Named fault points (the complete vocabulary — sites call
 ``serving.score``         per serving micro-batch, before scoring (corrupt =
                           treat the index as stale for this batch; raise =
                           the batch's tickets fail with the injected error)
+``solve.gram``            per training iteration of ``core.als.train``
+                          (host-level, after the jitted step — the
+                          comm.ring_step pattern; corrupt = NaN-poison a
+                          factor row, exactly what a blown Gram solve
+                          leaves behind)
+``ingest.record``         per parsed record in ``io.stream.stream_ingest``
+                          — armed only (disarmed ingest never walks
+                          records; corrupt = the record's rating column is
+                          rewritten to ``nan`` pre-parse, a genuinely
+                          poisoned text record for the quarantine path)
 ========================  ====================================================
 
 Spec grammar (``TPU_ALS_FAULT_SPEC`` env var, or :func:`install`)::
@@ -73,6 +83,8 @@ FAULT_POINTS = (
     "serve.gather",
     "serving.publish",
     "serving.score",
+    "solve.gram",
+    "ingest.record",
 )
 
 MODES = ("raise", "corrupt", "hang")

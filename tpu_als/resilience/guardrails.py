@@ -1,0 +1,270 @@
+"""Numerical-health guardrails: divergence sentinels + bounded rollback.
+
+The rest of :mod:`tpu_als.resilience` recovers from *process*-level
+failures (kills, torn publishes, corrupt checkpoints); this module
+guards against *numerical* failure — a NaN seeded into the factors, an
+ill-conditioned per-row Gram system, a poisoned rating stream — which
+would otherwise destroy a fit silently: ALS has no loss curve anyone
+watches per step, and a non-finite factor row propagates through the
+next normal-equation sum to every entity it touches.
+
+Three layers, armed together by one mode knob
+(``tpu_als train --guardrails off|warn|recover``, env
+``TPU_ALS_GUARDRAILS``, or :func:`set_mode`):
+
+- **Sentinels** — cheap on-device reductions over the factors
+  (finiteness, factor-norm band, norm-trend growth), computed by one
+  tiny jitted function per iteration and READ only at the existing
+  iteration-boundary callback gate, so the armed cost is one small
+  kernel plus one scalar sync per iteration and the production step's
+  jaxpr is untouched.  Disarmed, the cost is one mode check per
+  ``train()`` call — the jitted step is byte-identical (pinned in
+  tests/test_guardrails.py, the perf/ne_audit.py discipline).
+- **Adaptive solve** — ``recover`` mode rebuilds the step with
+  ``AlsConfig.adaptive_solve=True``: residual-checked jitter escalation
+  (base → 1e-4 → 1e-2) with a final CG fallback inside
+  :func:`tpu_als.ops.solve.solve_spd`, inherited by every solve backend
+  because it sits above the dispatch (the shared pre-regularization
+  contract).
+- **Rollback** — a rolling last-good factor snapshot (copied at each
+  healthy boundary; the production step donates its inputs, so the
+  snapshot must be a real copy).  On a trip in ``recover`` mode the
+  iteration is retried from the snapshot with a seeded perturbation and
+  a regularization bump; the budget reuses
+  :class:`tpu_als.resilience.retry.RetryPolicy` (``max_attempts``
+  rollbacks), after which the typed :class:`TrainDiverged` raises.
+  ``warn`` mode only emits and keeps going.
+
+Obs trail: every trip emits ``guardrail_tripped``; every rollback bumps
+the ``train.rollbacks`` counter and emits ``train_rollback``.  The
+ingest half of the guardrail story (poisoned-input quarantine) lives in
+:mod:`tpu_als.io.stream` / :mod:`tpu_als.core.ratings`.
+
+Deliberately importable without jax (the mode check runs in jax-free
+contexts); jax is imported only once a Monitor actually runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from tpu_als.resilience.retry import RetryPolicy
+
+MODES = ("off", "warn", "recover")
+
+ENV_VAR = "TPU_ALS_GUARDRAILS"
+
+# sentinel vocabulary (docs/resilience.md): the `sentinel` field of
+# every guardrail_tripped event is one of these
+SENTINELS = ("nonfinite", "norm_band", "trend")
+
+# default thresholds.  Factor rows start unit-norm (core.als.init_factors)
+# and a healthy explicit/implicit fit keeps row norms within a few orders
+# of magnitude of the rating scale; 1e4 is far outside any converging
+# trajectory while far inside f32 overflow.  The trend sentinel fires on a
+# >10x global-norm jump between consecutive healthy iterations — ALS
+# monotonically decreases its objective, so a norm explosion is the
+# cheap, ratings-free proxy for an RMSE-trend reversal.
+NORM_BAND_MAX = 1e4
+TREND_FACTOR = 10.0
+
+# recover-mode knobs: every rollback perturbs the snapshot by
+# PERTURB_SCALE gaussian noise (seeded — replays exactly) and multiplies
+# the effective regParam by REG_BUMP_FACTOR for the retried iterations.
+PERTURB_SCALE = 1e-3
+REG_BUMP_FACTOR = 10.0
+
+# default rollback budget: 3 rollbacks, then TrainDiverged.  A
+# RetryPolicy so call sites can override with the same vocabulary every
+# other resilience site uses (delays are irrelevant — rollback retries
+# immediately).
+DEFAULT_ROLLBACK_POLICY = RetryPolicy(max_attempts=3, base_delay=0.0,
+                                      jitter=0.0)
+
+
+class TrainDiverged(ArithmeticError):
+    """The rollback budget is exhausted and the fit still trips a
+    sentinel — the run is numerically unrecoverable under the current
+    config (raise regParam / jitter, or inspect the data)."""
+
+    def __init__(self, iteration, rollbacks, sentinel):
+        super().__init__(
+            f"training diverged at iteration {iteration}: sentinel "
+            f"{sentinel!r} still trips after {rollbacks} rollback(s) — "
+            "rollback budget exhausted (see docs/resilience.md "
+            "guardrails)")
+        self.iteration = iteration
+        self.rollbacks = rollbacks
+        self.sentinel = sentinel
+
+
+_mode = None   # explicit set_mode value; None -> consult the env var
+
+
+def set_mode(mode):
+    """Arm the guardrails programmatically (the estimator's
+    ``guardrails=`` knob lands here)."""
+    global _mode
+    if mode not in MODES:
+        raise ValueError(f"unknown guardrails mode {mode!r} "
+                         f"(expected one of {MODES})")
+    _mode = mode
+
+
+def clear_mode():
+    """Back to the environment default."""
+    global _mode
+    _mode = None
+
+
+def guardrails_mode():
+    """The effective mode: an explicit :func:`set_mode` wins, else the
+    ``TPU_ALS_GUARDRAILS`` env var, else 'off'.  A garbage env value
+    raises (silently disarming a guardrail would be worse)."""
+    if _mode is not None:
+        return _mode
+    env = os.environ.get(ENV_VAR, "off") or "off"
+    if env not in MODES:
+        raise ValueError(f"{ENV_VAR}={env!r} is not a guardrails mode "
+                         f"(expected one of {MODES})")
+    return env
+
+
+def armed():
+    return guardrails_mode() != "off"
+
+
+@contextlib.contextmanager
+def scoped(mode):
+    """Scoped arming for tests, scenarios, and the estimator fit."""
+    global _mode
+    prev = _mode
+    set_mode(mode)
+    try:
+        yield
+    finally:
+        _mode = prev
+
+
+_health_jit = None
+
+
+def health_stats(U, V):
+    """One jitted reduction over both factor matrices:
+    ``[finite, max_row_norm_u, max_row_norm_v, global_fro_norm]`` as a
+    length-4 f32 device array.  O(N·r) elementwise + reduce — trivial
+    next to a half-step's gathers — and NOT read here: the caller syncs
+    it at the iteration boundary."""
+    global _health_jit
+    if _health_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        def h(U, V):
+            finite = jnp.isfinite(U).all() & jnp.isfinite(V).all()
+            un = jnp.sqrt(jnp.max(jnp.sum(U * U, axis=1)))
+            vn = jnp.sqrt(jnp.max(jnp.sum(V * V, axis=1)))
+            fro = jnp.sqrt(jnp.sum(U * U) + jnp.sum(V * V))
+            return jnp.stack([finite.astype(jnp.float32), un, vn, fro])
+
+        _health_jit = jax.jit(h)
+    return _health_jit(U, V)
+
+
+class Monitor:
+    """Per-fit sentinel state + rollback machinery for the training loop
+    (:func:`tpu_als.core.als.train` instantiates one when armed).
+
+    The loop contract, per iteration: :meth:`keep_last_good` BEFORE the
+    step (the step donates its inputs), :meth:`judge` on the outputs at
+    the boundary, and — on a trip in recover mode — :meth:`rollback` to
+    get perturbed last-good factors plus the bumped reg scale for the
+    rebuilt step.
+    """
+
+    def __init__(self, cfg, mode, *, norm_band_max=NORM_BAND_MAX,
+                 trend_factor=TREND_FACTOR, policy=None):
+        if mode not in ("warn", "recover"):
+            raise ValueError(f"Monitor mode must be 'warn' or 'recover', "
+                             f"got {mode!r}")
+        self.cfg = cfg
+        self.mode = mode
+        self.norm_band_max = float(norm_band_max)
+        self.trend_factor = float(trend_factor)
+        self.policy = policy if policy is not None \
+            else DEFAULT_ROLLBACK_POLICY
+        self.rollbacks = 0
+        self.reg_scale = 1.0
+        self._snap = None
+        self._prev_fro = None
+
+    def keep_last_good(self, U, V, retry=False):
+        """Snapshot the pre-step factors (recover mode only; warn never
+        rolls back so it never pays the copy).  ``retry=True`` marks a
+        post-rollback attempt: the perturbed factors must NOT replace
+        the clean snapshot they were derived from."""
+        if self.mode != "recover" or retry:
+            return
+        import jax.numpy as jnp
+
+        self._snap = (jnp.array(U, copy=True), jnp.array(V, copy=True))
+
+    def judge(self, iteration, U, V):
+        """Read the sentinels at the iteration boundary (the one host
+        sync).  Returns the tripped sentinel name, or None when healthy;
+        emits ``guardrail_tripped`` on a trip."""
+        import numpy as np
+
+        s = np.asarray(health_stats(U, V))
+        finite = bool(s[0])
+        row_norm = float(max(s[1], s[2]))
+        fro = float(s[3])
+        trip = None
+        value = None
+        if not finite:
+            trip, value = "nonfinite", 0.0
+        elif row_norm > self.norm_band_max:
+            trip, value = "norm_band", row_norm
+        elif (self._prev_fro is not None
+                and fro > self.trend_factor * self._prev_fro):
+            trip, value = "trend", fro / self._prev_fro
+        if trip is None:
+            self._prev_fro = fro
+            return None
+        from tpu_als import obs
+
+        obs.emit("guardrail_tripped", iteration=int(iteration),
+                 sentinel=trip, mode=self.mode, value=value)
+        return trip
+
+    def rollback(self, iteration, sentinel):
+        """Bounded rollback-and-retry: restore the last-good snapshot
+        with a seeded perturbation and bump the regularization.  Returns
+        ``(U, V, reg_scale)``; raises :class:`TrainDiverged` once the
+        policy's ``max_attempts`` rollbacks are spent (or when no
+        healthy snapshot exists — a fit whose very first iteration
+        diverges has nothing to roll back to)."""
+        if self.rollbacks >= self.policy.max_attempts or self._snap is None:
+            raise TrainDiverged(iteration, self.rollbacks, sentinel)
+        self.rollbacks += 1
+        self.reg_scale *= REG_BUMP_FACTOR
+        import jax
+
+        U0, V0 = self._snap
+        # key is a pure function of (seed, iteration, attempt): a failing
+        # recovery replays exactly, and consecutive rollbacks at one
+        # iteration draw different noise
+        key = jax.random.PRNGKey(
+            (self.cfg.seed * 1_000_003 + iteration * 101 + self.rollbacks)
+            & 0x7FFFFFFF)
+        ku, kv = jax.random.split(key)
+        U = U0 + PERTURB_SCALE * jax.random.normal(ku, U0.shape, U0.dtype)
+        V = V0 + PERTURB_SCALE * jax.random.normal(kv, V0.shape, V0.dtype)
+        from tpu_als import obs
+
+        obs.counter("train.rollbacks", 1)
+        obs.emit("train_rollback", iteration=int(iteration),
+                 attempt=self.rollbacks, sentinel=sentinel,
+                 reg_param=float(self.cfg.reg_param * self.reg_scale))
+        return U, V, self.reg_scale

@@ -697,6 +697,197 @@ def _flight_recorder():
 
 
 # ---------------------------------------------------------------------------
+# solver-divergence
+
+
+def _sd_problem(c):
+    from tpu_als.core.ratings import build_csr_buckets
+
+    rng = np.random.default_rng(c["seed"])
+    u = rng.integers(0, c["users"], c["nnz"])
+    i = rng.integers(0, c["items"], c["nnz"])
+    r = rng.uniform(0.5, 5.0, c["nnz"]).astype(np.float32)
+    ucsr = build_csr_buckets(u, i, r, c["users"], min_width=4,
+                             chunk_elems=1 << 12)
+    icsr = build_csr_buckets(i, u, r, c["items"], min_width=4,
+                             chunk_elems=1 << 12)
+    return u, i, r, ucsr, icsr
+
+
+def _fit_rmse(U, V, u, i, r):
+    U, V = np.asarray(U), np.asarray(V)
+    pred = np.einsum("nr,nr->n", U[u], V[i])
+    return float(np.sqrt(np.mean((pred - r) ** 2)))
+
+
+def _sd_divergent(ctx):
+    from tpu_als.core.als import AlsConfig, train
+    from tpu_als.resilience import guardrails
+
+    c = ctx.config
+    u, i, r, ucsr, icsr = _sd_problem(c)
+    cfg = AlsConfig(rank=c["rank"], max_iter=c["iters"],
+                    reg_param=c["reg"], seed=c["seed"])
+    ctx.state.update(u=u, i=i, r=r, ucsr=ucsr, icsr=icsr, cfg=cfg)
+    with guardrails.scoped("recover"):
+        U, V = train(ucsr, icsr, cfg)
+    ctx.facts["recovered_finite"] = bool(
+        np.isfinite(np.asarray(U)).all()
+        and np.isfinite(np.asarray(V)).all())
+    ctx.facts["recovered_rmse"] = _fit_rmse(U, V, u, i, r)
+
+
+def _sd_clean(ctx):
+    from tpu_als.core.als import train
+
+    s = ctx.state
+    # the divergent phase consumed the nth=3 firing (nth schedules fire
+    # exactly once), so the still-armed spec can never fire here
+    U, V = train(s["ucsr"], s["icsr"], s["cfg"])
+    clean = _fit_rmse(U, V, s["u"], s["i"], s["r"])
+    ctx.facts["clean_rmse"] = clean
+    ctx.facts["rmse_ratio"] = ctx.facts["recovered_rmse"] / clean
+
+
+def _solver_divergence():
+    return ScenarioSpec(
+        name="solver-divergence",
+        doc="a NaN poisoned into the factors mid-train (solve.gram "
+            "corrupt at iteration 3) must trip the nonfinite sentinel, "
+            "roll back to the last-good snapshot, and finish with final "
+            "RMSE inside the clean-run band — the --guardrails recover "
+            "contract (docs/resilience.md).",
+        fault_spec="solve.gram=corrupt@nth=3",
+        defaults=dict(seed=0, users=300, items=200, nnz=5000, rank=8,
+                      iters=6, reg=0.1, rmse_band=1.2),
+        phases=(
+            Phase("divergent-fit", _sd_divergent,
+                  "guardrails=recover train with the mid-train NaN"),
+            Phase("clean-fit", _sd_clean,
+                  "reference run, same config, fault already consumed"),
+        ),
+        assertions=(
+            Assertion("sentinel_tripped", "event",
+                      event="guardrail_tripped", op=">=", value=1,
+                      doc="the nonfinite sentinel fired at the poisoned "
+                          "iteration's boundary"),
+            Assertion("rolled_back", "event", event="train_rollback",
+                      op=">=", value=1),
+            Assertion("rollback_counted", "counter",
+                      metric="train.rollbacks", op=">=", value=1),
+            Assertion("recovered_factors_finite", "fact",
+                      fact="recovered_finite", op="==", value=True),
+            Assertion("rmse_within_clean_band", "fact",
+                      fact="rmse_ratio", op="<=", value="$rmse_band",
+                      doc="recovered fit quality vs the clean reference"),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# poisoned-stream
+
+
+def _ps_write(ctx):
+    c = ctx.config
+    rng = np.random.default_rng(c["seed"])
+    u = rng.integers(0, c["users"], c["rows"])
+    i = rng.integers(0, c["items"], c["rows"])
+    r = rng.uniform(0.5, 5.0, c["rows"]).astype(np.float32)
+    path = os.path.join(ctx.workdir, "ratings.csv")
+    with open(path, "wb") as f:
+        for k in range(c["rows"]):
+            f.write(f"u{u[k]},i{i[k]},{r[k]:.4f}\n".encode())
+    ctx.state.update(path=path, u=u, i=i, r=r)
+
+
+def _ps_ingest(ctx):
+    from tpu_als import obs
+    from tpu_als.io.stream import stream_ingest
+    from tpu_als.resilience import faults
+
+    c0 = obs.counter_value("ingest.quarantined_rows")
+    uo, io_, ro, ul, il = stream_ingest(ctx.state["path"],
+                                        quarantine=True)
+    quarantined = obs.counter_value("ingest.quarantined_rows") - c0
+    injected = faults.hits("ingest.record")[1]
+    ctx.state.update(uo=uo, io=io_, ro=ro, ul=ul, il=il)
+    ctx.facts["injected_records"] = int(injected)
+    ctx.facts["quarantined_rows"] = int(quarantined)
+    ctx.facts["quarantined_equals_injected"] = \
+        int(quarantined) == int(injected)
+    ctx.facts["rows_out"] = int(len(ro))
+    ctx.facts["survivors_finite"] = bool(np.isfinite(ro).all())
+
+
+def _ps_fit(ctx):
+    from tpu_als.core.als import AlsConfig, train
+    from tpu_als.core.ratings import build_csr_buckets
+
+    c, s = ctx.config, ctx.state
+    cfg = AlsConfig(rank=c["rank"], max_iter=c["iters"],
+                    reg_param=c["reg"], seed=c["seed"])
+
+    def fit_rmse(u, i, r, nu, ni):
+        ucsr = build_csr_buckets(u, i, r, nu, min_width=4,
+                                 chunk_elems=1 << 12)
+        icsr = build_csr_buckets(i, u, r, ni, min_width=4,
+                                 chunk_elems=1 << 12)
+        U, V = train(ucsr, icsr, cfg)
+        return _fit_rmse(U, V, u, i, r)
+
+    # survivors: the ~99% that passed quarantine, in local dense ids
+    survivor = fit_rmse(s["uo"], s["io"], s["ro"],
+                        len(s["ul"]), len(s["il"]))
+    # reference: the full clean arrays the csv was synthesized from
+    clean = fit_rmse(s["u"], s["i"], s["r"], c["users"], c["items"])
+    ctx.facts["survivor_rmse"] = survivor
+    ctx.facts["clean_rmse"] = clean
+    ctx.facts["rmse_ratio"] = survivor / clean
+
+
+def _poisoned_stream():
+    return ScenarioSpec(
+        name="poisoned-stream",
+        doc="a ~1%-poisoned rating stream (ingest.record corrupt every "
+            "100 records) must quarantine EVERY bad record — sink + "
+            "counter == injected count, exactly — while the surviving "
+            "99% fit to the clean run's quality (docs/resilience.md "
+            "quarantine).",
+        fault_spec="ingest.record=corrupt@every=100",
+        defaults=dict(seed=0, users=120, items=80, rows=4000, rank=8,
+                      iters=5, reg=0.1, rmse_band=1.1),
+        phases=(
+            Phase("write-stream", _ps_write,
+                  "synthesize the rating csv"),
+            Phase("poisoned-ingest", _ps_ingest,
+                  "stream_ingest with quarantine on; the armed fault "
+                  "point poisons the scheduled records pre-parse"),
+            Phase("fit-survivors", _ps_fit,
+                  "train on the surviving rows vs the clean reference"),
+        ),
+        assertions=(
+            Assertion("poison_injected", "fact", fact="injected_records",
+                      op=">=", value=20,
+                      doc="the chaos schedule actually fired (~1% of "
+                          "the stream)"),
+            Assertion("all_poison_quarantined", "fact",
+                      fact="quarantined_equals_injected", op="==",
+                      value=True,
+                      doc="quarantine counter == injected count"),
+            Assertion("quarantine_counted", "counter",
+                      metric="ingest.quarantined_rows", op=">=", value=1),
+            Assertion("quarantine_event", "event",
+                      event="ingest_quarantined", op=">=", value=1),
+            Assertion("survivors_finite", "fact", fact="survivors_finite",
+                      op="==", value=True),
+            Assertion("fit_quality_unchanged", "fact", fact="rmse_ratio",
+                      op="<=", value="$rmse_band"),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 _BUILDERS = (
@@ -706,6 +897,8 @@ _BUILDERS = (
     _cold_start,
     _preempt_resume,
     _flight_recorder,
+    _solver_divergence,
+    _poisoned_stream,
 )
 
 SCENARIOS = {s.name: s for s in (b() for b in _BUILDERS)}

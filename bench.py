@@ -87,6 +87,42 @@ def _load_retry_module():
     return mod
 
 
+def _load_plan_cache_module():
+    """Load tpu_als/plan/cache.py STANDALONE (stdlib-only, same contract
+    as retry.py above): the execution planner's persistent autotune
+    cache knows whether this jax version already has banked plan
+    entries, which shrinks the probe envelope a known-good config needs
+    — without pulling jax into this process."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tpu_als", "plan", "cache.py")
+    spec = importlib.util.spec_from_file_location("_bench_plan_cache", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+DEFAULT_PROBE_BUDGET_S = 600
+
+
+def resolve_probe_budget(requested):
+    """The bench probe-budget dispatch, planner-consulted: an explicit
+    ``--probe-budget`` always wins; the default asks the plan cache
+    (``suggested_probe_budget``) — warm entries for this jax version
+    mean the winning paths compile immediately, so the TPU-ready
+    envelope drops from 600 s to ~120 s.  Returns ``(budget_s, why)``.
+    """
+    if requested is not None:
+        return max(0, requested), "explicit --probe-budget"
+    try:
+        pc = _load_plan_cache_module()
+        budget, why = pc.suggested_probe_budget(DEFAULT_PROBE_BUDGET_S)
+        return budget, why
+    except Exception as e:          # cache trouble must never fail bench
+        return DEFAULT_PROBE_BUDGET_S, f"plan cache unavailable ({e})"
+
+
 class ProbeBudgetExhausted(RuntimeError):
     """Total probe wall-clock budget spent.  Deliberately NOT a
     TimeoutError: the retry policy treats timeouts as transient and
@@ -1568,13 +1604,16 @@ def main():
                          "survives a brief tunnel outage (~20 min total)")
     ap.add_argument("--probe-wait", type=int, default=90)
     ap.add_argument("--probe-timeout", type=int, default=120)
-    ap.add_argument("--probe-budget", type=int, default=600,
+    ap.add_argument("--probe-budget", type=int, default=None,
                     help="TOTAL wall-clock cap across all probe attempts "
                          "+ waits, seconds (0 = uncapped).  Round 5 "
                          "burned 6x120s on a hung backend and banked a "
                          "null; on exhaustion the capture banks the "
                          "strongest builder-measured sweep value instead "
-                         "(source: sweep_fallback)")
+                         "(source: sweep_fallback).  Default: the "
+                         "execution planner's suggestion — 600, or ~120 "
+                         "when the plan cache holds warm entries for "
+                         "this jax version (docs/planner.md)")
     args = ap.parse_args()
 
     if (args.mode == "headline" and not args.no_auto_config
@@ -1617,9 +1656,12 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
     else:
+        budget_s, budget_why = resolve_probe_budget(args.probe_budget)
+        print(f"probe budget {budget_s:.0f}s ({budget_why})",
+              file=sys.stderr)
         ok, err, probe_events = tpu_ready(
             args.probe_attempts, args.probe_wait, args.probe_timeout,
-            budget_s=max(0, args.probe_budget))
+            budget_s=budget_s)
         if not ok:
             print(json.dumps(error_json(args, metric, unit, err,
                                         probe_events=probe_events)))

@@ -94,6 +94,39 @@ INLINE_TS_RE = re.compile(r"['\"]ts['\"]\s*:")
 
 DEFAULT_ROOTS = ("tpu_als", "scripts", "bench.py")
 
+# the execution planner's event vocabulary is a cross-process CONTRACT:
+# the warm-start tests assert trails like "plan_cache_hit present,
+# plan_probe absent", so a renamed/undeclared literal would silently
+# void those assertions.  Pin all four here, over and above the generic
+# call-site validation.
+PLAN_EVENTS = ("plan_resolved", "plan_probe", "plan_cache_hit",
+               "plan_cache_miss")
+
+
+def check_plan_vocabulary():
+    """The four plan_* events must be declared in the schema AND emitted
+    by tpu_als/plan/planner.py (an emit that moved elsewhere without a
+    declaration update fails the generic pass; a declaration whose emit
+    vanished fails here)."""
+    errors = []
+    for name in PLAN_EVENTS:
+        if name not in schema.EVENTS:
+            errors.append(
+                f"tpu_als/obs/schema.py: planner event {name!r} is not "
+                "declared in EVENTS (the tpu_als.plan contract pins all "
+                f"four of {', '.join(PLAN_EVENTS)})")
+    planner_py = os.path.join(REPO, "tpu_als", "plan", "planner.py")
+    if os.path.exists(planner_py):
+        with open(planner_py, encoding="utf-8") as f:
+            text = f.read()
+        for name in PLAN_EVENTS:
+            if f'"{name}"' not in text:
+                errors.append(
+                    f"tpu_als/plan/planner.py: never emits {name!r} — "
+                    "the plan_* event trail is the warm-start test "
+                    "contract (docs/planner.md)")
+    return errors
+
 
 def _py_files(paths):
     for p in paths:
@@ -243,6 +276,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     paths = args.paths or [os.path.join(REPO, p) for p in DEFAULT_ROOTS]
     errors = []
+    if args.paths is None:          # fixture runs scan only their files
+        errors.extend(check_plan_vocabulary())
     nfiles = 0
     for path in _py_files(paths):
         nfiles += 1

@@ -1,0 +1,100 @@
+#!/bin/bash
+# Execution-planner smoke: the tpu_als/plan subsystem's CI gate,
+# CPU-only (no accelerator, no network).  Four stages, fail-fast:
+#
+#   1. the planner test tier — cache schema/quarantine negatives, the
+#      seed-and-walk equivalence pins, the probe-registry contract,
+#      and the cross-process warm-start trail
+#      (tests/test_plan.py + tests/test_platform.py),
+#   2. the static obs-schema check (the four plan_* event literals
+#      must stay declared AND emitted — check_plan_vocabulary),
+#   3. one END-TO-END cold-vs-warm resolve through the real CLI in a
+#      fresh cache dir: run 1 must probe and bank (plan_cache_miss +
+#      plan_probe in its trail), run 2 must resolve the SAME plan with
+#      zero probe executions (plan_cache_hit present, plan_probe
+#      absent), and `plan show` must render the banked provenance,
+#   4. the bench regression gate over the committed result banks —
+#      BENCH_plan_warmstart.json rides the same provenance rules as
+#      every other bank (scripts/bench_gate.sh).
+#
+# Usage: scripts/plan_smoke.sh   (from the repo root; ~1 min on CPU)
+set -u
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+fail=0
+
+echo "== plan smoke 1/4: planner test tier =="
+python -m pytest tests/test_plan.py tests/test_platform.py \
+    -q -m 'not slow' -p no:cacheprovider || fail=1
+
+echo "== plan smoke 2/4: obs schema (static, incl. plan_* vocabulary) =="
+python scripts/check_obs_schema.py || fail=1
+
+echo "== plan smoke 3/4: end-to-end cold-vs-warm resolve =="
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+export TPU_ALS_PLAN_CACHE="$work/plan"
+python -m tpu_als.cli plan warm --rank 16 --k 5 \
+    --obs-dir "$work/obs_cold" >"$work/cold.json" 2>"$work/cold.log" \
+    || { echo "FAIL: cold plan warm exited nonzero" >&2; fail=1; }
+python -m tpu_als.cli plan warm --rank 16 --k 5 \
+    --obs-dir "$work/obs_warm" >"$work/warm.json" 2>"$work/warm.log" \
+    || { echo "FAIL: warm plan warm exited nonzero" >&2; fail=1; }
+python -m tpu_als.cli plan show >"$work/show.json" 2>>"$work/warm.log" \
+    || { echo "FAIL: plan show exited nonzero" >&2; fail=1; }
+python - "$work" <<'EOF' || fail=1
+import json, os, sys
+
+work = sys.argv[1]
+
+def trail(run):
+    with open(os.path.join(work, run, "events.jsonl")) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+def of(evs, t):
+    return [e for e in evs if e["type"] == t]
+
+cold, warm = trail("obs_cold"), trail("obs_warm")
+problems = []
+if not of(cold, "plan_cache_miss"):
+    problems.append("cold run emitted no plan_cache_miss")
+if not of(cold, "plan_probe"):
+    problems.append("cold run emitted no plan_probe (walk unrecorded)")
+if not of(warm, "plan_cache_hit"):
+    problems.append("warm run emitted no plan_cache_hit")
+if of(warm, "plan_probe"):
+    problems.append(f"warm run executed {len(of(warm, 'plan_probe'))} "
+                    "probes — the zero-probe warm-start contract is broken")
+if any(e["source"] != "cache" for e in of(warm, "plan_resolved")):
+    problems.append("warm run resolved a component outside the cache")
+cp = {e["component"]: e["resolved"] for e in of(cold, "plan_resolved")}
+wp = {e["component"]: e["resolved"] for e in of(warm, "plan_resolved")}
+if cp != wp:
+    problems.append(f"cold and warm resolved DIFFERENT plans: {cp} != {wp}")
+show = json.load(open(os.path.join(work, "show.json")))
+entries = [e for e in show["entries"] if "components" in e]
+if not entries:
+    problems.append("plan show rendered no valid entries after warm")
+elif any("banked_at" not in c for e in entries
+         for c in e["components"].values()):
+    problems.append("plan show entry missing banked_at provenance")
+for p in problems:
+    print(f"FAIL: plan smoke e2e: {p}", file=sys.stderr)
+cold_s = json.load(open(os.path.join(work, "cold.json")))["resolve_seconds"]
+warm_s = json.load(open(os.path.join(work, "warm.json")))["resolve_seconds"]
+print(f"plan e2e: cold resolve {cold_s}s -> warm resolve {warm_s}s, "
+      f"{len(entries)} banked entr{'y' if len(entries) == 1 else 'ies'}, "
+      "warm trail probe-free")
+sys.exit(1 if problems else 0)
+EOF
+unset TPU_ALS_PLAN_CACHE
+
+echo "== plan smoke 4/4: bench regression gate =="
+bash scripts/bench_gate.sh || fail=1
+
+if [ "$fail" -ne 0 ]; then
+    echo "plan smoke: FAIL" >&2
+    exit 1
+fi
+echo "plan smoke: OK"

@@ -15,6 +15,18 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Hermetic execution planner: without this, every resolve_solve_path call
+# in the suite would read/write the developer's real autotune cache
+# (~/.cache/tpu_als/plan) and test outcomes would depend on what previous
+# runs banked there.  One throwaway dir per session keeps the suite
+# cold-start deterministic; tests that need their own cache (or the
+# disarmed mode) monkeypatch TPU_ALS_PLAN_CACHE on top.
+if "TPU_ALS_PLAN_CACHE" not in os.environ:
+    import tempfile
+
+    os.environ["TPU_ALS_PLAN_CACHE"] = os.path.join(
+        tempfile.mkdtemp(prefix="tpu_als_plan_test_"), "plan")
+
 import jax  # noqa: E402
 
 # The axon TPU plugin in this environment ignores JAX_PLATFORMS=cpu from the

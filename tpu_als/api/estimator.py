@@ -243,12 +243,15 @@ class ALS(_ALSParams, Estimator):
                              "'matfree' or 'dense')")
         self.cgIters = int(cgIters)
         self.cgMode = cgMode
-        if gatherStrategy not in ("all_gather", "all_gather_chunked",
-                                  "ring", "ring_overlap", "all_to_all"):
+        if gatherStrategy not in ("auto", "all_gather",
+                                  "all_gather_chunked", "ring",
+                                  "ring_overlap", "all_to_all"):
             raise ValueError(
                 f"unknown gatherStrategy {gatherStrategy!r} (expected "
-                "'all_gather', 'all_gather_chunked', 'ring', "
-                "'ring_overlap' or 'all_to_all')")
+                "'auto', 'all_gather', 'all_gather_chunked', 'ring', "
+                "'ring_overlap' or 'all_to_all'; 'auto' lets the "
+                "execution planner pick by modeled collective bytes — "
+                "tpu_als.plan)")
         if dataMode not in ("replicated", "per_host"):
             raise ValueError(f"unknown dataMode {dataMode!r} (expected "
                              "'replicated' or 'per_host')")

@@ -49,6 +49,16 @@ def check_multiprocess_gate(est):
             os.path.abspath(est.checkpointDir).encode(),
             digest_size=8).digest()
         ckdir_digest = int(np.frombuffer(h, dtype=np.int64)[0])
+    if est.gatherStrategy == "auto":
+        # the planner's model is deterministic, but the knob gate below
+        # compares the REQUESTED strategy across hosts before shapes are
+        # agreed — resolving after the gate would let a cache-divergent
+        # host pair mismatched collectives.  Require explicitness here.
+        raise ValueError(
+            "gatherStrategy='auto' is not supported in multi-process "
+            "fits — resolve it up front (tpu_als plan warm shows the "
+            "modeled pick) and pass the same explicit strategy on every "
+            "process")
     strat_code = ("all_gather", "ring",
                   "all_to_all").index(est.gatherStrategy)
     gate = np.asarray(mhu.process_allgather(np.array(
@@ -219,6 +229,17 @@ def fit_sharded(est, u_idx, i_idx, r, user_map, item_map, cfg,
         ipart = partition_balanced(
             np.bincount(i_idx, minlength=len(item_map)), D)
     strategy = est.gatherStrategy
+    if strategy == "auto":
+        # planner resolve BEFORE container building (the container
+        # layout is strategy-specific); deterministic given shapes —
+        # tpu_als.plan.resolve_gather_strategy never takes the verdict
+        # from the cache, only banks it for provenance
+        from tpu_als import plan as _plan
+
+        strategy = _plan.resolve_gather_strategy(
+            requested="auto", n_users=len(user_map),
+            n_items=len(item_map), rank=cfg.rank, n_devices=int(D),
+            implicit=cfg.implicit_prefs)
     ring_counts = None
     with obs.span("train.block", strategy=strategy):
         if strategy in ("ring", "ring_overlap"):

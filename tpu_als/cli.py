@@ -815,7 +815,10 @@ def cmd_serve_bench(args):
     rng = np.random.default_rng(args.seed)
     U = rng.normal(size=(args.users, args.rank)).astype(np.float32)
     V = rng.normal(size=(args.items, args.rank)).astype(np.float32)
-    buckets = tuple(int(b) for b in args.buckets.split(","))
+    # no --buckets: the execution planner supplies the ladder (a banked
+    # plan for this device/jax key, else the DEFAULT_BUCKETS walk)
+    buckets = (tuple(int(b) for b in args.buckets.split(","))
+               if args.buckets else None)
     engine = ServingEngine(
         k=args.k, buckets=buckets, shortlist_k=args.shortlist_k,
         max_queue=args.max_queue, max_wait_s=args.max_wait_ms / 1e3,
@@ -890,7 +893,8 @@ def cmd_serve_bench(args):
             "path": path, "users": args.users, "items": args.items,
             "rank": args.rank, "k": args.k,
             "shortlist_k": args.shortlist_k, "qps": args.qps,
-            "duration_s": args.duration, "buckets": list(buckets),
+            "duration_s": args.duration,
+            "buckets": list(engine.batcher.buckets),
             "max_queue": args.max_queue, "max_wait_ms": args.max_wait_ms,
             "deadline_ms": args.deadline_ms,
             "foldin_frac": args.foldin_frac,
@@ -1145,6 +1149,63 @@ def _validate_fault_spec():
         raise SystemExit(2) from e
 
 
+def cmd_plan(args):
+    """Execution-planner verbs (docs/planner.md): ``show`` renders the
+    persistent autotune cache (mode, entries, provenance — corrupt
+    files included, flagged); ``warm`` resolves the full ExecutionPlan
+    for one configuration eagerly (cold: probes run and the verdicts
+    bank; warm: zero probe executions) and prints it with the resolve
+    wall-clock; ``clear`` drops the on-disk entries and the in-process
+    probe registry."""
+    import time
+
+    from tpu_als import plan as plan_pkg
+    from tpu_als.plan import cache as plan_cache
+
+    if args.plan_cmd == "show":
+        entries = []
+        for path, doc in plan_cache.list_entries():
+            if isinstance(doc, dict):
+                comps = {}
+                for name, comp in doc["components"].items():
+                    prov = comp["provenance"]
+                    comps[name] = {
+                        "resolved": comp["resolved"],
+                        "banked_at": prov["banked_at"],
+                        "walk_seconds": prov.get("walk_seconds"),
+                        "probes_executed": prov.get("probes_executed"),
+                        "model": prov.get("model"),
+                    }
+                entries.append({"path": path, "plan_key": doc["plan_key"],
+                                "probes": doc["probes"],
+                                "components": comps})
+            else:                       # PlanCacheCorrupt — show, don't die
+                entries.append({"path": path, "corrupt": str(doc)})
+        print(json.dumps({"mode": plan_pkg.mode(),
+                          "cache_dir": plan_cache.cache_dir(),
+                          "entries": entries}, indent=2, default=str))
+        return
+
+    if args.plan_cmd == "warm":
+        t0 = time.perf_counter()
+        ep = plan_pkg.resolve_execution_plan(
+            rank=args.rank, compute_dtype=args.dtype,
+            solve_backend=args.solve_backend, cg_iters=args.cg_iters,
+            k=args.k, n_users=args.users, n_items=args.items,
+            n_devices=args.devices)
+        out = ep.summary()
+        out["resolve_seconds"] = round(time.perf_counter() - t0, 4)
+        out["mode"] = plan_pkg.mode()
+        print(json.dumps(out, default=str))
+        return out
+
+    if args.plan_cmd == "clear":
+        root = plan_cache.cache_dir()
+        n = plan_pkg.clear()
+        print(json.dumps({"cleared_entries": n, "cache_dir": root}))
+        return
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="tpu_als")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -1179,12 +1240,14 @@ def main(argv=None):
                    help="train sharded over N devices (0 = all visible; "
                         "1 = single device, the default)")
     t.add_argument("--gather-strategy", default="all_gather",
-                   choices=["all_gather", "all_gather_chunked", "ring",
-                            "ring_overlap", "all_to_all"],
+                   choices=["auto", "all_gather", "all_gather_chunked",
+                            "ring", "ring_overlap", "all_to_all"],
                    help="how sharded half-steps move the opposite factors "
                         "(ring_overlap = double-buffered ring; "
                         "all_gather_chunked = column-block gathers, the "
-                        "full opposite table never materializes)")
+                        "full opposite table never materializes; auto = "
+                        "the execution planner's comm-model pick, "
+                        "single-process mesh fits only)")
     t.add_argument("--per-host-data", action="store_true",
                    help="multi-process only: each process loads its OWN "
                         "--data split ('{proc}' in the spec expands to "
@@ -1330,9 +1393,11 @@ def main(argv=None):
                          "are shed (typed Overloaded)")
     sb.add_argument("--max-wait-ms", type=float, default=2.0,
                     help="micro-batch coalescing window")
-    sb.add_argument("--buckets", default="8,32,128",
+    sb.add_argument("--buckets", default=None,
                     help="comma-separated padded batch sizes (one "
-                         "compiled program each)")
+                         "compiled program each); default: the "
+                         "execution planner's bucket plan (a banked "
+                         "ladder for this device, else 8,32,128)")
     sb.add_argument("--foldin-frac", type=float, default=0.0,
                     help="fraction of requests carrying a fold-in "
                          "factor row instead of a user id")
@@ -1481,6 +1546,42 @@ def main(argv=None):
                           "errors instead of warnings")
     os5.add_argument("--json", dest="as_json", action="store_true")
     os5.set_defaults(fn=cmd_observe)
+
+    pl = sub.add_parser(
+        "plan",
+        help="execution planner: inspect, warm, or clear the "
+             "persistent autotune cache (docs/planner.md; "
+             "TPU_ALS_PLAN_CACHE overrides the location, 'off' "
+             "disarms)")
+    plsub = pl.add_subparsers(dest="plan_cmd", required=True)
+    pls = plsub.add_parser(
+        "show", help="render the cache: mode, entries, per-component "
+                     "provenance (corrupt files flagged, not fatal)")
+    pls.set_defaults(fn=cmd_plan, obs_dir=None)
+    plw = plsub.add_parser(
+        "warm", parents=[obs_common],
+        help="resolve the full ExecutionPlan for one configuration "
+             "eagerly — cold resolves probe and bank, warm resolves "
+             "answer from the cache with zero probe executions")
+    plw.add_argument("--rank", type=int, default=128)
+    plw.add_argument("--dtype", default="float32",
+                     choices=["float32", "bfloat16"])
+    plw.add_argument("--solve-backend", default="auto",
+                     choices=["auto", "fused", "unfused", "gather_fused"])
+    plw.add_argument("--cg-iters", type=int, default=0)
+    plw.add_argument("--k", type=int, default=10,
+                     help="serving top-k (the pallas_topk probe keys "
+                          "on it)")
+    plw.add_argument("--users", type=int, default=None,
+                     help="with --items and --devices > 1: also "
+                          "resolve the gather strategy for this shape")
+    plw.add_argument("--items", type=int, default=None)
+    plw.add_argument("--devices", type=int, default=1)
+    plw.set_defaults(fn=cmd_plan)
+    plc = plsub.add_parser(
+        "clear", help="drop the on-disk entries and the in-process "
+                      "probe registry (.corrupt/ evidence is kept)")
+    plc.set_defaults(fn=cmd_plan, obs_dir=None)
 
     args = ap.parse_args(argv)
     _validate_fault_spec()

@@ -94,8 +94,33 @@ class AlsConfig:
 def resolve_solve_path(cfg: AlsConfig, rank, matfree_capable=True):
     """Which solve path the probes actually select for this config — the
     single source of truth for both the half-step dispatch and the
-    benchmark's attribution fields (VERDICT r1 weak #3: record *resolved*
-    backends, not requested ones).
+    benchmark's attribution fields.  When the execution planner is armed
+    (TPU_ALS_PLAN_CACHE != 'off', the default) the resolve goes through
+    tpu_als.plan: a warm cache entry for this (device, jax, rank, dtype)
+    key seeds the probe registry so the walk below runs with ZERO probe
+    executions; a cold resolve runs the walk and banks its verdicts.
+    Either way the verdict is computed by :func:`_resolve_solve_path_walk`
+    — the planner supplies probe outcomes, never a different answer — and
+    with the planner off this is exactly the pre-planner behavior
+    (tests/test_plan.py pins the training-step jaxpr byte-identical)."""
+    from tpu_als import plan as _plan
+
+    if _plan.armed():
+        label = (f"solve={cfg.solve_backend},cg={cfg.cg_iters},"
+                 f"mode={cfg.cg_mode},nonneg={int(cfg.nonnegative)},"
+                 f"matfree={int(matfree_capable)}")
+        resolved = _plan.resolve_training(
+            rank=rank, compute_dtype=cfg.compute_dtype, label=label,
+            walk=lambda: _resolve_solve_path_walk(cfg, rank,
+                                                  matfree_capable))
+        if resolved is not None:
+            return resolved
+    return _resolve_solve_path_walk(cfg, rank, matfree_capable)
+
+
+def _resolve_solve_path_walk(cfg: AlsConfig, rank, matfree_capable=True):
+    """The probe walk behind :func:`resolve_solve_path` (VERDICT r1 weak
+    #3: record *resolved* backends, not requested ones).
 
     Returns a dict with ``resolved_solve_path`` ∈ {'einsum+nnls',
     'fused_pallas', 'matfree_cg{n}_warmstart' (inexact ALS, no NE einsum;

@@ -228,6 +228,29 @@ EVENTS = {
         "one per `observe attribution` run: measured per-stage seconds "
         "joined against the roofline floor (the planner's measured-"
         "probe input format)"),
+    "plan_resolved": (
+        ("key", "component", "source", "resolved"),
+        "the execution planner settled a plan component (solve path / "
+        "top-k backend / gather strategy / serving buckets): the plan "
+        "key, whether the verdict came from 'cache' or a fresh 'probe' "
+        "walk, and the resolved value (tpu_als.plan.planner)"),
+    "plan_probe": (
+        ("kernel", "outcome", "seconds"),
+        "one probe consultation spent by a COLD plan resolve (the "
+        "per-kernel verdicts newly cached during the walk, plus one "
+        "'walk:<component>' record for the walk itself); a warm-cache "
+        "resolve emits none — the warm-start tests pin exactly that"),
+    "plan_cache_hit": (
+        ("key", "component", "path", "seeded"),
+        "a plan component resolved from the persistent autotune cache: "
+        "entry path and how many banked probe verdicts were seeded "
+        "into the in-process registry (zero probe executions)"),
+    "plan_cache_miss": (
+        ("key", "component", "reason"),
+        "a plan component was not servable from the cache (reason: "
+        "absent|component_absent|corrupt) — a probe walk follows and "
+        "its verdict is banked; 'corrupt' means the entry file was "
+        "quarantined to .corrupt/ first"),
 }
 
 

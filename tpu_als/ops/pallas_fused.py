@@ -194,7 +194,9 @@ def fused_normal_solve(Vg, vals, mask, YtY=None, *, reg, implicit=False,
     return x[:N, :r]
 
 
-_AVAILABLE = {}
+from tpu_als.utils.platform import probe_cache as _probe_cache
+
+_AVAILABLE = _probe_cache("pallas_fused")
 
 
 def available(rank=128, panel=16):

@@ -270,8 +270,10 @@ def gather_normal_eq_implicit(V, cols, vals, mask, reg, alpha, YtY, *,
     return A, b, count
 
 
-_AVAILABLE = {}
-_FASTER = {}
+from tpu_als.utils.platform import probe_cache as _probe_cache
+
+_AVAILABLE = _probe_cache("pallas_gather_ne")
+_FASTER = _probe_cache("pallas_gather_ne_speed")
 
 
 def available(rank=128, compute_dtype="float32"):

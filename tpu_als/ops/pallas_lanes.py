@@ -207,7 +207,9 @@ def spd_solve_lanes(A, b, panel=None, interpret=False):
     return x[:N, :r]
 
 
-_AVAILABLE = {}  # r_pad -> bool, probed once per process
+from tpu_als.utils.platform import probe_cache as _probe_cache
+
+_AVAILABLE = _probe_cache("pallas_lanes")  # r_pad -> bool, once per process
 _PANEL = {}      # r_pad -> panel width that validated on this Mosaic
 
 

@@ -224,7 +224,9 @@ def spd_solve_lanes_blocked(A, b, panel=None, interpret=False):
                                              trans=1)[..., 0]
 
 
-_AVAILABLE = {}  # r_pad -> bool, probed once per process
+from tpu_als.utils.platform import probe_cache as _probe_cache
+
+_AVAILABLE = _probe_cache("pallas_lanes_blocked")  # r_pad -> bool
 
 
 def supported_rank(rank):

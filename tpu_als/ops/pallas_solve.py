@@ -245,7 +245,9 @@ def spd_solve_pallas(A, b, panel=16, interpret=False):
     return x[:N, :r]
 
 
-_AVAILABLE = {}  # (r_pad, panel) -> bool, probed once per process
+from tpu_als.utils.platform import probe_cache as _probe_cache
+
+_AVAILABLE = _probe_cache("pallas_solve")  # (r_pad, panel) -> bool
 
 
 def available(rank=128, panel=16):

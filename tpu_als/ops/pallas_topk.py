@@ -151,7 +151,9 @@ def topk_scores_pallas(U, V, item_valid, k, tile_u=256, tile_i=512,
     return out_s[:n, :k], out_i[:n, :k]
 
 
-_AVAILABLE = {}
+from tpu_als.utils.platform import probe_cache as _probe_cache
+
+_AVAILABLE = _probe_cache("pallas_topk")
 
 
 def available(rank=128, k=10):

@@ -78,22 +78,44 @@ def chunked_topk_scores(U, V, item_valid, k, item_chunk=8192):
     return best_s, best_i
 
 
+def auto_topk_backend(rank, k):
+    """The 'auto' probe walk: the fused Pallas kernel only on TPU, only
+    for lane-sized k, and only after its compile-and-run probe passes —
+    a Mosaic regression degrades to the scan instead of crashing
+    serving.  Shared by :func:`topk_scores` and the execution planner
+    (tpu_als.plan), so the warm-cache verdict and the cold walk cannot
+    drift."""
+    from tpu_als.ops import pallas_topk
+    from tpu_als.utils.platform import on_tpu
+
+    return ("pallas" if (on_tpu() and k <= 128
+                         and pallas_topk.available(rank, k))
+            else "xla")
+
+
 def topk_scores(U, V, item_valid, k, item_chunk=8192, backend="auto"):
     """Top-k dispatch: the fused Pallas kernel on TPU (scores never touch
     HBM — tpu_als.ops.pallas_topk), the XLA scan elsewhere.
 
-    backend: 'auto' (Pallas only after its compile-and-run probe passes,
-    so a Mosaic regression degrades to the scan instead of crashing
-    serving) | 'pallas' | 'xla'.
+    backend: 'auto' (the :func:`auto_topk_backend` walk; when called
+    EAGERLY with the planner armed the verdict goes through
+    tpu_als.plan — a warm cache answers with zero probe executions —
+    while a call under an ambient jit trace skips the planner's disk
+    I/O and walks the in-process caches as before) | 'pallas' | 'xla'.
     """
-    from tpu_als.utils.platform import on_tpu
-
     if backend == "auto":
-        from tpu_als.ops import pallas_topk
+        rank = U.shape[1]
+        tracing = isinstance(U, jax.core.Tracer) \
+            or isinstance(V, jax.core.Tracer)
+        if not tracing:
+            from tpu_als import plan as _plan
 
-        backend = ("pallas" if (on_tpu() and k <= 128
-                                and pallas_topk.available(U.shape[1], k))
-                   else "xla")
+            if _plan.armed():
+                backend = _plan.resolve_topk(
+                    rank=rank, k=k,
+                    walk=lambda: auto_topk_backend(rank, k))
+        if backend == "auto":
+            backend = auto_topk_backend(rank, k)
     if backend == "pallas":
         from tpu_als.ops.pallas_topk import topk_scores_pallas
 

@@ -1,0 +1,391 @@
+"""Cost-model-driven execution planner.
+
+One resolve discipline for every dispatch decision in the stack — solve
+backend, NE build path, top-k backend, gather strategy, serving bucket
+plan, bench probe budget: **the roofline model proposes, a probe
+confirms, and the verdict persists.**
+
+Mechanics per component:
+
+- The *plan key* is (device kind, jax version, rank/dtype, shape class,
+  mesh shape) — everything a probe verdict can legitimately depend on.
+- A warm cache entry (tpu_als.plan.cache) seeds the in-process probe
+  registry (tpu_als.utils.platform) with the banked verdicts, so the
+  existing probe walks — ``core.als.resolve_solve_path``,
+  ``ops.solve.auto_solve_backend``, ``ops.topk`` — run as pure cache
+  reads: zero probe executions, and the resolved path is byte-for-byte
+  what a cold walk on the same key selects (the walk still computes the
+  verdict; the cache only supplies the probe outcomes it would have
+  measured).  ``plan_cache_hit`` is emitted, ``plan_probe`` is not —
+  the cross-process warm-start test pins exactly that trail.
+- A cold resolve emits ``plan_cache_miss``, runs the walk, emits one
+  ``plan_probe`` per newly cached kernel verdict plus one for the walk
+  itself, and banks the registry snapshot with full provenance (probe
+  timings, ``banked_at``, the roofline model's proposal next to the
+  probe's verdict).  Transient-failure verdicts are never banked
+  (platform.snapshot_probes).
+- ``TPU_ALS_PLAN_CACHE=off`` disarms everything: every consult returns
+  immediately and the dispatch sites behave exactly as before the
+  planner existed (tests pin the training-step jaxpr byte-identical).
+
+Gather strategy is the one component whose verdict is always the
+model's, never the bank's: it costs no probe, and in a multi-process
+fit every host must reach the same answer even when their caches
+disagree — a banked verdict steering collectives would be a
+distributed hang waiting to happen.  The cache entry is provenance for
+``plan show`` there, not authority.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+
+from tpu_als import obs
+from tpu_als.plan import cache as plan_cache
+
+PlanCacheCorrupt = plan_cache.PlanCacheCorrupt
+
+# tie-break preference when the comm model scores candidates equal
+GATHER_CANDIDATES = ("all_gather", "all_gather_chunked", "ring_overlap",
+                     "ring")
+
+
+def mode():
+    """``"off"`` or the active cache directory."""
+    return plan_cache.mode()
+
+
+def armed():
+    return plan_cache.mode() != "off"
+
+
+def _now():
+    return datetime.now(timezone.utc).isoformat(timespec="seconds")
+
+
+def _device_kind():
+    import jax
+
+    try:
+        d = jax.devices()[0]
+        return f"{d.platform}:{d.device_kind}"
+    except RuntimeError:
+        return "unknown"
+
+
+def shape_class(n_users=None, n_items=None, nnz=None):
+    """Coarse log2 bucketing so near-identical problem sizes share a plan
+    entry; ``"generic"`` when the resolve site has no shapes (the probe
+    verdicts themselves key on rank/dtype only)."""
+    if n_users is None and n_items is None and nnz is None:
+        return "generic"
+
+    def b(x):
+        return "?" if not x else f"2^{int(math.log2(max(1, int(x))))}"
+
+    return f"u{b(n_users)}.i{b(n_items)}.nnz{b(nnz)}"
+
+
+def plan_key(*, rank, dtype, shape_class="generic", mesh_shape=None):
+    return {
+        "device_kind": _device_kind(),
+        "jax_version": plan_cache._jax_version(),
+        "rank": int(rank),
+        "dtype": str(dtype),
+        "shape_class": shape_class,
+        "mesh_shape": list(mesh_shape) if mesh_shape else None,
+    }
+
+
+def _key_str(key):
+    mesh = key.get("mesh_shape")
+    return (f"{key['device_kind']}|jax{key['jax_version']}"
+            f"|r{key['rank']}|{key['dtype']}|{key['shape_class']}"
+            f"|mesh{'x'.join(map(str, mesh)) if mesh else '-'}")
+
+
+def _summ(resolved):
+    if isinstance(resolved, dict):
+        return str(resolved.get("resolved_solve_path", resolved))
+    return str(resolved)
+
+
+def _jsonable(x):
+    import json
+
+    return json.loads(json.dumps(x, default=str))
+
+
+def _load_or_quarantine(key):
+    """``(entry_or_None, miss_reason_or_None)`` — a corrupt entry is moved
+    to ``.corrupt/`` (never crashed on, never trusted) and reads as a
+    miss with reason ``"corrupt"`` so the walk reprobes."""
+    try:
+        return plan_cache.load_entry(key), None
+    except PlanCacheCorrupt as e:
+        qpath = plan_cache.quarantine(e.path, e.reason)
+        obs.emit("warning", what="plan_cache",
+                 reason=f"quarantined corrupt entry to {qpath}: {e.reason}")
+        return None, "corrupt"
+
+
+def _resolve_component(key, component, walk, *, model=None,
+                       use_banked=False):
+    """The shared resolve discipline.  On a cache hit the banked probe
+    verdicts are seeded and ``walk()`` re-derives the plan from them
+    (``use_banked=True`` trusts the banked resolved value instead —
+    only for configuration-like components such as the bucket ladder).
+    On a miss the walk runs cold, its probe spend is emitted, and the
+    verdict + registry snapshot are banked with provenance."""
+    from tpu_als.utils import platform
+
+    entry, reason = _load_or_quarantine(key)
+    if entry is not None and component in entry["components"]:
+        seeded = platform.seed_probes(entry.get("probes") or {})
+        obs.emit("plan_cache_hit", key=_key_str(key), component=component,
+                 path=plan_cache.entry_path(key), seeded=seeded)
+        resolved = (entry["components"][component]["resolved"]
+                    if use_banked else walk())
+        obs.emit("plan_resolved", key=_key_str(key), component=component,
+                 source="cache", resolved=_summ(resolved))
+        return resolved
+
+    obs.emit("plan_cache_miss", key=_key_str(key), component=component,
+             reason=(reason or "absent") if entry is None
+             else "component_absent")
+    before = {n: set(c) for n, c in platform.probe_caches().items()}
+    t0 = time.perf_counter()
+    resolved = walk()
+    walk_s = time.perf_counter() - t0
+    executed = []
+    for name, c in platform.probe_caches().items():
+        for k in c:
+            if k in before.get(name, ()):
+                continue
+            m = c.meta.get(k, {})
+            obs.emit("plan_probe", kernel=f"{name}:{k!r}",
+                     outcome=bool(c[k]), seconds=m.get("seconds") or 0.0)
+            executed.append(f"{name}:{k!r}")
+    obs.emit("plan_probe", kernel=f"walk:{component}",
+             outcome=_summ(resolved), seconds=walk_s)
+
+    if entry is None:
+        entry = {"schema_version": plan_cache.SCHEMA_VERSION,
+                 "plan_key": key, "probes": {}, "components": {}}
+    for name, outcomes in platform.snapshot_probes().items():
+        entry["probes"].setdefault(name, {}).update(outcomes)
+    entry["components"][component] = {
+        "resolved": _jsonable(resolved),
+        "provenance": {
+            "banked_at": _now(),
+            "walk_seconds": round(walk_s, 6),
+            "probes_executed": executed,
+            "probe_timings": _jsonable(platform.probe_timings()),
+            "model": _jsonable(model) if model is not None else None,
+        },
+    }
+    try:
+        plan_cache.store_entry(key, entry)
+    except OSError as e:
+        obs.emit("warning", what="plan_cache",
+                 reason=f"could not bank plan entry: {e}")
+    obs.emit("plan_resolved", key=_key_str(key), component=component,
+             source="probe", resolved=_summ(resolved))
+    return resolved
+
+
+# -- component resolvers (one per dispatch site) ------------------------
+
+
+def resolve_training(*, rank, compute_dtype, label, walk):
+    """Consulted by ``core.als.resolve_solve_path`` when armed.  ``walk``
+    is the legacy probe walk (``_resolve_solve_path_walk``); its return
+    dict is the verdict, warm or cold."""
+    if not armed():
+        return None
+    key = plan_key(rank=rank, dtype=compute_dtype)
+    return _resolve_component(key, f"training:{label}", walk,
+                              model=training_model(rank, compute_dtype))
+
+
+def training_model(rank, compute_dtype):
+    """The roofline proposal for the training resolve: modeled NE-build
+    HBM bytes of the gather-fused kernel vs the einsum build at the
+    timing probe's shapes (perf.roofline closed forms), plus the solve
+    preference ladder.  The probe walk confirms or overrules — both are
+    banked so ``plan show`` can display prediction vs measured."""
+    import importlib
+
+    # perf.__init__ rebinds the package attribute 'roofline' to the
+    # function, so attribute-style module imports resolve wrong here
+    rl = importlib.import_module("tpu_als.perf.roofline")
+
+    db = 2 if "bfloat16" in str(compute_dtype) else 4
+    n, w = 2048, 256                 # faster_than_einsum's probe instance
+    P = n * w
+    fused = rl.fused_ne_kernel_bytes(P, n, rank, db)
+    einsum = rl.einsum_ne_build_bytes(P, n, rank, db)
+    return {
+        "ne_bytes": {"gather_fused": fused, "einsum": einsum},
+        "ne_proposal": "gather_fused" if fused < einsum else "einsum",
+        "solve_preference": (["lanes"] if rank <= 128
+                             else ["lanes_blocked"]) + ["pallas", "xla"],
+    }
+
+
+def resolve_topk(*, rank, k, walk):
+    """Consulted by ``ops.topk.topk_scores`` (eager 'auto' dispatch) and
+    by ``plan warm``; ``walk`` is ``ops.topk.auto_topk_backend``."""
+    if not armed():
+        return None
+    key = plan_key(rank=rank, dtype="float32")
+    model = {"proposal": "pallas" if int(k) <= 128 else "xla",
+             "reason": "pallas top-k holds k<=128 in lanes; larger k "
+                       "falls back to the chunked XLA path"}
+    return _resolve_component(key, f"topk:k={int(k)}", walk, model=model)
+
+
+def gather_model(*, n_users, n_items, rank, n_devices, implicit=False):
+    """Closed-form per-device collective bytes for one full ALS iteration
+    per candidate strategy (the balanced-shard, one-row-tile case of
+    ``parallel.trainer.comm_bytes_per_iter``) and the ranked proposal."""
+    D = max(1, int(n_devices))
+    fb = 4 * int(rank)
+    ru = -(-int(n_users) // D)
+    ri = -(-int(n_items) // D)
+    ag = (D - 1) * ri * fb + (D - 1) * ru * fb
+    ring = D * ri * fb + D * ru * fb
+    psum = 4 * (D - 1) / D * rank * rank * 4 if implicit else 0
+    by = {"all_gather": ag + psum, "all_gather_chunked": ag + psum,
+          "ring_overlap": ring + psum, "ring": ring + psum}
+    proposal = min(GATHER_CANDIDATES, key=lambda s: by[s])
+    return {"comm_bytes_per_iter": by, "proposal": proposal,
+            "n_devices": D}
+
+
+def resolve_gather_strategy(*, requested="auto", n_users, n_items, rank,
+                            n_devices, implicit=False):
+    """An explicit strategy passes through untouched.  ``"auto"`` is the
+    comm model's pick — deterministic across hosts by construction (see
+    module docstring: the bank is provenance here, never authority)."""
+    if requested != "auto":
+        return requested
+    model = gather_model(n_users=n_users, n_items=n_items, rank=rank,
+                         n_devices=n_devices, implicit=implicit)
+    choice = model["proposal"]
+    if armed():
+        key = plan_key(
+            rank=rank, dtype="float32",
+            shape_class=shape_class(n_users=n_users, n_items=n_items),
+            mesh_shape=(n_devices,))
+        _resolve_component(key, f"gather:D={int(n_devices)}",
+                           walk=lambda: choice, model=model)
+    return choice
+
+
+def resolve_serving_buckets(*, rank=0, requested=None):
+    """Serving batch-bucket ladder.  Explicit buckets pass through; the
+    default consults the bank (a previously warmed/recorded ladder wins)
+    and falls back to ``serving.batcher.DEFAULT_BUCKETS``."""
+    from tpu_als.serving.batcher import DEFAULT_BUCKETS
+
+    if requested is not None:
+        return tuple(int(b) for b in requested)
+    if not armed():
+        return tuple(DEFAULT_BUCKETS)
+    key = plan_key(rank=int(rank or 0), dtype="float32")
+    model = {"proposal": list(DEFAULT_BUCKETS),
+             "reason": "geometric ladder bounds pad waste to ~4x worst "
+                       "case while keeping one executable per bucket "
+                       "(docs/serving.md)"}
+    resolved = _resolve_component(key, "serving_buckets",
+                                  walk=lambda: list(DEFAULT_BUCKETS),
+                                  model=model, use_banked=True)
+    return tuple(int(b) for b in resolved)
+
+
+def probe_budget_s(default_s):
+    """Bench probe-budget suggestion; see
+    ``plan.cache.suggested_probe_budget`` (bench.py loads that module
+    standalone to stay jax-free)."""
+    return plan_cache.suggested_probe_budget(default_s)
+
+
+def clear():
+    """Drop the on-disk entries AND the in-process probe registry (the
+    ``plan clear`` CLI verb).  Returns the number of files removed."""
+    from tpu_als.utils import platform
+
+    n = plan_cache.clear()
+    platform.clear_probe_caches()
+    return n
+
+
+# -- whole-plan assembly (CLI `plan warm` / `plan show`) ----------------
+
+
+@dataclass(frozen=True)
+class ExecutionPlan:
+    """Everything the planner decides, assembled in one place."""
+
+    key: dict
+    solve: dict | None                # resolve_solve_path verdict dict
+    topk_backend: str | None
+    gather_strategy: str | None
+    serving_buckets: tuple
+    probe_budget_s: float
+    probe_budget_reason: str
+    notes: dict = field(default_factory=dict)
+
+    def summary(self):
+        return {
+            "key": _key_str(self.key),
+            "resolved_solve_path": (self.solve or {}).get(
+                "resolved_solve_path"),
+            "topk_backend": self.topk_backend,
+            "gather_strategy": self.gather_strategy,
+            "serving_buckets": list(self.serving_buckets),
+            "probe_budget_s": self.probe_budget_s,
+            "probe_budget_reason": self.probe_budget_reason,
+        }
+
+
+def resolve_execution_plan(*, rank=128, compute_dtype="float32",
+                           solve_backend="auto", cg_iters=0,
+                           cg_mode="dense", nonnegative=False, k=10,
+                           n_users=None, n_items=None, n_devices=1,
+                           default_probe_budget_s=600.0):
+    """Resolve the full plan for one configuration — the ``plan warm``
+    entry point.  Every component goes through its real dispatch-site
+    walk (``resolve_solve_path`` consults the planner itself), so
+    warming here is exactly the resolve training/serving will perform."""
+    from tpu_als.core.als import AlsConfig, resolve_solve_path
+    from tpu_als.ops.topk import auto_topk_backend
+
+    cfg = AlsConfig(rank=int(rank), solve_backend=solve_backend,
+                    cg_iters=int(cg_iters), cg_mode=cg_mode,
+                    nonnegative=bool(nonnegative),
+                    compute_dtype=compute_dtype)
+    solve = resolve_solve_path(cfg, int(rank))
+    if armed():
+        topk = resolve_topk(rank=int(rank), k=int(k),
+                            walk=lambda: auto_topk_backend(int(rank),
+                                                           int(k)))
+    else:
+        topk = auto_topk_backend(int(rank), int(k))
+    gather = None
+    if n_devices and int(n_devices) > 1 and n_users and n_items:
+        gather = resolve_gather_strategy(
+            requested="auto", n_users=int(n_users), n_items=int(n_items),
+            rank=int(rank), n_devices=int(n_devices))
+    buckets = resolve_serving_buckets(rank=int(rank))
+    budget, why = plan_cache.suggested_probe_budget(default_probe_budget_s)
+    return ExecutionPlan(
+        key=plan_key(rank=int(rank), dtype=compute_dtype),
+        solve=solve, topk_backend=topk, gather_strategy=gather,
+        serving_buckets=buckets, probe_budget_s=budget,
+        probe_budget_reason=why,
+        notes={"mode": mode()})

@@ -102,10 +102,17 @@ class ServingEngine:
     disables the breach trigger; shed and degraded dumps stay on.
     """
 
-    def __init__(self, k=10, buckets=DEFAULT_BUCKETS, shortlist_k=64,
+    def __init__(self, k=10, buckets=None, shortlist_k=64,
                  max_queue=1024, max_wait_s=0.002,
                  default_deadline_s=None, item_chunk=8192,
                  slo_s=None, flight_capacity=64):
+        if buckets is None:
+            # bucket plan from the execution planner: a banked ladder
+            # for this device/jax key wins, else DEFAULT_BUCKETS — and
+            # with the planner off this IS DEFAULT_BUCKETS, unchanged
+            from tpu_als import plan as _plan
+
+            buckets = _plan.resolve_serving_buckets()
         self.k = int(k)
         self.shortlist_k = int(shortlist_k)
         self.item_chunk = int(item_chunk)

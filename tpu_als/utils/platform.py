@@ -13,6 +13,106 @@ _TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
                       "Socket closed", "Connection reset")
 
 
+class ProbeCache(dict):
+    """A named per-kernel probe cache: ``key -> bool`` outcome, plus a
+    ``meta`` side-table (``key -> {"seconds", "transient"}``) recording how
+    each outcome was reached.  Still a plain dict to callers —
+    :func:`probe_kernel`'s ``(cache, key, probe)`` contract is unchanged —
+    but named caches registered here are enumerable (``probe_caches``),
+    clearable for tests (``clear_probe_caches``), and bankable into the
+    persistent plan cache (``snapshot_probes`` / ``seed_probes``).
+    """
+
+    def __init__(self, name):
+        super().__init__()
+        self.name = name
+        self.meta = {}
+
+
+_PROBE_CACHES: dict = {}      # name -> ProbeCache (one registry per process)
+
+
+def probe_cache(name):
+    """The process-wide named probe cache, created on first use.  Each
+    Pallas module binds its ``_AVAILABLE`` (and timing) dict here so every
+    probe verdict in the process is reachable from one registry instead of
+    five private module globals."""
+    c = _PROBE_CACHES.get(name)
+    if c is None:
+        c = _PROBE_CACHES[name] = ProbeCache(name)
+    return c
+
+
+def probe_caches():
+    """Snapshot view of the registry: ``{name: ProbeCache}``."""
+    return dict(_PROBE_CACHES)
+
+
+def clear_probe_caches(name=None):
+    """Empty one named cache (or all of them) IN PLACE — module globals
+    keep their identity, so clearing is safe mid-process (tests, ``tpu_als
+    plan clear``)."""
+    targets = ([_PROBE_CACHES[name]] if name is not None
+               else list(_PROBE_CACHES.values()))
+    for c in targets:
+        c.clear()
+        c.meta.clear()
+
+
+def snapshot_probes():
+    """Bankable probe outcomes: ``{name: {repr(key): bool}}``.  Outcomes
+    whose meta marks them ``transient`` (False cached only because retries
+    exhausted on a flaky tunnel) are EXCLUDED — persisting those would pin
+    a healthy kernel to the slow path across processes, the exact failure
+    the retry logic exists to contain."""
+    out = {}
+    for name, c in _PROBE_CACHES.items():
+        entries = {}
+        for key, val in c.items():
+            m = c.meta.get(key, {})
+            if m.get("transient"):
+                continue
+            entries[repr(key)] = bool(val)
+        if entries:
+            out[name] = entries
+    return out
+
+
+def probe_timings():
+    """``{name: {repr(key): seconds}}`` for probes that actually executed
+    (provenance for the plan cache)."""
+    out = {}
+    for name, c in _PROBE_CACHES.items():
+        t = {repr(k): m["seconds"] for k, m in c.meta.items()
+             if m.get("seconds") is not None}
+        if t:
+            out[name] = t
+    return out
+
+
+def seed_probes(snapshot):
+    """Install banked outcomes (a :func:`snapshot_probes` payload) into the
+    registry.  In-process verdicts win — a key already probed THIS process
+    is never overwritten by a banked one.  Returns the number of keys
+    seeded."""
+    import ast
+
+    n = 0
+    for name, entries in (snapshot or {}).items():
+        cache = probe_cache(name)
+        for key_repr, val in entries.items():
+            try:
+                key = ast.literal_eval(key_repr)
+            except (ValueError, SyntaxError):
+                continue                      # unparseable key: skip, reprobe
+            if key not in cache:
+                cache[key] = bool(val)
+                cache.meta[key] = {"seconds": None, "transient": False,
+                                   "seeded": True}
+                n += 1
+    return n
+
+
 def classify_probe_error(e):
     """Classify an exception raised inside a kernel probe — the single
     classification shared by :func:`probe_kernel` and the per-kernel
@@ -83,14 +183,19 @@ def probe_kernel(cache, key, probe):
             return False
         if not on_tpu():
             cache[key] = False
+            _note_probe(cache, key, seconds=None, transient=False)
         else:
             import time
             import warnings
 
             attempts = 3
             for k in range(attempts):
+                t0 = time.perf_counter()
                 try:
                     cache[key] = bool(probe())
+                    _note_probe(cache, key,
+                                seconds=time.perf_counter() - t0,
+                                transient=False)
                     break
                 except Exception as e:
                     msg = f"{type(e).__name__}: {e}"
@@ -124,8 +229,19 @@ def probe_kernel(cache, key, probe):
                         f"preference order for this process: {msg[:200]}",
                         stacklevel=2)
                     cache[key] = False
+                    _note_probe(cache, key,
+                                seconds=time.perf_counter() - t0,
+                                transient=transient)
                     break
     return cache[key]
+
+
+def _note_probe(cache, key, *, seconds, transient):
+    """Record probe provenance on a registered :class:`ProbeCache`; plain
+    dicts (tests pass bare ``{}``) are left untouched."""
+    meta = getattr(cache, "meta", None)
+    if meta is not None:
+        meta[key] = {"seconds": seconds, "transient": bool(transient)}
 
 
 def fence(x):

@@ -199,6 +199,8 @@ def main():
         ku, kv = jax.random.split(key)
         U = jax.random.normal(ku, (nU, rank), jnp.float32)
         V = jax.random.normal(kv, (nI, rank), jnp.float32)
+        # tal: disable=bare-jit -- one jit per ablation variant is the point:
+        # each variant IS a different step function, compiled and timed once
         step = jax.jit(lambda U, V, ub, ib: step_impl(U, V, ub, ib, ab),
                        donate_argnums=(0, 1))
         t0 = time.time()

@@ -5,9 +5,12 @@
 #   1. the fast chaos matrix — every fault point exercised with at least
 #      one injected failure (tests/test_resilience.py, tier-1 subset)
 #      plus the resume/preemption suite,
-#   2. the static obs-schema check (the resilience event vocabulary —
-#      retry_attempt, fault_injected, preempted, ... — must stay
-#      declared),
+#   2. the static checks — the obs-schema shim (the resilience event
+#      vocabulary — retry_attempt, fault_injected, preempted, ... —
+#      must stay declared) plus the analysis gate
+#      (scripts/lint_smoke.sh: poisoned-jax tracer-safety lint + the
+#      jaxpr contract registry, which re-verifies guardrails_disarmed
+#      by name),
 #   3. one END-TO-END kill-and-resume train via the scenario harness
 #      (`tpu_als scenario run preempt-resume` — the ONE implementation
 #      of this flow, shared with tests/test_scenarios.py): preempt the
@@ -32,8 +35,9 @@ echo "== chaos smoke 1/4: fault-point matrix (fast tier) =="
 python -m pytest tests/test_resilience.py tests/test_resume.py \
     -q -m 'not slow' -p no:cacheprovider || fail=1
 
-echo "== chaos smoke 2/4: obs schema (static) =="
+echo "== chaos smoke 2/4: static checks (obs schema + analysis gate) =="
 python scripts/check_obs_schema.py || fail=1
+scripts/lint_smoke.sh || fail=1
 
 echo "== chaos smoke 3/4: end-to-end kill-and-resume (scenario) =="
 # the preempt-resume scenario asserts exit code 43 on the preempted

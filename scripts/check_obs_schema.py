@@ -1,294 +1,43 @@
 #!/usr/bin/env python
-"""Static schema check over observability call sites.
+"""Static schema check over observability call sites — thin shim.
 
-The registry validates metric/event names at call time
-(tpu_als.obs.schema), but a call site on a cold path — a checkpoint
-format branch, a multi-process-only event — may not execute under the
-test suite at all.  This script closes that gap statically: it greps
-every ``.counter( / .gauge( / .histogram( / .emit(`` call site (plus
-inline ``{"ts": ..., "type": "..."}`` event dicts, the shape bench.py
-builds because it must not import tpu_als before its subprocess backend
-probe) and fails when a LITERAL name is not declared in
-``tpu_als.obs.schema``, is used with the wrong kind, or when a name is
-non-literal outside ``tpu_als/obs/`` itself (a computed name defeats
-the static check — route it through a declared vocabulary instead).
+The engine lives in ``tpu_als/analysis/vocab.py`` (one registry-driven
+implementation shared with the ``tpu_als lint`` rule
+``unregistered-name``); this script keeps the historical CLI contract —
+same diagnostics, same ``--paths`` override, same exit codes and
+summary lines — so the smoke scripts and tests/test_obs.py are
+untouched.  See the engine module's docstring for what is checked and
+why; docs/analysis.md for the rule catalog.
 
-Beyond the emit sites, the pass also covers the READ side — the
-``histogram_quantile / histogram_count / counter_value`` accessors
-skip the registry's call-time schema check (they can't mint a series,
-so a typo'd name silently reads NaN/0 forever) — and the scenario
-layer's declarative ``Assertion(metric= / event= / num= / den=)``
-literals, which only meet the registry indirectly at evaluation time.
-Non-literal names are a violation for WRITE methods only; dynamic
-reads (the scenario evaluator resolving declared assertion fields) are
-allowed because their literals are validated at the declaration site.
-
-The fault-injection vocabulary gets the same treatment: every literal
-``faults.check( / .armed( / .hits("point")`` site and every scenario
-``fault_spec="..."`` declaration is validated against
-``tpu_als.resilience.faults.FAULT_POINTS`` (specs additionally through
-``parse_spec``, so trigger-grammar drift fails here too) — a typo'd
-point name is otherwise a fault that silently never fires, the exact
-cold-path gap this script exists to close.
-
-Run directly (exit 1 + file:line diagnostics on violation) or from the
-tier-1 suite (tests/test_obs.py).  ``--paths`` overrides the scanned
-tree (the negative test exercises the failure mode on a fixture file).
-
-Deliberately jax-free and import-light: only tpu_als.obs.schema and
-tpu_als.resilience.faults are imported, both stdlib-only.
+Deliberately jax-free: the engine is loaded STANDALONE by file path
+(never through the ``tpu_als`` package root, whose ``__init__`` imports
+jax), and the engine loads the schema/fault registries the same way.
+The pre-shim version of this script imported ``tpu_als.obs.schema``
+through the package and crashed with jax absent despite making the
+same claim — the linter's ``jaxfree-import`` rule and a poisoned-jax
+test (tests/test_analysis.py) now pin the contract.
 """
 
 from __future__ import annotations
 
-import argparse
+import importlib.util
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
-
-from tpu_als.obs import schema  # noqa: E402
-from tpu_als.resilience import faults  # noqa: E402
-
-# a counter/gauge/histogram/emit (write) or quantile/count/value (read
-# accessor) call with either a literal first argument (named groups
-# q/name) or anything else (group expr); longest alternatives first so
-# 'histogram_quantile' never half-matches as 'histogram'
-CALL_RE = re.compile(
-    r"\.(?P<method>histogram_quantile|histogram_count|histogram"
-    r"|counter_value|counter|gauge|emit)\(\s*"
-    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<expr>[^)\s][^),]*))")
-
-# accessor method -> the metric kind its name must be declared as; a
-# non-literal name is allowed for these (read-only: can't mint a series)
-ACCESSOR_KIND = {"histogram_quantile": "histogram",
-                 "histogram_count": "histogram",
-                 "counter_value": "counter"}
-
-# scenario-spec literals: Assertion(metric=/event=/num=/den=) bind to
-# the registry only at evaluation time — validate them where declared.
-# "$key"-prefixed values resolve from scenario config, not the schema.
-ASSERT_KW_RE = re.compile(
-    r"\b(?P<kw>metric|event|num)\s*=\s*"
-    r"(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)")
-ASSERT_DEN_RE = re.compile(r"\bden\s*=\s*\((?P<body>[^)]*)\)")
-_STR_RE = re.compile(r"['\"]([^'\"]+)['\"]")
-
-# fault-point literals: consultation sites (check/armed/hits) must name
-# a declared point; scenario fault_spec= strings (possibly implicit-
-# concat inside parens) must survive parse_spec whole
-FAULT_CALL_RE = re.compile(
-    r"\bfaults\.(?P<method>check|armed|hits)\(\s*"
-    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<expr>[^)\s][^),]*))")
-FAULT_SPEC_RE = re.compile(
-    r"\bfault_spec\s*=\s*(?P<body>\([^)]*\)|['\"][^'\"]*['\"])",
-    re.DOTALL)
-
-# inline event dicts: a line carrying both a "ts" key and a literal
-# "type" value (the hand-built shape allowed where importing tpu_als is
-# off-limits)
-INLINE_RE = re.compile(r"['\"]type['\"]\s*:\s*['\"](?P<name>\w+)['\"]")
-INLINE_TS_RE = re.compile(r"['\"]ts['\"]\s*:")
-
-DEFAULT_ROOTS = ("tpu_als", "scripts", "bench.py")
-
-# the execution planner's event vocabulary is a cross-process CONTRACT:
-# the warm-start tests assert trails like "plan_cache_hit present,
-# plan_probe absent", so a renamed/undeclared literal would silently
-# void those assertions.  Pin all four here, over and above the generic
-# call-site validation.
-PLAN_EVENTS = ("plan_resolved", "plan_probe", "plan_cache_hit",
-               "plan_cache_miss")
 
 
-def check_plan_vocabulary():
-    """The four plan_* events must be declared in the schema AND emitted
-    by tpu_als/plan/planner.py (an emit that moved elsewhere without a
-    declaration update fails the generic pass; a declaration whose emit
-    vanished fails here)."""
-    errors = []
-    for name in PLAN_EVENTS:
-        if name not in schema.EVENTS:
-            errors.append(
-                f"tpu_als/obs/schema.py: planner event {name!r} is not "
-                "declared in EVENTS (the tpu_als.plan contract pins all "
-                f"four of {', '.join(PLAN_EVENTS)})")
-    planner_py = os.path.join(REPO, "tpu_als", "plan", "planner.py")
-    if os.path.exists(planner_py):
-        with open(planner_py, encoding="utf-8") as f:
-            text = f.read()
-        for name in PLAN_EVENTS:
-            if f'"{name}"' not in text:
-                errors.append(
-                    f"tpu_als/plan/planner.py: never emits {name!r} — "
-                    "the plan_* event trail is the warm-start test "
-                    "contract (docs/planner.md)")
-    return errors
-
-
-def _py_files(paths):
-    for p in paths:
-        if os.path.isfile(p):
-            if p.endswith(".py"):
-                yield p
-        else:
-            for root, _, files in os.walk(p):
-                for name in sorted(files):
-                    if name.endswith(".py"):
-                        yield os.path.join(root, name)
-
-
-def _assertion_blocks(text):
-    """Yield (start_pos, block_text) for every ``Assertion(...)`` call,
-    matched by paren balance (good enough for our code: no parens inside
-    the string literals these blocks carry)."""
-    for m in re.finditer(r"\bAssertion\s*\(", text):
-        start = m.end() - 1
-        depth = 0
-        for i in range(start, min(len(text), start + 4000)):
-            ch = text[i]
-            if ch == "(":
-                depth += 1
-            elif ch == ")":
-                depth -= 1
-                if depth == 0:
-                    yield m.start(), text[start:i + 1]
-                    break
-
-
-def check_file(path):
-    errors = []
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    rel = os.path.relpath(path, REPO)
-    # the registry/schema themselves pass names through variables
-    in_obs = "tpu_als/obs/" in path.replace(os.sep, "/") \
-        or path.replace(os.sep, "/").endswith("scripts/check_obs_schema.py")
-
-    def line_of(pos):
-        return text.count("\n", 0, pos) + 1
-
-    for m in CALL_RE.finditer(text):
-        method, name = m.group("method"), m.group("name")
-        where = f"{rel}:{line_of(m.start())}"
-        if name is None:
-            if not in_obs and method not in ACCESSOR_KIND:
-                errors.append(
-                    f"{where}: {method}() with a non-literal name "
-                    f"({m.group('expr').strip()!r}) — the static check "
-                    "cannot validate it; use a literal declared in "
-                    "tpu_als.obs.schema")
-            continue
-        if method == "emit":
-            if name not in schema.EVENTS:
-                errors.append(
-                    f"{where}: emit of undeclared event type {name!r} "
-                    "(declare it in tpu_als.obs.schema.EVENTS)")
-        else:
-            want_kind = ACCESSOR_KIND.get(method, method)
-            decl = schema.METRICS.get(name)
-            if decl is None:
-                errors.append(
-                    f"{where}: {method} of undeclared metric {name!r} "
-                    "(declare it in tpu_als.obs.schema.METRICS)")
-            elif decl[0] != want_kind:
-                errors.append(
-                    f"{where}: metric {name!r} is declared as a "
-                    f"{decl[0]}, used as a {want_kind} ({method})")
-
-    for pos, block in _assertion_blocks(text):
-        where = f"{rel}:{line_of(pos)}"
-        for m in ASSERT_KW_RE.finditer(block):
-            kw, name = m.group("kw"), m.group("name")
-            if name.startswith("$"):     # resolved from scenario config
-                continue
-            if kw == "event":
-                if name not in schema.EVENTS:
-                    errors.append(
-                        f"{where}: Assertion(event={name!r}) names an "
-                        "undeclared event type (declare it in "
-                        "tpu_als.obs.schema.EVENTS)")
-            elif name not in schema.METRICS:
-                errors.append(
-                    f"{where}: Assertion({kw}={name!r}) names an "
-                    "undeclared metric (declare it in "
-                    "tpu_als.obs.schema.METRICS)")
-        for m in ASSERT_DEN_RE.finditer(block):
-            for name in _STR_RE.findall(m.group("body")):
-                if not name.startswith("$") \
-                        and name not in schema.METRICS:
-                    errors.append(
-                        f"{where}: Assertion(den=...) entry {name!r} is "
-                        "not a declared metric (declare it in "
-                        "tpu_als.obs.schema.METRICS)")
-
-    in_faults = in_obs or path.replace(os.sep, "/").endswith(
-        "tpu_als/resilience/faults.py")
-    for m in FAULT_CALL_RE.finditer(text) if not in_obs else ():
-        method, name = m.group("method"), m.group("name")
-        where = f"{rel}:{line_of(m.start())}"
-        if name is None:
-            if not in_faults:
-                errors.append(
-                    f"{where}: faults.{method}() with a non-literal "
-                    f"point ({m.group('expr').strip()!r}) — the static "
-                    "check cannot validate it; use a literal from "
-                    "tpu_als.resilience.faults.FAULT_POINTS")
-        elif name not in faults.FAULT_POINTS:
-            errors.append(
-                f"{where}: faults.{method} of undeclared fault point "
-                f"{name!r} (declare it in "
-                "tpu_als.resilience.faults.FAULT_POINTS)")
-
-    for m in FAULT_SPEC_RE.finditer(text) if not in_obs else ():
-        where = f"{rel}:{line_of(m.start())}"
-        spec = "".join(_STR_RE.findall(m.group("body")))
-        if not spec:
-            continue                         # non-literal: runtime checks it
-        try:
-            faults.parse_spec(spec)
-        except faults.FaultSpecError as e:
-            errors.append(f"{where}: fault_spec {spec!r} does not parse: "
-                          f"{e}")
-
-    for lineno, line in enumerate(text.splitlines(), 1):
-        if not INLINE_TS_RE.search(line):
-            continue
-        for m in INLINE_RE.finditer(line):
-            name = m.group("name")
-            if name not in schema.EVENTS:
-                errors.append(
-                    f"{rel}:{lineno}: inline event dict with undeclared "
-                    f"type {name!r} (declare it in "
-                    "tpu_als.obs.schema.EVENTS)")
-    return errors
+def _load_vocab():
+    spec = importlib.util.spec_from_file_location(
+        "_tal_vocab", os.path.join(REPO, "tpu_als", "analysis",
+                                   "vocab.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def main(argv=None):
-    ap = argparse.ArgumentParser(
-        description="statically validate observability call sites "
-                    "against tpu_als.obs.schema")
-    ap.add_argument("--paths", nargs="*", default=None,
-                    help="files/dirs to scan (default: tpu_als/, "
-                         "scripts/, bench.py under the repo root)")
-    args = ap.parse_args(argv)
-    paths = args.paths or [os.path.join(REPO, p) for p in DEFAULT_ROOTS]
-    errors = []
-    if args.paths is None:          # fixture runs scan only their files
-        errors.extend(check_plan_vocabulary())
-    nfiles = 0
-    for path in _py_files(paths):
-        nfiles += 1
-        errors.extend(check_file(path))
-    if errors:
-        print("\n".join(errors), file=sys.stderr)
-        print(f"check_obs_schema: {len(errors)} violation(s) in "
-              f"{nfiles} files", file=sys.stderr)
-        return 1
-    print(f"check_obs_schema: OK ({nfiles} files)")
-    return 0
+    return _load_vocab().main(argv)
 
 
 if __name__ == "__main__":

@@ -6,8 +6,11 @@
 #      seed-and-walk equivalence pins, the probe-registry contract,
 #      and the cross-process warm-start trail
 #      (tests/test_plan.py + tests/test_platform.py),
-#   2. the static obs-schema check (the four plan_* event literals
-#      must stay declared AND emitted — check_plan_vocabulary),
+#   2. the static checks — the obs-schema shim (the four plan_* event
+#      literals must stay declared AND emitted — check_plan_vocabulary)
+#      plus the analysis gate (scripts/lint_smoke.sh: poisoned-jax
+#      tracer-safety lint + the jaxpr contract registry, which
+#      re-verifies plan_cache_off by name),
 #   3. one END-TO-END cold-vs-warm resolve through the real CLI in a
 #      fresh cache dir: run 1 must probe and bank (plan_cache_miss +
 #      plan_probe in its trail), run 2 must resolve the SAME plan with
@@ -28,8 +31,9 @@ echo "== plan smoke 1/4: planner test tier =="
 python -m pytest tests/test_plan.py tests/test_platform.py \
     -q -m 'not slow' -p no:cacheprovider || fail=1
 
-echo "== plan smoke 2/4: obs schema (static, incl. plan_* vocabulary) =="
+echo "== plan smoke 2/4: static checks (obs schema + analysis gate) =="
 python scripts/check_obs_schema.py || fail=1
+scripts/lint_smoke.sh || fail=1
 
 echo "== plan smoke 3/4: end-to-end cold-vs-warm resolve =="
 work=$(mktemp -d)

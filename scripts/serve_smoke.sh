@@ -6,8 +6,10 @@
 #      admission queue, engine loop, serving fault points, and the
 #      topk validity mask (tests/test_serving.py + the topk/sharded
 #      companions),
-#   2. the static obs-schema check (the serving.* metric vocabulary
-#      and the serving_publish event must stay declared),
+#   2. the static checks — the obs-schema shim (the serving.* metric
+#      vocabulary and the serving_publish event must stay declared)
+#      plus the analysis gate (scripts/lint_smoke.sh: poisoned-jax
+#      tracer-safety lint + the jaxpr contract registry),
 #   3. one END-TO-END open-loop serve-bench: 5 seconds of synthetic
 #      load on CPU against a loose SLO, the result banked with
 #      banked_at provenance and sanity-checked (non-empty histograms,
@@ -27,8 +29,9 @@ echo "== serve smoke 1/4: serving test tier =="
 python -m pytest tests/test_serving.py tests/test_serve_sharded.py \
     tests/test_topk_foldin.py -q -m 'not slow' -p no:cacheprovider || fail=1
 
-echo "== serve smoke 2/4: obs schema (static) =="
+echo "== serve smoke 2/4: static checks (obs schema + analysis gate) =="
 python scripts/check_obs_schema.py || fail=1
+scripts/lint_smoke.sh || fail=1
 
 echo "== serve smoke 3/4: end-to-end open-loop serve-bench =="
 work=$(mktemp -d)

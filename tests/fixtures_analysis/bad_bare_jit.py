@@ -1,0 +1,7 @@
+"""Fixture: TAL008 — jit built inside a plain function recompiles."""
+import jax
+
+
+def scorer(x):
+    f = jax.jit(lambda y: y * 2.0)
+    return f(x)

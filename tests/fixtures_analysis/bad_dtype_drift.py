@@ -1,0 +1,8 @@
+"""Fixture: TAL005 — unconditional bf16 downcast, no dtype gate."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def shrink(x):
+    return x.astype(jnp.bfloat16) * 2.0

@@ -1,0 +1,10 @@
+"""Fixture: TAL002 — host print inside a jitted fn fires at trace only."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noisy_sum(x):
+    y = jnp.sum(x)
+    print("partial:", y)
+    return y

@@ -1,0 +1,6 @@
+"""Fixture: TAL010.  Deliberately jax-free — except it isn't."""
+import jax
+
+
+def probe():
+    return jax.__name__

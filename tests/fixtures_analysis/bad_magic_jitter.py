@@ -1,0 +1,6 @@
+"""Fixture: TAL009 — hardcoded 1e-6 jitter literal."""
+import jax.numpy as jnp
+
+
+def regularize(A, jitter=1e-6):
+    return A + jitter * jnp.eye(A.shape[-1])

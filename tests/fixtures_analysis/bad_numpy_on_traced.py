@@ -1,0 +1,11 @@
+"""Fixture: TAL006 — numpy consuming a traced array."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_norm(x):
+    y = jnp.sum(x * x)
+    return np.sqrt(y)

@@ -1,0 +1,3 @@
+"""Fixture: TAL000 — the file does not parse."""
+def broken(:
+    return

@@ -1,0 +1,12 @@
+"""Fixture: TAL012 — suppressions without a reason / of unknown rules."""
+import jax
+
+
+def scorer(x):
+    f = jax.jit(lambda y: y * 2.0)  # tal: disable=bare-jit
+    return f(x)
+
+
+def other(x):
+    # tal: disable=not-a-rule -- the rule name does not exist
+    return x

@@ -1,0 +1,11 @@
+"""Fixture: TAL001 — Python branch on a traced value in a jitted fn."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu_or_neg(x):
+    y = jnp.sum(x)
+    if y > 0:
+        return y
+    return -y

@@ -1,0 +1,6 @@
+"""Fixture: TAL007 — metric literal not declared in the obs schema."""
+from tpu_als import obs
+
+
+def report(n):
+    obs.counter("fixture.not_registered", n)

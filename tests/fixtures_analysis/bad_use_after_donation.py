@@ -1,0 +1,14 @@
+"""Fixture: TAL004 — reading a buffer after donating it."""
+import jax
+
+
+def _step_impl(U, V):
+    return U + 1.0, V + 1.0
+
+
+step = jax.jit(_step_impl, donate_argnums=(0, 1))
+
+
+def drive(U, V):
+    U2, V2 = step(U, V)
+    return U.sum() + U2.sum()

@@ -1,0 +1,12 @@
+"""Fixture: TAL003 — wall clock / host RNG baked in at trace time."""
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def stamped(x):
+    t = time.time()
+    return jnp.sum(x) + t + random.random()

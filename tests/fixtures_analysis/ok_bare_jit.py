@@ -1,0 +1,13 @@
+"""Fixture negative: module-level jit and a build-once factory."""
+import jax
+
+
+def _double(y):
+    return y * 2.0
+
+
+scorer = jax.jit(_double)
+
+
+def make_scorer(scale):
+    return jax.jit(lambda y: y * scale)

@@ -1,0 +1,10 @@
+"""Fixture negative: downcast gated on (and restoring) the input dtype."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def shrink(x):
+    orig = x.dtype
+    y = x.astype(jnp.bfloat16) * 2.0
+    return y.astype(orig)

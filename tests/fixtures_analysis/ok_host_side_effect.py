@@ -1,0 +1,10 @@
+"""Fixture negative: jax.debug.print is the sanctioned escape hatch."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noisy_sum(x):
+    y = jnp.sum(x)
+    jax.debug.print("partial: {}", y)
+    return y

@@ -1,0 +1,6 @@
+"""Fixture negative: deliberately jax-free, and actually stdlib-only."""
+import json
+
+
+def probe():
+    return json.dumps({"ok": True})

@@ -1,0 +1,8 @@
+"""Fixture negative: the jitter default is threaded, not hardcoded."""
+import jax.numpy as jnp
+
+from tpu_als.ops.solve import DEFAULT_JITTER
+
+
+def regularize(A, jitter=DEFAULT_JITTER):
+    return A + jitter * jnp.eye(A.shape[-1])

@@ -1,0 +1,13 @@
+"""Fixture negative: jnp on traced values; numpy only on host constants."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+SCALE = np.float32(2.0)
+
+
+@jax.jit
+def good_norm(x):
+    y = jnp.sum(x * x)
+    return jnp.sqrt(y) * SCALE

@@ -1,0 +1,8 @@
+"""Fixture negative: a real finding suppressed with a reason."""
+import jax
+
+
+def scorer(x):
+    # tal: disable=bare-jit -- fixture: the per-call jit IS the point
+    f = jax.jit(lambda y: y * 2.0)
+    return f(x)

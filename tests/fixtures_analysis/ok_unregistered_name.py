@@ -1,0 +1,6 @@
+"""Fixture negative: a declared counter, used with its declared kind."""
+from tpu_als import obs
+
+
+def report(n):
+    obs.counter("serve.requests", n)

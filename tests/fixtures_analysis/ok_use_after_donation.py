@@ -1,0 +1,15 @@
+"""Fixture negative: rebinding the donated names (the als.py loop)."""
+import jax
+
+
+def _step_impl(U, V):
+    return U + 1.0, V + 1.0
+
+
+step = jax.jit(_step_impl, donate_argnums=(0, 1))
+
+
+def drive(U, V):
+    last_good = (U, V)
+    U, V = step(U, V)
+    return U.sum() + V.sum(), last_good

@@ -1,0 +1,16 @@
+"""Fixture negative: clock outside the trace, jax.random inside."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noised(x, key):
+    return jnp.sum(x) + jax.random.normal(key, ())
+
+
+def timed(x, key):
+    t0 = time.perf_counter()
+    y = noised(x, key)
+    return y, time.perf_counter() - t0

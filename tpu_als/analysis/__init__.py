@@ -1,0 +1,38 @@
+"""Static analysis: tracer-safety linter + jaxpr-contract registry.
+
+Three modules, layered by what they may import:
+
+- :mod:`tpu_als.analysis.lint` — the AST linter (``tpu_als lint``).
+  Deliberately jax-free, stdlib-only, and runnable standalone
+  (``python tpu_als/analysis/lint.py``) so CI can lint before the
+  accelerator stack even resolves.
+- :mod:`tpu_als.analysis.vocab` — the obs/fault literal-vocabulary
+  engine (the one registry-driven implementation behind both the
+  linter's ``unregistered-name`` rule and the
+  ``scripts/check_obs_schema.py`` shim).  Also jax-free: it loads
+  ``tpu_als/obs/schema.py`` and ``tpu_als/resilience/faults.py`` by
+  file path, never through the package root (which imports jax).
+- :mod:`tpu_als.analysis.contracts` — the ``Contract(name, build,
+  pin)`` manifest unifying the repo's jaxpr byte-identity and
+  byte-count pins.  jax is imported lazily inside ``verify()`` so the
+  module itself stays importable everywhere.
+
+This ``__init__`` keeps imports lazy for the same reason: importing
+``tpu_als.analysis`` must not be the thing that drags jax in.
+"""
+
+from __future__ import annotations
+
+_SUBMODULES = ("contracts", "lint", "vocab")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

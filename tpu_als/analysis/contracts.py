@@ -1,0 +1,359 @@
+"""Unified jaxpr-contract registry: the repo's byte-level pins, by name.
+
+Four subsystems carry the same load-bearing discipline — a claim about
+the TRACED program, pinned byte-for-byte against the jaxpr rather than
+against the claimant's own inputs:
+
+- ``ne_audit``            — the einsum NE build materializes exactly one
+  ``Vg = V[cols]`` gather; the gather-fused build traces NO HBM gather;
+  the fused kernel's embedded CostEstimate equals the roofline's
+  ``fused_ne_kernel_bytes`` at the kernel's padded shapes.
+- ``guardrails_disarmed`` — arming the divergence sentinels must not
+  perturb the production step's traced graph (``str(jax.make_jaxpr)``
+  byte-identity, armed vs disarmed).
+- ``plan_cache_off``      — ``TPU_ALS_PLAN_CACHE=off`` vs a warm cache
+  dir resolves the byte-identical step jaxpr: the planner supplies probe
+  verdicts, never a different program.
+- ``comm_audit``          — the collective bytes the sharded step's
+  jaxpr actually moves equal ``trainer.comm_bytes_per_iter``'s closed
+  form exactly.
+
+Before this registry the four pins lived in four test files with no
+shared vocabulary; a kernel author adding a fifth had to rediscover the
+idiom each time.  Here each pin is a ``Contract(name, build, pin)``:
+``build()`` produces the traced artifact (jaxprs, byte counts),
+``pin(artifact)`` asserts the invariant and returns a one-line verdict.
+``tpu_als lint --contracts`` re-verifies all of them; ``--contract
+<name>`` re-verifies one.  The authoritative (parameter-rich) versions
+remain the provenance tests named on each contract — this registry is
+the cheap, named, CI-gated re-verification at small shapes.
+
+Import layering: this module imports only stdlib at module level; jax
+and tpu_als subsystems load lazily inside each ``build``.  Contracts
+assume a fresh process (the CLI / smoke-script invocation): process
+state they must control (guardrails mode, the plan-cache env var, probe
+caches) is saved and restored, but a caller that already armed a
+subsystem mid-process may see spurious verdicts.  ``comm_audit`` needs
+a multi-device backend — start Python with
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` on CPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+__all__ = [
+    "Contract", "ContractViolation", "Result",
+    "get", "names", "verify", "verify_all",
+]
+
+
+class ContractViolation(AssertionError):
+    """A pinned jaxpr-level invariant no longer holds."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Result:
+    name: str
+    ok: bool
+    detail: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    """One named, re-verifiable jaxpr pin.
+
+    ``build``: () -> artifact (traces the program(s), counts bytes).
+    ``pin``: artifact -> str (asserts; the returned string is the
+    human verdict).  ``provenance``: the authoritative test that owns
+    the full-strength version of this pin.
+    """
+
+    name: str
+    build: "callable"
+    pin: "callable"
+    provenance: str
+
+    def verify(self):
+        t0 = time.perf_counter()
+        try:
+            detail = self.pin(self.build())
+        except Exception as e:  # noqa: BLE001 — verdicts, not crashes
+            return Result(self.name, False,
+                          f"{type(e).__name__}: {e} [{self.provenance}]")
+        dt = time.perf_counter() - t0
+        return Result(self.name, True,
+                      f"{detail} [{dt:.1f}s; {self.provenance}]")
+
+
+def _require(cond, msg):
+    if not cond:
+        raise ContractViolation(msg)
+
+
+# -- shared tiny problem (the guardrails/plan pin shapes) -------------------
+
+def _tiny_csr(nU=60, nI=40, nnz=800, seed=0):
+    import numpy as np
+
+    from tpu_als.core.ratings import build_csr_buckets
+
+    gen = np.random.default_rng(seed)
+    u = gen.integers(0, nU, nnz)
+    i = gen.integers(0, nI, nnz)
+    r = gen.uniform(0.5, 5.0, nnz).astype(np.float32)
+    ucsr = build_csr_buckets(u, i, r, nU, min_width=4, chunk_elems=1 << 12)
+    icsr = build_csr_buckets(i, u, r, nI, min_width=4, chunk_elems=1 << 12)
+    return ucsr, icsr
+
+
+def _tiny_step_and_factors(cfg):
+    import jax
+
+    from tpu_als.core.als import init_factors, make_step
+
+    ucsr, icsr = _tiny_csr()
+    nU, nI = ucsr.num_rows, icsr.num_rows
+    ub = jax.device_put(ucsr.device_buckets())
+    ib = jax.device_put(icsr.device_buckets())
+    step = make_step(ub, ib, nU, nI, cfg,
+                     ucsr.chunk_elems, icsr.chunk_elems)
+    ku, kv = jax.random.split(jax.random.PRNGKey(cfg.seed))
+    U0 = init_factors(ku, nU, cfg.rank)
+    V0 = init_factors(kv, nI, cfg.rank)
+    return step, U0, V0, ucsr, icsr
+
+
+# -- ne_audit ---------------------------------------------------------------
+
+def _build_ne_audit():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from tpu_als.ops.pallas_gather_ne import (
+        _tiles,
+        gather_normal_eq_explicit,
+    )
+    from tpu_als.ops.solve import normal_eq_explicit
+    from tpu_als.perf.ne_audit import gather_out_bytes, pallas_cost_bytes
+    from tpu_als.perf.roofline import fused_ne_kernel_bytes
+
+    n, w, r, N = 48, 40, 24, 300           # the provenance test's shapes
+    rng = np.random.default_rng(7)
+    V = jnp.asarray(rng.normal(size=(N, r)).astype(np.float32))
+    cols = jnp.asarray(rng.integers(0, N, size=(n, w)).astype(np.int32))
+    vals = jnp.asarray(rng.normal(size=(n, w)).astype(np.float32))
+    mask = jnp.asarray((rng.random((n, w)) < 0.8).astype(np.float32))
+
+    einsum = lambda V, c, v, m: normal_eq_explicit(V[c], v, m, 0.1)
+    fused = lambda V, c, v, m: gather_normal_eq_explicit(
+        V, c, v, m, 0.1, interpret=True)
+
+    r_pad = max(128, -(-r // 128) * 128)
+    tn, wc, w_pad = _tiles(r_pad, -(-w // 8) * 8)
+    n_pad = -(-n // tn) * tn
+    return {
+        "vg_bytes": n * w * r * 4,
+        "einsum_gather": gather_out_bytes(einsum, V, cols, vals, mask),
+        "fused_gather": gather_out_bytes(fused, V, cols, vals, mask),
+        "fused_cost": pallas_cost_bytes(fused, V, cols, vals, mask),
+        "model_bytes": fused_ne_kernel_bytes(n_pad * w_pad, n_pad,
+                                             r_pad, 4),
+    }
+
+
+def _pin_ne_audit(a):
+    total, count = a["einsum_gather"]
+    _require(count == 1 and total == a["vg_bytes"],
+             f"einsum path traced {count} gather(s) writing {total} B, "
+             f"expected exactly one writing {a['vg_bytes']} B (Vg)")
+    _require(a["fused_gather"] == (0, 0),
+             f"gather-fused path traced an HBM gather: "
+             f"{a['fused_gather']} — Vg is being materialized")
+    ctotal, ccount = a["fused_cost"]
+    _require(ccount == 1 and ctotal == a["model_bytes"],
+             f"fused CostEstimate {ctotal} B != fused_ne_kernel_bytes "
+             f"{a['model_bytes']} B at padded shapes")
+    return (f"einsum gather == Vg ({a['vg_bytes']} B), fused gather-free, "
+            f"CostEstimate == model ({a['model_bytes']} B)")
+
+
+# -- guardrails_disarmed ----------------------------------------------------
+
+def _build_guardrails_disarmed():
+    import jax
+
+    from tpu_als.core.als import AlsConfig
+    from tpu_als.resilience import guardrails
+
+    step, U0, V0, _, _ = _tiny_step_and_factors(
+        AlsConfig(rank=4, max_iter=2))
+    disarmed = str(jax.make_jaxpr(step)(U0, V0))
+    with guardrails.scoped("recover"):
+        armed = str(jax.make_jaxpr(step)(U0, V0))
+    return {"disarmed": disarmed, "armed": armed}
+
+
+def _pin_guardrails_disarmed(a):
+    _require(a["disarmed"] == a["armed"],
+             "arming guardrails changed the production step's jaxpr "
+             f"({len(a['disarmed'])} vs {len(a['armed'])} chars) — the "
+             "sentinels leaked into the traced graph")
+    return f"armed == disarmed step jaxpr ({len(a['disarmed'])} chars)"
+
+
+# -- plan_cache_off ---------------------------------------------------------
+
+def _build_plan_cache_off():
+    import tempfile
+
+    from tpu_als.core.als import AlsConfig
+    from tpu_als.plan.cache import ENV_VAR
+    from tpu_als.utils import platform
+
+    import jax
+
+    cfg = AlsConfig(rank=4, max_iter=2)
+    saved = os.environ.get(ENV_VAR)
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            os.environ[ENV_VAR] = "off"
+            platform.clear_probe_caches()
+            step, U0, V0, _, _ = _tiny_step_and_factors(cfg)
+            off = str(jax.make_jaxpr(step)(U0, V0))
+
+            os.environ[ENV_VAR] = os.path.join(td, "armed")
+            platform.clear_probe_caches()
+            step, U0, V0, _, _ = _tiny_step_and_factors(cfg)
+            armed = str(jax.make_jaxpr(step)(U0, V0))
+    finally:
+        if saved is None:
+            os.environ.pop(ENV_VAR, None)
+        else:
+            os.environ[ENV_VAR] = saved
+        platform.clear_probe_caches()
+    return {"off": off, "armed": armed}
+
+
+def _pin_plan_cache_off(a):
+    _require(a["off"] == a["armed"],
+             "arming the plan cache changed the step's jaxpr "
+             f"({len(a['off'])} vs {len(a['armed'])} chars) — the "
+             "planner steered the traced program, not just the probes")
+    return f"cache-off == cache-armed step jaxpr ({len(a['off'])} chars)"
+
+
+# -- comm_audit -------------------------------------------------------------
+
+def _build_comm_audit():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_als.core.als import AlsConfig
+    from tpu_als.parallel.comm_audit import collective_bytes
+    from tpu_als.parallel.data import partition_balanced, shard_csr
+    from tpu_als.parallel.mesh import AXIS, make_mesh
+    from tpu_als.parallel.trainer import (
+        comm_bytes_per_iter,
+        make_sharded_step,
+    )
+
+    D = len(jax.devices())
+    if D < 2:
+        raise ContractViolation(
+            "comm_audit needs a multi-device backend; start Python with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 on CPU")
+    rank = 8
+    gen = np.random.default_rng(3)
+    nU, nI, nnz = 60, 40, 900
+    u = gen.integers(0, nU, nnz)
+    i = gen.integers(0, nI, nnz)
+    r = np.abs(gen.normal(size=nnz)).astype(np.float32) + 0.1
+    upart = partition_balanced(np.bincount(u, minlength=nU), D)
+    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
+    ush = shard_csr(upart, ipart, u, i, r, min_width=4)
+    ish = shard_csr(ipart, upart, i, u, r, min_width=4)
+    mesh = make_mesh(D)
+    leading = NamedSharding(mesh, P(AXIS))
+    U = jax.device_put(
+        jnp.zeros((upart.padded_rows, rank), jnp.float32), leading)
+    V = jax.device_put(
+        jnp.zeros((ipart.padded_rows, rank), jnp.float32), leading)
+    ub = jax.device_put(ush.device_buckets(), leading)
+    ib = jax.device_put(ish.device_buckets(), leading)
+    cfg = AlsConfig(rank=rank, max_iter=1, reg_param=0.1,
+                    implicit_prefs=True, alpha=4.0, seed=0)
+    step = make_sharded_step(mesh, ush, ish, cfg)
+    traced, breakdown = collective_bytes(step, U, V, ub, ib, axis_size=D)
+    model = comm_bytes_per_iter("all_gather", upart, ipart, rank,
+                                user_container=ush, item_container=ish,
+                                implicit=True)
+    return {"traced": traced, "model": model, "breakdown": breakdown,
+            "devices": D}
+
+
+def _pin_comm_audit(a):
+    _require(a["breakdown"].get("all_gather")
+             and a["breakdown"].get("psum"),
+             f"expected all_gather+psum collectives, traced "
+             f"{sorted(a['breakdown'])}")
+    _require(a["traced"] == a["model"],
+             f"traced collective bytes {a['traced']} != "
+             f"comm_bytes_per_iter model {a['model']} "
+             f"(breakdown {a['breakdown']})")
+    return (f"traced == modeled collective bytes ({a['traced']} B/device "
+            f"across {a['devices']} devices)")
+
+
+# -- registry ---------------------------------------------------------------
+
+_REGISTRY = {
+    c.name: c for c in (
+        Contract("ne_audit", _build_ne_audit, _pin_ne_audit,
+                 "tests/test_ne_audit.py, PR 6"),
+        Contract("guardrails_disarmed", _build_guardrails_disarmed,
+                 _pin_guardrails_disarmed,
+                 "tests/test_guardrails.py::"
+                 "test_disarmed_step_jaxpr_is_byte_identical, PR 8"),
+        Contract("plan_cache_off", _build_plan_cache_off,
+                 _pin_plan_cache_off,
+                 "tests/test_plan.py::"
+                 "test_planner_off_training_step_jaxpr_byte_identical, "
+                 "PR 9"),
+        Contract("comm_audit", _build_comm_audit, _pin_comm_audit,
+                 "tests/test_comm_audit.py, PR 6"),
+    )
+}
+
+
+def names():
+    return tuple(_REGISTRY)
+
+
+def get(name):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"no contract named {name!r}; registered: "
+            f"{', '.join(_REGISTRY)}") from None
+
+
+def verify(name):
+    return get(name).verify()
+
+
+def verify_all(only=None):
+    """Verify every registered contract (or the named subset), in
+    registration order.  Unknown names in ``only`` are skipped here —
+    the CLI reports them — so the return covers exactly the contracts
+    that ran."""
+    picked = [c for n, c in _REGISTRY.items()
+              if only is None or n in set(only)]
+    return [c.verify() for c in picked]

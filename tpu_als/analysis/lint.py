@@ -1,0 +1,989 @@
+#!/usr/bin/env python
+"""Tracer-safety linter: the JAX/Pallas pitfalls that live in reviewer
+memory, mechanized as ~a dozen named AST rules.
+
+Why a bespoke linter: the invariants that keep six Pallas kernels and
+the donation-based trainer step correct — no Python branching on traced
+values, no host side effects or wall-clock/RNG at trace time, no reads
+of donated buffers, no hardcoded precision downcasts, no literal names
+bypassing the obs/fault registries, no per-call ``jax.jit`` that dodges
+the PR 9 planner — are invisible to generic linters because they are
+*tracing* semantics, not Python semantics.  PR 8's Monitor had to learn
+the donated-snapshot rule from a real corruption; every future kernel
+should inherit these checks for free instead (ROADMAP items 1–2).
+
+Rules (slug = what you put in a suppression)::
+
+    TAL000 parse-error           file does not parse
+    TAL001 tracer-branch         if/while/assert on a traced value in traced code
+    TAL002 host-side-effect      print/open/file I/O inside traced code
+    TAL003 wallclock-rng         time.* / random.* / np.random / datetime in traced code
+    TAL004 use-after-donation    read of a donated buffer after the donating call
+    TAL005 dtype-drift           hardcoded low-precision downcast without a dtype gate
+    TAL006 numpy-on-traced       np.* call on a traced array
+    TAL007 unregistered-name     obs/fault literal bypassing the schema registries
+    TAL008 bare-jit              jax.jit built per call inside a plain function body
+    TAL009 magic-jitter          hardcoded 1e-6 jitter escaping DEFAULT_JITTER threading
+    TAL010 jaxfree-import        'Deliberately jax-free' module imports jax / tpu_als
+    TAL011 timer-brackets-span   perf_counter window brackets an obs.span enter/exit
+    TAL012 bad-suppression       'tal: disable' without a reason / unknown rule
+
+Suppression syntax (reason is MANDATORY — a suppression is a reviewed
+decision, not an escape hatch)::
+
+    something_flagged()  # tal: disable=bare-jit -- built once per fit, cached on self
+
+A suppression comment on its own line applies to the next line.  The
+checked-in ``lint_baseline.txt`` holds repo-wide accepted findings
+(``path :: rule :: message`` per line) and is kept EMPTY by policy:
+pre-existing findings get fixed or individually suppressed with a
+reason at the site, so every new finding is a hard failure.
+
+Deliberately jax-free and stdlib-only: runnable standalone
+(``python tpu_als/analysis/lint.py``) without jax installed, and proven
+so by a poisoned-jax subprocess test — the same discipline
+tests/test_regress.py applies to the bench gate.  The sibling
+``vocab.py`` engine (rule unregistered-name) is loaded by FILE PATH,
+never through the ``tpu_als`` package root, whose ``__init__`` imports
+jax.  ``--contracts`` is the one jax doorway: it imports
+:mod:`tpu_als.analysis.contracts` and re-verifies the jaxpr pins.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import importlib.util
+import os
+import re
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+# tpu_als/analysis/lint.py -> repo root
+REPO = os.path.dirname(os.path.dirname(HERE))
+
+RULES = {
+    "parse-error": ("TAL000", "file does not parse"),
+    "tracer-branch": (
+        "TAL001",
+        "Python if/while/assert on a traced value inside traced code — "
+        "trace-time freeze of one branch; use lax.cond/lax.select/pl.when"),
+    "host-side-effect": (
+        "TAL002",
+        "host side effect inside traced code runs at trace time only "
+        "(and never again from the compiled step); use jax.debug.print "
+        "or a callback"),
+    "wallclock-rng": (
+        "TAL003",
+        "wall-clock / host RNG inside traced code is baked in at trace "
+        "time; fence outside the jit or use jax.random"),
+    "use-after-donation": (
+        "TAL004",
+        "read of a buffer after it was donated to a jitted call — the "
+        "backing memory is invalid; snapshot before the call (the PR 8 "
+        "Monitor rule)"),
+    "dtype-drift": (
+        "TAL005",
+        "hardcoded low-precision downcast with no dtype gate — restore "
+        "the saved input dtype instead (ops/solve.py solve_spd gate)"),
+    "numpy-on-traced": (
+        "TAL006",
+        "np.* call on a traced array forces a host round-trip or a "
+        "trace error; use jnp"),
+    "unregistered-name": (
+        "TAL007",
+        "obs metric/event/fault-point literal bypassing the schema "
+        "registries"),
+    "bare-jit": (
+        "TAL008",
+        "jax.jit built inside a plain function body recompiles per "
+        "call; hoist to module scope, cache it, or route the dispatch "
+        "decision through tpu_als.plan"),
+    "magic-jitter": (
+        "TAL009",
+        "hardcoded 1e-6 jitter literal — thread "
+        "tpu_als.ops.solve.DEFAULT_JITTER / AlsConfig.jitter instead"),
+    "jaxfree-import": (
+        "TAL010",
+        "module declared 'Deliberately jax-free' imports jax or the "
+        "tpu_als package (tpu_als/__init__ imports jax); load "
+        "registries standalone by file path"),
+    "timer-brackets-span": (
+        "TAL011",
+        "perf_counter window brackets an obs.span enter/exit, so span "
+        "emission (JSONL writes) pollutes the measurement; start the "
+        "clock inside the span"),
+    "bad-suppression": (
+        "TAL012",
+        "'tal: disable' comment without a '-- reason' or naming an "
+        "unknown rule"),
+}
+
+DEFAULT_ROOTS = ("tpu_als", "scripts", "bench.py")
+BASELINE_DEFAULT = os.path.join(REPO, "lint_baseline.txt")
+
+# jnp/np helpers whose results are static host values (safe to branch
+# on) or dtype objects — calling them does NOT make a value traced
+_LAUNDER_CALLS = {
+    "issubdtype", "dtype", "result_type", "promote_types", "iinfo",
+    "finfo", "shape", "ndim", "isdtype", "can_cast",
+    # dtype constructors on static config values
+    "float32", "float64", "float16", "bfloat16", "int8", "int16",
+    "int32", "int64", "uint8", "uint32", "uint64", "bool_",
+}
+# attribute reads that launder taint (static metadata of an array)
+_LAUNDER_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+# method calls that pull a host value off a traced array on purpose
+_LAUNDER_METHODS = {"item", "tolist"}
+
+# call targets (resolved, dotted) that trace their function arguments
+_TRACER_SUFFIXES = ("pallas_call", "shard_map")
+_JAX_TRACERS = {
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.lax.scan", "jax.lax.map",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.associative_scan", "jax.custom_vjp",
+    "jax.custom_jvp",
+}
+
+_HOST_EFFECT_BUILTINS = {"print", "open", "input", "breakpoint"}
+_HOST_EFFECT_MODULES = ("os.remove", "os.rename", "os.makedirs",
+                        "shutil.", "sys.stdout", "sys.stderr",
+                        "logging.")
+_WALLCLOCK_MODULES = ("time.", "random.", "datetime.", "secrets.",
+                      "uuid.", "numpy.random.")
+# debug/callback escape hatches that are legitimate inside traced code
+_TRACED_OK_CALLS = ("jax.debug.", "jax.experimental.io_callback",
+                    "jax.pure_callback", "jax.experimental.pallas.debug_print")
+
+_JAXFREE_CLAIM_RE = re.compile(
+    r"(?i)\bdeliberately\s+(?:stdlib-only\s+and\s+)?jax[-\s]free\b")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tal:\s*disable=(?P<rules>[A-Za-z0-9_,\-]+)"
+    r"(?P<sep>\s*--\s*)?(?P<reason>.*)?$")
+
+
+class Finding:
+    __slots__ = ("path", "line", "rule", "msg")
+
+    def __init__(self, path, line, rule, msg):
+        self.path, self.line, self.rule, self.msg = path, line, rule, msg
+
+    @property
+    def key(self):
+        return f"{self.path} :: {self.rule} :: {self.msg}"
+
+    def render(self):
+        tal = RULES[self.rule][0]
+        return f"{self.path}:{self.line}: {self.rule} [{tal}]: {self.msg}"
+
+
+def _dotted(node, aliases):
+    """Resolve an Attribute/Name chain to a dotted path with import
+    aliases expanded ('jnp.linalg.cholesky' -> 'jax.numpy.linalg.
+    cholesky'); None for anything not a plain chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+def _const_names(node):
+    """static_argnames value -> set of names."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    return set()
+
+
+def _const_ints(node):
+    """donate_argnums value -> tuple of ints."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    return ()
+
+
+def _jit_call_info(call, aliases):
+    """If ``call`` is ``jax.jit(...)`` or ``functools.partial(jax.jit,
+    ...)``, return (inner_fn_node_or_None, donate, static); else None."""
+    f = _dotted(call.func, aliases)
+    inner = None
+    if f == "jax.jit":
+        inner = call.args[0] if call.args else None
+    elif f in ("functools.partial", "partial") and call.args \
+            and _dotted(call.args[0], aliases) == "jax.jit":
+        inner = call.args[1] if len(call.args) > 1 else None
+    else:
+        return None
+    donate, static = (), set()
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            donate = _const_ints(kw.value)
+        elif kw.arg in ("static_argnames",):
+            static = _const_names(kw.value)
+        elif kw.arg in ("static_argnums",):
+            static = set(_const_ints(kw.value))
+    return inner, donate, static
+
+
+class _ModuleIndex:
+    """One parsed module: alias map, function table, traced set,
+    donating-callable table."""
+
+    def __init__(self, tree):
+        self.tree = tree
+        self.aliases = {}
+        self.functions = {}          # simple name -> FunctionDef node
+        self.parents = {}            # id(node) -> parent node
+        self.traced = {}             # id(FunctionDef) -> reason str
+        self.donating = {}           # callable name -> donated arg positions
+        self.jit_aliases = {}        # name -> (donate, static) partial aliases
+        self._index()
+
+    def _index(self):
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+        # module-level partial(jax.jit, ...) aliases (the als.py
+        # ``_step_jit`` idiom)
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                info = _jit_call_info(node.value, self.aliases)
+                if info is not None and info[0] is None:
+                    self.jit_aliases[node.targets[0].id] = \
+                        (info[1], info[2])
+        self._mark_traced()
+
+    def _mark(self, fn_node, reason, donate=(), name=None):
+        if id(fn_node) not in self.traced:
+            self.traced[id(fn_node)] = reason
+        if donate and name:
+            self.donating[name] = donate
+
+    def _mark_traced(self):
+        # 1. decorators
+        for fn in self.functions.values():
+            for dec in fn.decorator_list:
+                if isinstance(dec, ast.Call):
+                    info = _jit_call_info(dec, self.aliases)
+                    if info is not None:
+                        self._mark(fn, "jit-decorated", info[1], fn.name)
+                        continue
+                    d = _dotted(dec.func, self.aliases)
+                else:
+                    d = _dotted(dec, self.aliases)
+                if d == "jax.jit":
+                    self._mark(fn, "jit-decorated", (), fn.name)
+                elif d is not None and d in self.jit_aliases:
+                    donate, _ = self.jit_aliases[d]
+                    self._mark(fn, "jit-decorated", donate, fn.name)
+        # 2. call sites: jax.jit(f, ...) and tracing consumers
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            info = _jit_call_info(node, self.aliases)
+            if info is not None:
+                inner, donate, _ = info
+                if isinstance(inner, ast.Name) \
+                        and inner.id in self.functions:
+                    target = None
+                    parent = self.parents.get(id(node))
+                    if isinstance(parent, ast.Assign) \
+                            and len(parent.targets) == 1 \
+                            and isinstance(parent.targets[0], ast.Name):
+                        target = parent.targets[0].id
+                    self._mark(self.functions[inner.id], "jit-wrapped",
+                               donate, target or inner.id)
+                elif isinstance(inner, ast.Lambda):
+                    pass          # no statements to lint in a lambda
+                continue
+            d = _dotted(node.func, self.aliases)
+            if d is None:
+                continue
+            if d in _JAX_TRACERS or d.endswith(_TRACER_SUFFIXES):
+                why = "pallas kernel" if d.endswith("pallas_call") \
+                    else f"passed to {d.rsplit('.', 1)[-1]}"
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) \
+                            and arg.id in self.functions:
+                        self._mark(self.functions[arg.id], why)
+        # 3. propagate: nested defs + same-module callees of traced fns
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions.values():
+                if id(fn) not in self.traced:
+                    continue
+                for node in ast.walk(fn):
+                    called = None
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and node is not fn:
+                        if id(node) not in self.traced:
+                            self.traced[id(node)] = "nested in traced"
+                            changed = True
+                        continue
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Name):
+                        called = node.func.id
+                    if called and called in self.functions \
+                            and id(self.functions[called]) \
+                            not in self.traced:
+                        self.traced[id(self.functions[called])] = \
+                            f"called from traced code"
+                        changed = True
+
+
+class _Taint:
+    """Array-taint for one traced function: a name is tainted when it
+    was produced by a jax/jnp/lax call (or arithmetic/indexing on a
+    tainted value).  Plain parameters are deliberately NOT tainted —
+    branching on a static config param is the normal idiom; the bug this
+    catches is branching on something the trace just computed."""
+
+    def __init__(self, fn, aliases):
+        self.aliases = aliases
+        self.names = set()
+        assigns = [n for n in ast.walk(fn)
+                   if isinstance(n, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign, ast.For))]
+        for _ in range(4):                       # tiny fixpoint
+            before = len(self.names)
+            for node in assigns:
+                if isinstance(node, ast.For):
+                    if self.tainted(node.iter):
+                        self._add_targets(node.target)
+                    continue
+                value = node.value
+                if value is not None and self.tainted(value):
+                    tgt = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in tgt:
+                        self._add_targets(t)
+            if len(self.names) == before:
+                break
+
+    def _add_targets(self, t):
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._add_targets(e)
+
+    def tainted(self, node):
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _LAUNDER_ATTRS:
+                return False
+            return self.tainted(node.value)
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func, self.aliases)
+            if d is not None:
+                leaf = d.rsplit(".", 1)[-1]
+                if leaf in _LAUNDER_CALLS or leaf in _LAUNDER_METHODS:
+                    return False
+                if d.startswith(("jax.numpy.", "jax.lax.", "jax.nn.",
+                                 "jax.scipy.", "jax.random.",
+                                 "jax.image.")):
+                    return True
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr not in _LAUNDER_METHODS \
+                    and self.tainted(node.func.value):
+                return True                       # method on tainted
+            return any(self.tainted(a) for a in node.args)
+        if isinstance(node, ast.BinOp):
+            return self.tainted(node.left) or self.tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.tainted(node.left) \
+                or any(self.tainted(c) for c in node.comparators)
+        if isinstance(node, ast.Subscript):
+            return self.tainted(node.value)
+        if isinstance(node, ast.IfExp):
+            return self.tainted(node.body) or self.tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.tainted(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.tainted(node.value)
+        return False
+
+
+def _walk_own(fn):
+    """Yield every AST node in ``fn``'s body WITHOUT descending into
+    nested function definitions (those are linted as their own traced
+    functions, so descending would double-report)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class FileLinter:
+    def __init__(self, path, repo=REPO, vocab=None):
+        self.path = path
+        self.rel = os.path.relpath(path, repo).replace(os.sep, "/")
+        self.repo = repo
+        self.vocab = vocab
+        self.findings = []
+        with open(path, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+
+    def add(self, line, rule, msg):
+        self.findings.append(Finding(self.rel, line, rule, msg))
+
+    # -- suppression comments ------------------------------------------
+    def _suppressions(self):
+        """Map line -> set(rule slugs) from ``# tal: disable=`` comments;
+        malformed comments become bad-suppression findings."""
+        by_line = {}
+        for i, raw in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")
+                     if r.strip()}
+            reason = (m.group("reason") or "").strip()
+            if not m.group("sep") or not reason:
+                self.add(i, "bad-suppression",
+                         "suppression without a reason — write "
+                         "'# tal: disable=<rule> -- <why this is ok>'")
+                continue
+            unknown = sorted(r for r in rules if r not in RULES)
+            if unknown:
+                self.add(i, "bad-suppression",
+                         f"unknown rule(s) {', '.join(unknown)} in "
+                         "suppression (see tpu_als lint --rules)")
+                rules -= set(unknown)
+            target = i
+            if raw.lstrip().startswith("#"):
+                # own-line comment: applies to the next code line
+                # (skipping blank/comment continuation lines)
+                target = i + 1
+                while target <= len(self.lines) and (
+                        not self.lines[target - 1].strip()
+                        or self.lines[target - 1].lstrip()
+                        .startswith("#")):
+                    target += 1
+            by_line.setdefault(target, set()).update(rules)
+        return by_line
+
+    # -- the rules -----------------------------------------------------
+    def run(self):
+        suppressions = self._suppressions()
+        try:
+            tree = ast.parse(self.text)
+        except SyntaxError as e:
+            self.add(e.lineno or 1, "parse-error", str(e.msg))
+            return self.findings
+        idx = _ModuleIndex(tree)
+
+        self._rule_jaxfree_import(tree, idx)
+        self._rule_magic_jitter(tree, idx)
+        self._rule_bare_jit(tree, idx)
+        self._rule_timer_brackets_span(tree, idx)
+        self._rule_use_after_donation(tree, idx)
+        for fn in idx.functions.values():
+            if id(fn) in idx.traced:
+                self._traced_rules(fn, idx)
+        if self.vocab is not None:
+            for lineno, msg in self.vocab.check_file(self.path,
+                                                     self.repo):
+                prefix = f"{os.path.relpath(self.path, self.repo)}:{lineno}: "
+                if msg.startswith(prefix):
+                    msg = msg[len(prefix):]
+                self.add(lineno, "unregistered-name", msg)
+
+        kept = []
+        for f in self.findings:
+            if f.rule != "bad-suppression" \
+                    and f.rule in suppressions.get(f.line, ()):
+                continue
+            kept.append(f)
+        self.findings = kept
+        return self.findings
+
+    def _rule_jaxfree_import(self, tree, idx):
+        head = self.text[:4000]
+        if not _JAXFREE_CLAIM_RE.search(head):
+            return
+        for node in tree.body:
+            mods = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    mods = ["." + (node.module or "")]
+                elif node.module:
+                    mods = [node.module]
+            for mod in mods:
+                if mod.split(".")[0] in ("jax", "tpu_als") \
+                        or mod.startswith("."):
+                    self.add(
+                        node.lineno, "jaxfree-import",
+                        f"module declares itself jax-free but imports "
+                        f"{mod!r} at module level — importing any "
+                        "tpu_als submodule executes tpu_als/__init__, "
+                        "which imports jax; load the registry "
+                        "standalone by file path instead "
+                        "(scripts/bench_gate.sh idiom)")
+
+    def _rule_magic_jitter(self, tree, idx):
+        def is_magic(node):
+            return isinstance(node, ast.Constant) \
+                and node.value == 1e-6 and isinstance(node.value, float)
+
+        def mentions_jitter(node):
+            return (isinstance(node, ast.Name) and "jitter" in node.id) \
+                or (isinstance(node, ast.Attribute)
+                    and "jitter" in node.attr)
+
+        msg = ("hardcoded 1e-6 jitter — use tpu_als.ops.solve."
+               "DEFAULT_JITTER (or thread AlsConfig.jitter) so the one "
+               "regularization knob stays one knob")
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                pos_named = args.posonlyargs + args.args
+                for a, d in zip(pos_named[len(pos_named)
+                                          - len(args.defaults):],
+                                args.defaults):
+                    if a.arg == "jitter" and is_magic(d):
+                        self.add(d.lineno, "magic-jitter", msg)
+                for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                    if d is not None and a.arg == "jitter" \
+                            and is_magic(d):
+                        self.add(d.lineno, "magic-jitter", msg)
+            elif isinstance(node, ast.keyword):
+                if node.arg == "jitter" and is_magic(node.value):
+                    self.add(node.value.lineno, "magic-jitter", msg)
+            elif isinstance(node, ast.AnnAssign):
+                # dataclass field: ``jitter: float = 1e-6``
+                if isinstance(node.target, ast.Name) \
+                        and "jitter" in node.target.id \
+                        and node.value is not None \
+                        and is_magic(node.value):
+                    self.add(node.lineno, "magic-jitter", msg)
+            elif isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                if any(is_magic(s) for s in sides) \
+                        and any(mentions_jitter(s) for s in sides):
+                    self.add(node.lineno, "magic-jitter", msg)
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Mult):
+                for side, other in ((node.left, node.right),
+                                    (node.right, node.left)):
+                    if is_magic(side) and isinstance(other, ast.Call):
+                        d = _dotted(other.func, idx.aliases) or ""
+                        if d.rsplit(".", 1)[-1] == "eye":
+                            self.add(node.lineno, "magic-jitter", msg)
+
+    def _rule_bare_jit(self, tree, idx):
+        decorator_ids = {id(n) for f in idx.functions.values()
+                         for d in f.decorator_list
+                         for n in ast.walk(d)}
+        for fn in idx.functions.values():
+            # build-once factories are the sanctioned idiom: the jit
+            # happens once per construction, not per call
+            if re.match(r"^_?(make|build|get)(_|$)", fn.name):
+                continue
+            if any(isinstance(s, ast.Global) for s in _walk_own(fn)):
+                continue          # memoized module-global builder
+            for node in _walk_own(fn):
+                if id(node) in decorator_ids:
+                    continue
+                if isinstance(node, ast.Call) \
+                        and _dotted(node.func, idx.aliases) == "jax.jit":
+                    self.add(node.lineno, "bare-jit",
+                             "jax.jit inside a function body compiles "
+                             "per call — hoist to module scope, cache "
+                             "in a module global, or resolve the "
+                             "dispatch through tpu_als.plan")
+
+    def _rule_timer_brackets_span(self, tree, idx):
+        for fn in idx.functions.values():
+            body_blocks = [fn.body]
+            for node in ast.walk(fn):
+                for field in ("body", "orelse", "finalbody"):
+                    blk = getattr(node, field, None)
+                    if isinstance(blk, list) and blk and node is not fn:
+                        body_blocks.append(blk)
+            for block in body_blocks:
+                for prev, nxt in zip(block, block[1:]):
+                    if not (isinstance(prev, ast.Assign)
+                            and isinstance(prev.value, ast.Call)):
+                        continue
+                    d = _dotted(prev.value.func, idx.aliases) or ""
+                    if not d.endswith(("perf_counter", "monotonic",
+                                       "time.time")):
+                        continue
+                    if isinstance(nxt, ast.With) and any(
+                            isinstance(item.context_expr, ast.Call)
+                            and isinstance(item.context_expr.func,
+                                           ast.Attribute)
+                            and item.context_expr.func.attr == "span"
+                            for item in nxt.items):
+                        self.add(
+                            prev.lineno, "timer-brackets-span",
+                            "stage clock started before the obs.span "
+                            "enter (and read after its exit) — the "
+                            "span's own event emission lands in the "
+                            "measured interval; move the perf_counter "
+                            "read inside the span body")
+
+    def _rule_use_after_donation(self, tree, idx):
+        if not idx.donating:
+            return
+
+        def stores_of(stmt):
+            out = set()
+            tgts = []
+            if isinstance(stmt, ast.Assign):
+                tgts = stmt.targets
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign,
+                                   ast.For)):
+                tgts = [stmt.target]
+            elif isinstance(stmt, ast.With):
+                tgts = [i.optional_vars for i in stmt.items
+                        if i.optional_vars is not None]
+            for t in tgts:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+            return out
+
+        def donated_in(stmt):
+            out = []
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id in idx.donating:
+                    for pos in idx.donating[node.func.id]:
+                        if pos < len(node.args) \
+                                and isinstance(node.args[pos], ast.Name):
+                            out.append((node.args[pos].id,
+                                        node.func.id, node.lineno))
+            return out
+
+        def check_loads(node, track):
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) \
+                        and isinstance(n.ctx, ast.Load) \
+                        and n.id in track:
+                    callee, at = track[n.id]
+                    self.add(
+                        n.lineno, "use-after-donation",
+                        f"{n.id!r} was donated to {callee}() at "
+                        f"line {at} — its buffer is gone; snapshot "
+                        "before the donating call")
+                    del track[n.id]              # report once per name
+
+        def scan(block, track):
+            for stmt in block:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                compound = isinstance(
+                    stmt, (ast.If, ast.For, ast.While, ast.With,
+                           ast.Try, ast.AsyncWith, ast.AsyncFor))
+                if compound:
+                    # header expressions only; the sub-blocks are
+                    # scanned statement-by-statement below
+                    for h in ([stmt.test] if hasattr(stmt, "test")
+                              else [stmt.iter] if hasattr(stmt, "iter")
+                              else [i.context_expr
+                                    for i in getattr(stmt, "items", [])]):
+                        check_loads(h, track)
+                    for s in stores_of(stmt):
+                        track.pop(s, None)
+                    for field in ("body", "orelse", "finalbody"):
+                        sub = getattr(stmt, field, None)
+                        if sub:
+                            scan(sub, track)
+                    for h in getattr(stmt, "handlers", []) or []:
+                        scan(h.body, track)
+                    continue
+                check_loads(stmt, track)
+                stores = stores_of(stmt)
+                for name, callee, at in donated_in(stmt):
+                    if name not in stores:
+                        track[name] = (callee, at)
+                for s in stores:
+                    track.pop(s, None)
+
+        for fn in idx.functions.values():
+            scan(fn.body, {})
+
+    def _traced_rules(self, fn, idx):
+        taint = _Taint(fn, idx.aliases)
+        kernel = "pallas" in (idx.traced.get(id(fn)) or "")
+        for node in _walk_own(fn):
+            if isinstance(node, (ast.If, ast.While)) \
+                    and taint.tainted(node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                fix = "pl.when / jnp.where" if kernel \
+                    else "lax.cond / lax.while_loop / jnp.where"
+                self.add(node.lineno, "tracer-branch",
+                         f"Python `{kind}` on a traced value in traced "
+                         f"function {fn.name!r} — the branch freezes at "
+                         f"trace time (or raises); use {fix}")
+            elif isinstance(node, ast.Assert) \
+                    and taint.tainted(node.test):
+                self.add(node.lineno, "tracer-branch",
+                         f"`assert` on a traced value in traced "
+                         f"function {fn.name!r} — raises a tracer "
+                         "error; use checkify or assert static "
+                         "metadata (shapes/dtypes) instead")
+            elif isinstance(node, ast.IfExp) \
+                    and taint.tainted(node.test):
+                self.add(node.lineno, "tracer-branch",
+                         f"conditional expression on a traced value "
+                         f"in traced function {fn.name!r}; use "
+                         "jnp.where / lax.select")
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func, idx.aliases)
+            if d is None:
+                continue
+            if d.startswith(_TRACED_OK_CALLS):
+                continue
+            if d in _HOST_EFFECT_BUILTINS \
+                    or d.startswith(_HOST_EFFECT_MODULES):
+                self.add(node.lineno, "host-side-effect",
+                         f"{d}() inside traced function "
+                         f"{fn.name!r} runs at trace time only — "
+                         "it will not fire per step; use "
+                         "jax.debug.print / pl.debug_print / a "
+                         "callback")
+            elif d.startswith(_WALLCLOCK_MODULES):
+                self.add(node.lineno, "wallclock-rng",
+                         f"{d}() inside traced function "
+                         f"{fn.name!r} is evaluated once at trace "
+                         "time and baked into the jaxpr; move it "
+                         "outside the traced region (or use "
+                         "jax.random for randomness)")
+            elif (d.startswith("numpy.")
+                  and d.rsplit(".", 1)[-1] not in _LAUNDER_CALLS
+                  and any(taint.tainted(a) for a in node.args)):
+                self.add(node.lineno, "numpy-on-traced",
+                         f"{d}() applied to a traced value in "
+                         f"{fn.name!r} — numpy can't consume "
+                         "tracers (ConcretizationTypeError) and "
+                         "silently constant-folds otherwise; use "
+                         "the jnp equivalent")
+        self._rule_dtype_drift(fn, idx)
+
+    def _rule_dtype_drift(self, fn, idx):
+        consults_dtype = any(
+            isinstance(n, ast.Attribute) and n.attr == "dtype"
+            for n in _walk_own(fn))
+        if consults_dtype:
+            return            # gated like solve_spd: downcast is informed
+        for node in _walk_own(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype" and node.args):
+                continue
+            arg = node.args[0]
+            target = _dotted(arg, idx.aliases) or (
+                arg.value if isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str) else "")
+            if str(target).rsplit(".", 1)[-1] in ("bfloat16", "float16"):
+                self.add(node.lineno, "dtype-drift",
+                         f"unconditional downcast to {str(target).rsplit('.', 1)[-1]} "
+                         f"in traced function {fn.name!r} with no "
+                         ".dtype consultation — restore the saved "
+                         "input dtype instead so f32 callers stay f32 "
+                         "(ops/solve.py solve_spd gate is the idiom)")
+
+
+# -- front end ---------------------------------------------------------
+
+def _load_vocab():
+    spec = importlib.util.spec_from_file_location(
+        "_tal_vocab", os.path.join(HERE, "vocab.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_baseline(path):
+    keys = set()
+    if path and os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    keys.add(line)
+    return keys
+
+
+def lint_paths(paths, repo=REPO, with_vocab=True):
+    """Lint files/dirs; returns (findings, nfiles)."""
+    vocab = _load_vocab() if with_vocab else None
+    findings, nfiles = [], 0
+    for path in _py_files(paths):
+        nfiles += 1
+        findings.extend(FileLinter(path, repo, vocab).run())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, nfiles
+
+
+def _py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, _, files in os.walk(p):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="tpu_als lint",
+        description="tracer-safety linter + contract verifier "
+                    "(stdlib-only; --contracts needs jax)")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: tpu_als/, "
+                         "scripts/, bench.py)")
+    ap.add_argument("--baseline", default=BASELINE_DEFAULT,
+                    help="baseline file of accepted findings "
+                         "(default: lint_baseline.txt; 'none' disables)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline file "
+                         "and exit 0")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--contracts", action="store_true",
+                    help="also re-verify the jaxpr contract registry "
+                         "(imports jax; CPU-safe)")
+    ap.add_argument("--contract", action="append", default=None,
+                    help="verify only this named contract (repeatable; "
+                         "implies --contracts)")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for slug, (tal, help_) in RULES.items():
+            print(f"{tal}  {slug:22s} {help_}")
+        return 0
+
+    t0 = time.perf_counter()
+    default_run = args.paths is None
+    paths = args.paths if args.paths \
+        else [os.path.join(REPO, p) for p in DEFAULT_ROOTS]
+    findings, nfiles = lint_paths(paths)
+
+    if default_run:
+        # the plan_* vocabulary is a repo-level contract, not a
+        # per-file property — only meaningful over the default roots
+        vocab = _load_vocab()
+        for msg in vocab.check_plan_vocabulary(REPO):
+            path, _, rest = msg.partition(": ")
+            findings.append(Finding(path, 1, "unregistered-name", rest))
+
+    baseline_path = None if args.baseline == "none" else args.baseline
+    if args.write_baseline:
+        with open(baseline_path or BASELINE_DEFAULT, "w",
+                  encoding="utf-8") as f:
+            f.write("# tpu_als lint baseline — accepted findings, one "
+                    "'path :: rule :: message' per line.\n"
+                    "# Policy: keep this EMPTY.  Fix findings or "
+                    "suppress at the site with a reason\n"
+                    "# ('# tal: disable=<rule> -- <why>').  See "
+                    "docs/analysis.md.\n")
+            for fd in findings:
+                f.write(fd.key + "\n")
+        print(f"tpu_als lint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path or BASELINE_DEFAULT}")
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    new = [f for f in findings if f.key not in baseline]
+    matched = {f.key for f in findings if f.key in baseline}
+    stale = baseline - matched
+    for entry in sorted(stale):
+        print(f"tpu_als lint: note: stale baseline entry (fixed? "
+              f"remove it): {entry}", file=sys.stderr)
+
+    rc = 0
+    if new:
+        for f in new:
+            print(f.render(), file=sys.stderr)
+        print(f"tpu_als lint: {len(new)} finding(s) in {nfiles} files",
+              file=sys.stderr)
+        rc = 1
+    else:
+        dt = time.perf_counter() - t0
+        print(f"tpu_als lint: OK ({nfiles} files, "
+              f"{len(matched)} baselined, {dt:.2f}s)")
+
+    if args.contracts or args.contract:
+        rc = max(rc, _run_contracts(args.contract))
+    return rc
+
+
+def _run_contracts(only=None):
+    """Verify the jaxpr contract registry (the jax doorway)."""
+    sys.path.insert(0, REPO)
+    from tpu_als.analysis import contracts
+
+    results = contracts.verify_all(only=only)
+    bad = 0
+    for r in results:
+        status = "OK" if r.ok else "FAIL"
+        print(f"contract {r.name}: {status} — {r.detail}")
+        if not r.ok:
+            bad += 1
+    if only is not None:
+        known = {r.name for r in results}
+        missing = [n for n in only if n not in known]
+        for n in missing:
+            print(f"contract {n}: UNKNOWN (not registered)",
+                  file=sys.stderr)
+            bad += 1
+    if bad:
+        print(f"tpu_als lint --contracts: {bad} contract(s) failed",
+              file=sys.stderr)
+        return 1
+    print(f"tpu_als lint --contracts: OK ({len(results)} verified)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

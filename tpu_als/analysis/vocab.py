@@ -1,0 +1,332 @@
+"""Registry-driven literal-vocabulary checks (obs schema + fault points).
+
+The single engine behind two front ends:
+
+- ``scripts/check_obs_schema.py`` — the historical CLI, now a thin shim
+  over this module (same diagnostics, same exit codes, same summary
+  lines, so the smoke scripts and tests/test_obs.py are untouched);
+- the linter's ``unregistered-name`` rule (:mod:`tpu_als.analysis.lint`),
+  which reports the same diagnostics through the baseline/suppression
+  machinery.
+
+What it checks (verbatim from the PR 1/PR 3/PR 9 contracts): every
+literal ``.counter( / .gauge( / .histogram( / .emit(`` call site and
+read-side accessor must name a declared metric/event of the right kind;
+non-literal names are violations for write methods outside
+``tpu_als/obs/``; scenario ``Assertion(metric=/event=/num=/den=)``
+literals and inline ``{"ts": ..., "type": ...}`` event dicts validate
+against the same schema; ``faults.check/armed/hits`` literals and
+``fault_spec=`` strings validate against ``FAULT_POINTS`` /
+``parse_spec``.  The four ``plan_*`` events are additionally pinned as
+a cross-process contract (declared AND emitted by the planner).
+
+Deliberately jax-free: the registries — ``tpu_als/obs/schema.py`` and
+``tpu_als/resilience/faults.py``, both stdlib-only — are loaded
+STANDALONE by file path (the ``scripts/bench_gate.sh`` idiom), never
+through the ``tpu_als`` package root, whose ``__init__`` imports jax.
+That standalone loading is itself the fix for the linter's
+``jaxfree-import`` finding on the pre-shim check_obs_schema.py, which
+imported the package root and crashed with jax absent despite its
+documented contract (pinned by a poisoned-jax test in
+tests/test_analysis.py).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import re
+import sys
+
+# tpu_als/analysis/vocab.py -> repo root
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# a counter/gauge/histogram/emit (write) or quantile/count/value (read
+# accessor) call with either a literal first argument (named groups
+# q/name) or anything else (group expr); longest alternatives first so
+# 'histogram_quantile' never half-matches as 'histogram'
+CALL_RE = re.compile(
+    r"\.(?P<method>histogram_quantile|histogram_count|histogram"
+    r"|counter_value|counter|gauge|emit)\(\s*"
+    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<expr>[^)\s][^),]*))")
+
+# accessor method -> the metric kind its name must be declared as; a
+# non-literal name is allowed for these (read-only: can't mint a series)
+ACCESSOR_KIND = {"histogram_quantile": "histogram",
+                 "histogram_count": "histogram",
+                 "counter_value": "counter"}
+
+# scenario-spec literals: Assertion(metric=/event=/num=/den=) bind to
+# the registry only at evaluation time — validate them where declared.
+# "$key"-prefixed values resolve from scenario config, not the schema.
+ASSERT_KW_RE = re.compile(
+    r"\b(?P<kw>metric|event|num)\s*=\s*"
+    r"(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)")
+ASSERT_DEN_RE = re.compile(r"\bden\s*=\s*\((?P<body>[^)]*)\)")
+_STR_RE = re.compile(r"['\"]([^'\"]+)['\"]")
+
+# fault-point literals: consultation sites (check/armed/hits) must name
+# a declared point; scenario fault_spec= strings (possibly implicit-
+# concat inside parens) must survive parse_spec whole
+FAULT_CALL_RE = re.compile(
+    r"\bfaults\.(?P<method>check|armed|hits)\(\s*"
+    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<expr>[^)\s][^),]*))")
+FAULT_SPEC_RE = re.compile(
+    r"\bfault_spec\s*=\s*(?P<body>\([^)]*\)|['\"][^'\"]*['\"])",
+    re.DOTALL)
+
+# inline event dicts: a line carrying both a "ts" key and a literal
+# "type" value (the hand-built shape allowed where importing tpu_als is
+# off-limits)
+INLINE_RE = re.compile(r"['\"]type['\"]\s*:\s*['\"](?P<name>\w+)['\"]")
+INLINE_TS_RE = re.compile(r"['\"]ts['\"]\s*:")
+
+DEFAULT_ROOTS = ("tpu_als", "scripts", "bench.py")
+
+# the execution planner's event vocabulary is a cross-process CONTRACT:
+# the warm-start tests assert trails like "plan_cache_hit present,
+# plan_probe absent", so a renamed/undeclared literal would silently
+# void those assertions.  Pin all four here, over and above the generic
+# call-site validation.
+PLAN_EVENTS = ("plan_resolved", "plan_probe", "plan_cache_hit",
+               "plan_cache_miss")
+
+
+def _load_standalone(name, relpath, repo):
+    """Load one stdlib-only registry module by file path, bypassing the
+    ``tpu_als`` package root (whose ``__init__`` imports jax)."""
+    path = os.path.join(repo, *relpath.split("/"))
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_REGISTRY_CACHE = {}
+
+
+def load_registries(repo=REPO):
+    """Return ``(schema, faults)`` — the two vocabulary registries,
+    loaded standalone (jax-free) and cached per repo root."""
+    if repo not in _REGISTRY_CACHE:
+        _REGISTRY_CACHE[repo] = (
+            _load_standalone("_tal_obs_schema", "tpu_als/obs/schema.py",
+                             repo),
+            _load_standalone("_tal_faults", "tpu_als/resilience/faults.py",
+                             repo),
+        )
+    return _REGISTRY_CACHE[repo]
+
+
+def check_plan_vocabulary(repo=REPO):
+    """The four plan_* events must be declared in the schema AND emitted
+    by tpu_als/plan/planner.py (an emit that moved elsewhere without a
+    declaration update fails the generic pass; a declaration whose emit
+    vanished fails here)."""
+    schema, _ = load_registries(repo)
+    errors = []
+    for name in PLAN_EVENTS:
+        if name not in schema.EVENTS:
+            errors.append(
+                f"tpu_als/obs/schema.py: planner event {name!r} is not "
+                "declared in EVENTS (the tpu_als.plan contract pins all "
+                f"four of {', '.join(PLAN_EVENTS)})")
+    planner_py = os.path.join(repo, "tpu_als", "plan", "planner.py")
+    if os.path.exists(planner_py):
+        with open(planner_py, encoding="utf-8") as f:
+            text = f.read()
+        for name in PLAN_EVENTS:
+            if f'"{name}"' not in text:
+                errors.append(
+                    f"tpu_als/plan/planner.py: never emits {name!r} — "
+                    "the plan_* event trail is the warm-start test "
+                    "contract (docs/planner.md)")
+    return errors
+
+
+def py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, _, files in os.walk(p):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def _assertion_blocks(text):
+    """Yield (start_pos, block_text) for every ``Assertion(...)`` call,
+    matched by paren balance (good enough for our code: no parens inside
+    the string literals these blocks carry)."""
+    for m in re.finditer(r"\bAssertion\s*\(", text):
+        start = m.end() - 1
+        depth = 0
+        for i in range(start, min(len(text), start + 4000)):
+            ch = text[i]
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    yield m.start(), text[start:i + 1]
+                    break
+
+
+def check_file(path, repo=REPO):
+    """Return ``(lineno, message)`` pairs for every vocabulary violation
+    in one file.  Messages carry their own ``rel:line`` prefix so the
+    shim's output stays byte-compatible with the historical script."""
+    schema, faults = load_registries(repo)
+    errors = []
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    rel = os.path.relpath(path, repo)
+    # the registry/schema themselves pass names through variables; the
+    # analysis engine (this module + the linter) quotes call shapes in
+    # docstrings and fixtures, so it gets the same exemption the old
+    # check_obs_schema.py script gave itself
+    in_obs = "tpu_als/obs/" in path.replace(os.sep, "/") \
+        or "tpu_als/analysis/" in path.replace(os.sep, "/") \
+        or path.replace(os.sep, "/").endswith("scripts/check_obs_schema.py")
+
+    def line_of(pos):
+        return text.count("\n", 0, pos) + 1
+
+    def add(lineno, msg):
+        errors.append((lineno, msg))
+
+    for m in CALL_RE.finditer(text):
+        method, name = m.group("method"), m.group("name")
+        lineno = line_of(m.start())
+        where = f"{rel}:{lineno}"
+        if name is None:
+            if not in_obs and method not in ACCESSOR_KIND:
+                add(lineno,
+                    f"{where}: {method}() with a non-literal name "
+                    f"({m.group('expr').strip()!r}) — the static check "
+                    "cannot validate it; use a literal declared in "
+                    "tpu_als.obs.schema")
+            continue
+        if method == "emit":
+            if name not in schema.EVENTS:
+                add(lineno,
+                    f"{where}: emit of undeclared event type {name!r} "
+                    "(declare it in tpu_als.obs.schema.EVENTS)")
+        else:
+            want_kind = ACCESSOR_KIND.get(method, method)
+            decl = schema.METRICS.get(name)
+            if decl is None:
+                add(lineno,
+                    f"{where}: {method} of undeclared metric {name!r} "
+                    "(declare it in tpu_als.obs.schema.METRICS)")
+            elif decl[0] != want_kind:
+                add(lineno,
+                    f"{where}: metric {name!r} is declared as a "
+                    f"{decl[0]}, used as a {want_kind} ({method})")
+
+    for pos, block in _assertion_blocks(text):
+        lineno = line_of(pos)
+        where = f"{rel}:{lineno}"
+        for m in ASSERT_KW_RE.finditer(block):
+            kw, name = m.group("kw"), m.group("name")
+            if name.startswith("$"):     # resolved from scenario config
+                continue
+            if kw == "event":
+                if name not in schema.EVENTS:
+                    add(lineno,
+                        f"{where}: Assertion(event={name!r}) names an "
+                        "undeclared event type (declare it in "
+                        "tpu_als.obs.schema.EVENTS)")
+            elif name not in schema.METRICS:
+                add(lineno,
+                    f"{where}: Assertion({kw}={name!r}) names an "
+                    "undeclared metric (declare it in "
+                    "tpu_als.obs.schema.METRICS)")
+        for m in ASSERT_DEN_RE.finditer(block):
+            for name in _STR_RE.findall(m.group("body")):
+                if not name.startswith("$") \
+                        and name not in schema.METRICS:
+                    add(lineno,
+                        f"{where}: Assertion(den=...) entry {name!r} is "
+                        "not a declared metric (declare it in "
+                        "tpu_als.obs.schema.METRICS)")
+
+    in_faults = in_obs or path.replace(os.sep, "/").endswith(
+        "tpu_als/resilience/faults.py")
+    for m in FAULT_CALL_RE.finditer(text) if not in_obs else ():
+        method, name = m.group("method"), m.group("name")
+        lineno = line_of(m.start())
+        where = f"{rel}:{lineno}"
+        if name is None:
+            if not in_faults:
+                add(lineno,
+                    f"{where}: faults.{method}() with a non-literal "
+                    f"point ({m.group('expr').strip()!r}) — the static "
+                    "check cannot validate it; use a literal from "
+                    "tpu_als.resilience.faults.FAULT_POINTS")
+        elif name not in faults.FAULT_POINTS:
+            add(lineno,
+                f"{where}: faults.{method} of undeclared fault point "
+                f"{name!r} (declare it in "
+                "tpu_als.resilience.faults.FAULT_POINTS)")
+
+    for m in FAULT_SPEC_RE.finditer(text) if not in_obs else ():
+        lineno = line_of(m.start())
+        where = f"{rel}:{lineno}"
+        spec = "".join(_STR_RE.findall(m.group("body")))
+        if not spec:
+            continue                         # non-literal: runtime checks it
+        try:
+            faults.parse_spec(spec)
+        except faults.FaultSpecError as e:
+            add(lineno, f"{where}: fault_spec {spec!r} does not parse: "
+                        f"{e}")
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not INLINE_TS_RE.search(line):
+            continue
+        for m in INLINE_RE.finditer(line):
+            name = m.group("name")
+            if name not in schema.EVENTS:
+                add(lineno,
+                    f"{rel}:{lineno}: inline event dict with undeclared "
+                    f"type {name!r} (declare it in "
+                    "tpu_als.obs.schema.EVENTS)")
+    return errors
+
+
+def main(argv=None):
+    """CLI core shared with scripts/check_obs_schema.py: returns the
+    historical exit code and prints the historical summary lines."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="statically validate observability call sites "
+                    "against tpu_als.obs.schema")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="files/dirs to scan (default: tpu_als/, "
+                         "scripts/, bench.py under the repo root)")
+    args = ap.parse_args(argv)
+    paths = args.paths or [os.path.join(REPO, p) for p in DEFAULT_ROOTS]
+    errors = []
+    if args.paths is None:          # fixture runs scan only their files
+        errors.extend(check_plan_vocabulary())
+    nfiles = 0
+    for path in py_files(paths):
+        nfiles += 1
+        errors.extend(
+            msg for _, msg in check_file(path))
+    if errors:
+        print("\n".join(errors), file=sys.stderr)
+        print(f"check_obs_schema: {len(errors)} violation(s) in "
+              f"{nfiles} files", file=sys.stderr)
+        return 1
+    print(f"check_obs_schema: OK ({nfiles} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -841,8 +841,10 @@ def cmd_serve_bench(args):
     tickets, shed = [], 0
     engine.start()
     try:
-        t0 = time.perf_counter()
         with obs.span("serve_bench.drive"):
+            # pacing epoch starts inside the span: the span-enter
+            # emission must not make request 0 late against its target
+            t0 = time.perf_counter()
             for j in range(n_req):
                 target = t0 + j / args.qps
                 delay = target - time.perf_counter()
@@ -1204,6 +1206,29 @@ def cmd_plan(args):
         n = plan_pkg.clear()
         print(json.dumps({"cleared_entries": n, "cache_dir": root}))
         return
+
+
+def cmd_lint(args):
+    """Delegate to the analysis linter (docs/analysis.md), rebuilding
+    its argv — the engine owns the argument semantics and the direct
+    ``python tpu_als/analysis/lint.py`` invocation (jax-free) must stay
+    the single source of truth for both."""
+    from tpu_als.analysis import lint as _lint
+
+    argv = []
+    if args.paths is not None:
+        argv += ["--paths", *args.paths]
+    if args.baseline is not None:
+        argv += ["--baseline", args.baseline]
+    if args.write_baseline:
+        argv.append("--write-baseline")
+    if args.rules:
+        argv.append("--rules")
+    if args.contracts:
+        argv.append("--contracts")
+    for name in args.contract or ():
+        argv += ["--contract", name]
+    return _lint.main(argv)
 
 
 def main(argv=None):
@@ -1583,6 +1608,30 @@ def main(argv=None):
                       "probe registry (.corrupt/ evidence is kept)")
     plc.set_defaults(fn=cmd_plan, obs_dir=None)
 
+    ln = sub.add_parser(
+        "lint",
+        help="tracer-safety linter + jaxpr contract registry "
+             "(docs/analysis.md; the AST pass is stdlib-only, "
+             "--contracts re-verifies the byte pins)")
+    ln.add_argument("--paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: tpu_als/, "
+                         "scripts/, bench.py)")
+    ln.add_argument("--baseline", default=None,
+                    help="baseline file of accepted findings "
+                         "(default: lint_baseline.txt; 'none' disables)")
+    ln.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline file")
+    ln.add_argument("--rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ln.add_argument("--contracts", action="store_true",
+                    help="also re-verify every registered jaxpr "
+                         "contract (guardrails_disarmed, plan_cache_off, "
+                         "ne_audit, comm_audit)")
+    ln.add_argument("--contract", action="append", default=None,
+                    help="verify only this named contract (repeatable; "
+                         "implies --contracts)")
+    ln.set_defaults(fn=cmd_lint)
+
     args = ap.parse_args(argv)
     _validate_fault_spec()
     if getattr(args, "nonnegative", False) and \
@@ -1593,8 +1642,8 @@ def main(argv=None):
         ap.error("--cg-iters cannot be combined with --nonnegative "
                  "(the NNLS solver takes precedence and the CG request "
                  "would be silently ignored)")
-    if args.cmd == "observe":
-        return args.fn(args)  # reading a run dir must not write one
+    if args.cmd in ("observe", "lint"):
+        return args.fn(args)  # read-only commands must not write a run dir
 
     from tpu_als import obs
 
@@ -1629,4 +1678,7 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    # several commands return report objects for in-process callers;
+    # only integer returns are exit codes (lint findings, contract fails)
+    _rc = main()
+    sys.exit(_rc if isinstance(_rc, int) else 0)

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from tpu_als.core.ratings import trainer_chunk
 
 from tpu_als.ops.solve import (
+    DEFAULT_JITTER,
     compute_yty,
     normal_eq_explicit,
     normal_eq_implicit,
@@ -83,7 +84,7 @@ class AlsConfig:
     # knob for solve_spd / solve_cg / solve_cg_matfree / solve_nnls, and
     # the base rung of the adaptive escalation ladder).  Static: a
     # different jitter is a different compiled step.
-    jitter: float = 1e-6
+    jitter: float = DEFAULT_JITTER
     # residual-checked jitter escalation + CG fallback inside solve_spd
     # (ops.solve ADAPTIVE_JITTER_RUNGS).  OFF by default — the plain
     # step's jaxpr must stay byte-identical; the guardrails 'recover'

@@ -19,6 +19,7 @@ import functools
 import jax
 
 from tpu_als.ops.solve import (
+    DEFAULT_JITTER,
     compute_yty,
     normal_eq_explicit,
     normal_eq_implicit,
@@ -38,7 +39,7 @@ def fold_in(
     nonnegative=False,
     nnls_sweeps=32,
     YtY=None,
-    jitter=1e-6,
+    jitter=DEFAULT_JITTER,
 ):
     """Solve factors for a batch of touched entities against fixed ``V``.
 
@@ -74,7 +75,7 @@ def _fold_in_jit(
     nonnegative=False,
     nnls_sweeps=32,
     YtY=None,
-    jitter=1e-6,
+    jitter=DEFAULT_JITTER,
 ):
     Vg = V[cols]
     if implicit_prefs:

@@ -97,6 +97,12 @@ def stage(name, sink=None):
         pending.append(x)
         return x
 
+    # tal: disable=timer-brackets-span -- deliberate: the clock MUST
+    # bracket the span enter/exit emissions.  The attribution coverage
+    # contract (tests/test_attribution.py: stage sums >= 90% of the wall
+    # iteration) attributes ALL armed-path time to stages; excluding the
+    # two JSONL writes per stage leaves them unattributed and breaks the
+    # bound on fast (CPU) iterations.
     t0 = time.perf_counter()
     with obs.span("attr." + name, stage=name):
         yield keep

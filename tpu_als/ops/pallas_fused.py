@@ -32,6 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from tpu_als.ops.pallas_solve import factorize, substitute
+from tpu_als.ops.solve import DEFAULT_JITTER
 
 
 def _fused_kernel(Vg_ref, vals_ref, mask_ref, YtY_ref, x_ref, S, LT, bacc,
@@ -133,7 +134,8 @@ def _tiles(r_pad, w, max_wc=256, budget_elems=1 << 18, panel=16):
                      "interpret"),
 )
 def fused_normal_solve(Vg, vals, mask, YtY=None, *, reg, implicit=False,
-                       alpha=1.0, panel=16, jitter=1e-6, interpret=False):
+                       alpha=1.0, panel=16, jitter=DEFAULT_JITTER,
+                       interpret=False):
     """x = (ΣvvᵀC + λnI [+ YᵀY])⁻¹ (ΣcCp) for every row, A never in HBM.
 
     Vg [N, w, r] gathered opposite factors; vals/mask [N, w]; YtY [r, r]

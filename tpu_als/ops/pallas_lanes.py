@@ -244,7 +244,7 @@ def available(rank=128):
     def probe():
         import numpy as np
 
-        from tpu_als.ops.solve import solve_spd
+        from tpu_als.ops.solve import DEFAULT_JITTER, solve_spd
 
         n, r = LANES + 8, r_pad  # force 2 lane groups + batch padding
         rng = np.random.default_rng(0)
@@ -258,7 +258,8 @@ def available(rank=128):
         # fused update trips this Mosaic version
         for p in (DEFAULT_PANEL, 1):
             try:
-                x = spd_solve_lanes(A + 1e-6 * jnp.eye(r), b, panel=p)
+                x = spd_solve_lanes(A + DEFAULT_JITTER * jnp.eye(r), b,
+                                    panel=p)
                 x.block_until_ready()
                 ok = np.allclose(np.asarray(x), np.asarray(ref), atol=1e-3,
                                  rtol=1e-2)

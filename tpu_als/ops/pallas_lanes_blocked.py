@@ -249,7 +249,7 @@ def available(rank=256):
     def probe():
         import numpy as np
 
-        from tpu_als.ops.solve import solve_spd
+        from tpu_als.ops.solve import DEFAULT_JITTER, solve_spd
 
         n, r = LANES + 8, r_pad  # 2 lane groups + batch padding
         rng = np.random.default_rng(0)
@@ -260,7 +260,8 @@ def available(rank=256):
         b = jnp.asarray(rng.normal(size=(n, r)).astype(np.float32))
         ref = solve_spd(A, b, jnp.ones((n,), jnp.float32), backend="xla")
         try:
-            x = spd_solve_lanes_blocked(A + 1e-6 * jnp.eye(r), b)
+            x = spd_solve_lanes_blocked(A + DEFAULT_JITTER * jnp.eye(r),
+                                        b)
             x.block_until_ready()
             return np.allclose(np.asarray(x), np.asarray(ref),
                                atol=1e-3, rtol=1e-2)

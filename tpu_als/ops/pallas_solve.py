@@ -271,7 +271,7 @@ def available(rank=128, panel=16):
         # arithmetic; same standard as pallas_fused.available)
         import numpy as np
 
-        from tpu_als.ops.solve import solve_spd
+        from tpu_als.ops.solve import DEFAULT_JITTER, solve_spd
 
         n, r = 8, r_pad
         rng = np.random.default_rng(0)
@@ -282,7 +282,8 @@ def available(rank=128, panel=16):
         b = jnp.asarray(rng.normal(size=(n, r)).astype(np.float32))
         # mirror solve_spd's pre-regularization, but call the kernel
         # directly so the probe compiles the SAME panel it green-lights
-        x = spd_solve_pallas(A + 1e-6 * jnp.eye(r), b, panel=panel)
+        x = spd_solve_pallas(A + DEFAULT_JITTER * jnp.eye(r), b,
+                             panel=panel)
         x.block_until_ready()
         ref = solve_spd(A, b, jnp.ones((n,), jnp.float32), backend="xla")
         return np.allclose(np.asarray(x), np.asarray(ref), atol=1e-3,

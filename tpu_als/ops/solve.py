@@ -39,6 +39,15 @@ import functools
 import jax
 import jax.numpy as jnp
 
+# The ONE default Tikhonov jitter, threaded everywhere a solve can be
+# reached: every solver signature below, the Pallas probe matrices, the
+# fused-kernel default, fold-in, and ``AlsConfig.jitter`` all reference
+# this name.  A literal 1e-6 anywhere else is a lint finding
+# (magic-jitter, tpu_als/analysis/lint.py): a drifted copy means the
+# attribution twin or a probe solves a DIFFERENTLY-regularized system
+# than the production step and the bitwise-equivalence pins lie.
+DEFAULT_JITTER = 1e-6
+
 # The adaptive-solve escalation ladder (resilience guardrails, docs/
 # resilience.md): rungs are ABSOLUTE jitter levels tried above the
 # configured base jitter, in order, before the CG fallback.  Residuals
@@ -224,7 +233,8 @@ def _dispatch_spd(A, b, backend):
     return x
 
 
-def solve_spd(A, b, count, jitter=1e-6, backend="auto", adaptive=False):
+def solve_spd(A, b, count, jitter=DEFAULT_JITTER, backend="auto",
+              adaptive=False):
     """Batched SPD solve via Cholesky: x = A⁻¹ b for each row.
 
     Rows with ``count == 0`` (entities with no ratings in this shard — padding
@@ -315,7 +325,7 @@ def solve_spd(A, b, count, jitter=1e-6, backend="auto", adaptive=False):
     return jax.lax.cond(jnp.all(ok0), lambda x: x, _escalate, x0)
 
 
-def solve_spd_checked(A, b, count, jitter=1e-6, backend="auto"):
+def solve_spd_checked(A, b, count, jitter=DEFAULT_JITTER, backend="auto"):
     """Eager adaptive solve with a host-side verdict: runs the full
     escalation ladder and raises the typed :class:`SolveUnstable` when
     rows remain non-finite or residual-failing after every rung — the
@@ -376,7 +386,7 @@ def pcg(matvec, b, diag, x0=None, iters=3):
     return x
 
 
-def solve_cg(A, b, count, x0=None, iters=3, jitter=1e-6):
+def solve_cg(A, b, count, x0=None, iters=3, jitter=DEFAULT_JITTER):
     """Batched Jacobi-preconditioned conjugate gradient, fixed iterations.
 
     The Takács–Pilászy approach for ALS (Applications of the conjugate
@@ -412,7 +422,7 @@ def solve_cg(A, b, count, x0=None, iters=3, jitter=1e-6):
 
 
 def solve_cg_matfree(Vg, vals, mask, reg, implicit=False, alpha=1.0,
-                     YtY=None, x0=None, iters=3, jitter=1e-6):
+                     YtY=None, x0=None, iters=3, jitter=DEFAULT_JITTER):
     """Matrix-free inexact solve: warm-started Jacobi-CG where A is
     applied THROUGH the gathered factor rows —
 
@@ -474,7 +484,7 @@ def solve_cg_matfree(Vg, vals, mask, reg, implicit=False, alpha=1.0,
 
 
 @functools.partial(jax.jit, static_argnames=("sweeps", "jitter"))
-def solve_nnls(A, b, count, sweeps=32, jitter=1e-6):
+def solve_nnls(A, b, count, sweeps=32, jitter=DEFAULT_JITTER):
     """Batched nonnegative least squares via cyclic coordinate descent.
 
     Replaces the reference stack's projected-CG ``NNLSSolver``

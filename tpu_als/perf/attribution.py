@@ -43,6 +43,7 @@ from tpu_als.core.als import (
 from tpu_als.core.ratings import trainer_chunk
 from tpu_als.obs import trace
 from tpu_als.ops.solve import (
+    DEFAULT_JITTER,
     compute_yty,
     normal_eq_explicit,
     normal_eq_implicit,
@@ -117,7 +118,7 @@ def make_attributed_step(user_buckets, item_buckets, num_users, num_items,
         solve_fn = jax.jit(
             functools.partial(solve_nnls, sweeps=cfg.nnls_sweeps,
                               jitter=cfg.jitter))
-    elif cfg.jitter == 1e-6:
+    elif cfg.jitter == DEFAULT_JITTER:
         solve_fn = _solve_spd
     else:
         # non-default jitter (AlsConfig.jitter is the one knob): the twin

@@ -1,0 +1,97 @@
+#!/bin/bash
+# Live smoke: the continuous-freshness subsystem's CI gate, CPU-only
+# (no accelerator, no network).  Four stages, fail-fast:
+#
+#   1. the live test tier — the delta-index bitwise property sweep
+#      (touched/append/mixed/second-generation/compacted vs a full
+#      rebuild), publish_update mode selection, the LiveUpdater loop
+#      (micro-batching, quarantine, shed, SLO breach → flight record),
+#      plus the serving companions the pipeline publishes through,
+#   2. the static checks — the obs-schema shim (the live.* metric
+#      vocabulary, live_update / live_freshness_breach events) plus
+#      the analysis gate (scripts/lint_smoke.sh: tracer-safety lint +
+#      the jaxpr contract registry, live_delta_index included),
+#   3. one END-TO-END serve-bench with a concurrent open-loop update
+#      stream: serve traffic AND rating events with poison mixed in,
+#      judged against BOTH SLOs (serve p99 and freshness p99), the
+#      result banked with banked_at provenance and sanity-checked
+#      (events folded, poison quarantined, publishes incremental),
+#   4. the bench regression gate over the committed result banks
+#      (scripts/bench_gate.sh — regressions, null banks, missing
+#      provenance all exit non-zero).
+#
+# Usage: scripts/live_smoke.sh   (from the repo root; ~2 min on CPU)
+set -u
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+fail=0
+
+echo "== live smoke 1/4: live test tier =="
+python -m pytest tests/test_live.py tests/test_serving.py \
+    tests/test_topk_foldin.py -q -m 'not slow' -p no:cacheprovider || fail=1
+
+echo "== live smoke 2/4: static checks (obs schema + analysis gate) =="
+python scripts/check_obs_schema.py || fail=1
+scripts/lint_smoke.sh || fail=1
+
+echo "== live smoke 3/4: end-to-end serve-bench with live update stream =="
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+python -m tpu_als.cli serve-bench \
+    --users 2000 --items 5000 --rank 32 --k 10 --shortlist-k 64 \
+    --qps 60 --duration 4 --slo-ms 2000 --max-wait-ms 2 \
+    --update-qps 60 --update-items --update-poison-frac 0.05 \
+    --update-max-batch 32 --freshness-slo-ms 10000 \
+    --bench-json "$work/BENCH_live_smoke.json" \
+    >"$work/live.out" 2>"$work/live.log"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "FAIL: serve-bench --update-qps exited $rc" >&2
+    tail -5 "$work/live.log" >&2
+    fail=1
+else
+    python - "$work/BENCH_live_smoke.json" <<'EOF' || fail=1
+import json, sys
+
+r = json.load(open(sys.argv[1]))
+problems = []
+if r["metric"] != "live_freshness_p99_ms":
+    problems.append(f"unexpected metric {r['metric']!r}")
+if not r["scored"]:
+    problems.append("no serve request completed (empty latency histograms)")
+if not r["slo_met"]:
+    problems.append(f"freshness p99 {r['value']}ms blew the loose "
+                    f"{r['slo_ms']}ms SLO")
+if not r["serve"]["slo_met"]:
+    problems.append(f"serve p99 {r['serve']['p99_ms']}ms blew the loose "
+                    f"{r['serve']['slo_ms']}ms SLO under the update stream")
+live = r["live"]
+if not live["events_scored"]:
+    problems.append("no update event made it through the fold-in pipeline")
+if not live["quarantined_rows"]:
+    problems.append("5% poison injected but nothing quarantined")
+if live["updates_shed"]:
+    problems.append(f"shed {live['updates_shed']} updates at 60 eps on CPU")
+modes = live["publish_modes"]
+if not (modes.get("delta", 0) + modes.get("compact", 0)):
+    problems.append(f"no incremental publish (modes: {modes})")
+if "banked_at" not in r or "+00:00" not in r["banked_at"]:
+    problems.append("missing/naive banked_at provenance stamp")
+for p in problems:
+    print(f"FAIL: live serve-bench result: {p}", file=sys.stderr)
+print(f"live serve-bench: freshness p50={r['p50_ms']}ms p99={r['value']}ms "
+      f"serve p99={r['serve']['p99_ms']}ms events={live['events_scored']} "
+      f"quarantined={live['quarantined_rows']} modes={modes}")
+sys.exit(1 if problems else 0)
+EOF
+fi
+
+echo "== live smoke 4/4: bench regression gate =="
+bash scripts/bench_gate.sh || fail=1
+
+if [ "$fail" -ne 0 ]; then
+    echo "live smoke: FAIL" >&2
+    exit 1
+fi
+echo "live smoke: OK"

@@ -69,7 +69,8 @@ def _cli(args, env_extra=None):
 
 def test_registry_has_the_issue_scenarios():
     for name in ("traffic-spike", "preempt-under-serve", "torn-publish",
-                 "cold-start", "preempt-resume", "flight-recorder"):
+                 "cold-start", "preempt-resume", "flight-recorder",
+                 "continuous-freshness"):
         assert scenario.get_scenario(name).name == name
 
 
@@ -262,6 +263,27 @@ def test_flight_recorder_scenario_passes(_fresh):
         assert r["trigger"] == "slo_breach"
         assert all(r["spans"][k] is not None for k in
                    ("admission", "queue_wait", "score", "respond"))
+
+
+def test_continuous_freshness_scenario_passes(_fresh):
+    """ISSUE 11 acceptance: a sustained rating stream under live serve
+    load — freshness p99 under the SLO, zero torn publishes, every
+    publish incremental (retag/delta/compact, never a full rebuild),
+    and the poison quarantine counted exactly — all judged from the
+    obs trail by the scenario's own assertions."""
+    reg = _fresh
+    result = scenario.run_scenario(
+        scenario.get_scenario("continuous-freshness"))
+    assert result["passed"], result["assertions"]
+    f = result["facts"]
+    assert f["all_incremental"] is True
+    assert f["new_user_served"] is True
+    assert f["hard_failures"] == 0
+    # the trail carries the live vocabulary end to end
+    assert reg.histogram_count("live.freshness_seconds") > 0
+    assert any(e["type"] == "live_update" for e in reg._events)
+    assert any(e["type"] == "ingest_quarantined"
+               and e["path"] == "live" for e in reg._events)
 
 
 def test_preempt_under_serve_acceptance():

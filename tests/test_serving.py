@@ -330,9 +330,38 @@ def test_engine_stale_index_falls_back_to_exact(rng, _fresh):
         "count"] == 1
 
 
-def test_engine_publish_corrupt_fault_marks_index_stale(rng, _fresh):
+def test_engine_publish_corrupt_fault_first_publish_goes_indexless(
+        rng, _fresh):
+    """A torn FIRST publish has no prior generation to carry: the
+    publish goes out with ``index=None`` (never an in-place mutation of
+    a live index), requests take the exact path directly — no stale
+    index exists, so nothing counts as a fallback."""
     faults.install("serving.publish=corrupt@nth=1")
     eng, U, V = _engine(rng, quantize=True)
+    assert eng.published_index is None
+    t = eng.submit(1)
+    _drain_one(eng)
+    s, _ = t.result(timeout=1.0)
+    ref_s, _ = _exact(U[1:2], V, np.ones(V.shape[0], bool), eng.k)
+    np.testing.assert_allclose(s, ref_s[0], rtol=1e-5, atol=1e-6)
+    assert "serving.fallback_exact" not in _fresh.snapshot()["counters"]
+    pub = [e for e in _fresh._events if e["type"] == "serving_publish"]
+    assert pub and pub[-1]["quantized"] is False
+
+
+def test_engine_publish_corrupt_fault_carries_stale_index(rng, _fresh):
+    """A torn publish AFTER a healthy one carries the previous
+    generation's index untouched — stale by seq, detected on the score
+    path, counted as an exact fallback.  The prior generation's index
+    object itself must stay intact (the old in-place ``seq = -1``
+    corruption poisoned it for any still-serving reader)."""
+    eng, U, V = _engine(rng, quantize=True)
+    first = eng.published_index
+    first_seq = first.seq
+    faults.install("serving.publish=corrupt@nth=1")
+    eng.publish(U, V, quantize=True)
+    assert eng.published_index is first          # carried, not rebuilt
+    assert first.seq == first_seq                # and NOT mutated
     t = eng.submit(1)
     _drain_one(eng)
     s, _ = t.result(timeout=1.0)

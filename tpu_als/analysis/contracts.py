@@ -17,6 +17,12 @@ against the claimant's own inputs:
 - ``comm_audit``          — the collective bytes the sharded step's
   jaxpr actually moves equal ``trainer.comm_bytes_per_iter``'s closed
   form exactly.
+- ``live_delta_index``    — an incremental publish (delta segment of
+  only the touched/appended rows, and its later compaction) returns
+  top-k scores/indices BITWISE equal to a full ``build_index`` rebuild
+  of the updated catalog, and compaction's arrays are byte-equal to
+  the rebuild's (serving/index.py; not a jaxpr pin but the same
+  discipline — an exactness claim re-verified by name).
 
 Before this registry the four pins lived in four test files with no
 shared vocabulary; a kernel author adding a fifth had to rediscover the
@@ -311,6 +317,55 @@ def _pin_comm_audit(a):
             f"across {a['devices']} devices)")
 
 
+# -- live_delta_index -------------------------------------------------------
+
+def _build_live_delta():
+    import numpy as np
+
+    from tpu_als.serving.index import build_index
+
+    rng = np.random.default_rng(17)
+    Ni, r, n, k, sk = 220, 8, 13, 5, 48
+    V = rng.normal(size=(Ni, r)).astype(np.float32)
+    valid = rng.random(Ni) > 0.15
+    U = rng.normal(size=(n, r)).astype(np.float32)
+    base = build_index(V, item_valid=valid, shortlist_k=sk, seq=1)
+
+    touched = rng.choice(Ni, 9, replace=False)
+    Vn = np.concatenate(
+        [V, rng.normal(size=(5, r)).astype(np.float32)])
+    Vn[touched] = rng.normal(size=(9, r)).astype(np.float32)
+    validn = np.concatenate([valid, np.ones(5, bool)])
+    rows = np.concatenate([touched, np.arange(Ni, Ni + 5)])
+    delta = base.with_updates(rows, Vn[rows], valid_rows=validn[rows],
+                              seq=2)
+    compacted = delta.compact(seq=3)
+    ref = build_index(Vn, item_valid=validn, shortlist_k=sk, seq=2)
+    return {"U": U, "k": k, "delta": delta, "compacted": compacted,
+            "ref": ref, "touched": len(rows)}
+
+
+def _pin_live_delta(a):
+    import numpy as np
+
+    s_r, ix_r = (np.asarray(x) for x in a["ref"].topk(a["U"], a["k"]))
+    for which in ("delta", "compacted"):
+        s, ix = (np.asarray(x) for x in a[which].topk(a["U"], a["k"]))
+        _require(np.array_equal(s, s_r),
+                 f"{which} top-k SCORES differ from the full rebuild "
+                 "(the O(touched) incremental publish is not bitwise)")
+        _require(np.array_equal(ix, ix_r),
+                 f"{which} top-k INDICES differ from the full rebuild")
+    for arr in ("V", "Vq", "sv", "valid"):
+        _require(np.array_equal(np.asarray(getattr(a["compacted"], arr)),
+                                np.asarray(getattr(a["ref"], arr))),
+                 f"compacted index array {arr!r} differs bytewise from "
+                 "a full rebuild — compaction re-quantized or dropped "
+                 "rows")
+    return (f"delta({a['touched']} touched rows) and compacted top-k "
+            "bitwise == full rebuild; compacted arrays byte-equal")
+
+
 # -- registry ---------------------------------------------------------------
 
 _REGISTRY = {
@@ -328,6 +383,8 @@ _REGISTRY = {
                  "PR 9"),
         Contract("comm_audit", _build_comm_audit, _pin_comm_audit,
                  "tests/test_comm_audit.py, PR 6"),
+        Contract("live_delta_index", _build_live_delta, _pin_live_delta,
+                 "tests/test_live.py, PR 11"),
     )
 }
 

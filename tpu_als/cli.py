@@ -805,8 +805,17 @@ def cmd_serve_bench(args):
     loop self-throttles and hides queueing collapse).  Results can be
     banked as ``BENCH_serve_*.json`` with the same ``banked_at``
     provenance stamp bench.py uses (``--bench-json``).
+
+    ``--update-qps > 0`` additionally drives the LIVE pipeline
+    (tpu_als/live/) during the window: a concurrent rating-event
+    stream through a LiveUpdater — fold-in, incremental publish,
+    freshness measured per event — and the report's headline metric
+    becomes ``live_freshness_p99_ms`` judged against
+    ``--freshness-slo-ms``, with an O(touched)-vs-O(catalog)
+    publish-cost probe (min-of-3, device-fenced) alongside.
     """
     import datetime as _dt
+    import threading
     import time
 
     from tpu_als import obs
@@ -831,6 +840,46 @@ def cmd_serve_bench(args):
     engine.publish(U, V, quantize=not args.exact)
     with obs.span("serve_bench.warmup"):
         engine.warmup()
+
+    updater, model, upd_stats = None, None, {"shed": 0}
+    if args.update_qps > 0:
+        from tpu_als.api.estimator import ALSModel
+        from tpu_als.core.ratings import IdMap, _next_pow2
+        from tpu_als.live import LiveUpdater
+        from tpu_als.stream.microbatch import FoldInServer
+
+        model = ALSModel(
+            args.rank, IdMap(ids=np.arange(args.users)),
+            IdMap(ids=np.arange(args.items)), U.copy(), V.copy(),
+            {"userCol": "user", "itemCol": "item",
+             "ratingCol": "rating", "regParam": 0.05,
+             "implicitPrefs": False, "alpha": 1.0,
+             "nonnegative": False})
+        # keep_history=False: widths stay the per-batch multiplicity
+        # (1-2), so the prewarm grid below covers every shape the
+        # stream can produce — a history merge would grow widths over
+        # the window and pay compiles against the freshness SLO
+        srv = FoldInServer(model, keep_history=False)
+        updater = LiveUpdater(
+            engine, srv, max_batch=args.update_max_batch,
+            max_wait_ms=args.update_max_wait_ms,
+            slo_s=args.freshness_slo_ms / 1e3,
+            fold_items=args.update_items)
+        ladder = tuple(sorted({_next_pow2(max(1, updater.max_batch >> s))
+                               for s in range(updater.max_batch.bit_length())}))
+        with obs.span("serve_bench.live_prewarm"):
+            srv.prewarm(
+                rows=ladder, widths=(1, 2),
+                sides=(("user", "item") if args.update_items
+                       else ("user",)))
+            if args.update_items and not args.exact:
+                # each event touches one item, so the stream can never
+                # grow the delta segment past its own event count —
+                # compile the (bucket, delta-pad) serve executables up
+                # to that bound now, not on the request path
+                engine.warmup_live(max_delta_rows=max(
+                    1, int(args.update_qps * args.duration)))
+
     path = "exact" if args.exact else "int8"
     n_req = max(1, int(args.qps * args.duration))
     print(f"serve-bench: {n_req} requests at {args.qps:g} rps over "
@@ -838,12 +887,43 @@ def cmd_serve_bench(args):
           f"{args.items:,} items, rank {args.rank})", file=sys.stderr)
     foldin_ids = rng.random(n_req) < args.foldin_frac
     uids = rng.integers(0, args.users, n_req)
+
+    upd_thread = None
+    if updater is not None:
+        n_upd = max(1, int(args.update_qps * args.duration))
+        upd_u = rng.integers(0, args.users, n_upd)
+        upd_i = rng.integers(0, args.items, n_upd)
+        upd_r = rng.uniform(0.5, 5.0, n_upd).astype(np.float32)
+        upd_r[rng.random(n_upd) < args.update_poison_frac] = np.nan
+        print(f"serve-bench: +{n_upd} rating events at "
+              f"{args.update_qps:g}/s (live fold-in → publish, "
+              f"freshness SLO {args.freshness_slo_ms:g}ms)",
+              file=sys.stderr)
+
+        def _drive_updates():
+            tu = time.perf_counter()
+            for j in range(n_upd):
+                delay = tu + j / args.update_qps - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    updater.submit(int(upd_u[j]), int(upd_i[j]),
+                                   float(upd_r[j]))
+                except Overloaded:
+                    upd_stats["shed"] += 1
+
+        updater.start()
+        upd_thread = threading.Thread(
+            target=_drive_updates, name="serve-bench-updates")
+
     tickets, shed = [], 0
     engine.start()
     try:
         with obs.span("serve_bench.drive"):
             # pacing epoch starts inside the span: the span-enter
             # emission must not make request 0 late against its target
+            if upd_thread is not None:
+                upd_thread.start()
             t0 = time.perf_counter()
             for j in range(n_req):
                 target = t0 + j / args.qps
@@ -861,7 +941,16 @@ def cmd_serve_bench(args):
                     t.result(timeout=max(5.0, 10 * args.slo_ms / 1e3))
                 except Exception:
                     pass   # expired/failed requests are counted below
+            if upd_thread is not None:
+                upd_thread.join()
+                # freshness is judged on a DRAINED queue: every event
+                # that was admitted must reach a publish before the
+                # histograms are read
+                updater.stop(drain_timeout_s=max(
+                    30.0, 10 * args.freshness_slo_ms / 1e3))
     finally:
+        if updater is not None:
+            updater.stop()
         engine.stop()
 
     p50 = obs.histogram_quantile("serving.e2e_seconds", 0.5)
@@ -902,6 +991,80 @@ def cmd_serve_bench(args):
             "foldin_frac": args.foldin_frac,
         },
     }
+    if updater is not None:
+        from tpu_als.serving import build_index
+
+        fr_p50 = obs.histogram_quantile("live.freshness_seconds", 0.5)
+        fr_p99 = obs.histogram_quantile("live.freshness_seconds", 0.99)
+        fr_n = obs.histogram_count("live.freshness_seconds")
+        if fr_n == 0:
+            raise SystemExit("serve-bench: no update event reached a "
+                             "publish — the freshness histogram is "
+                             "empty")
+        modes = {}
+        for e in obs.default_registry()._events:
+            if e.get("type") == "live_update":
+                modes[e["mode"]] = modes.get(e["mode"], 0) + 1
+
+        # publish-cost probe: the incremental path must price as
+        # O(touched rows), not O(catalog).  min-of-3 with device
+        # fencing (rep 1 eats any quantize compile), same touched-row
+        # count a steady-state micro-batch produces.
+        probe = {}
+        idx = engine.published_index
+        if idx is not None:
+            Vcur = np.asarray(model._V, dtype=np.float32)
+            pr = np.arange(min(64, idx.n_items), dtype=np.int64)
+            vr = np.ascontiguousarray(Vcur[pr])
+
+            def _min3(fn):
+                best = float("inf")
+                for _ in range(3):
+                    tp = time.perf_counter()
+                    fn().block_until_ready()
+                    best = min(best, time.perf_counter() - tp)
+                return best
+
+            d_s = _min3(lambda: idx.with_updates(
+                pr, vr, seq=idx.seq + 1))
+            f_s = _min3(lambda: build_index(
+                Vcur, shortlist_k=idx.shortlist_k))
+            probe = {
+                "publish_delta_ms": round(d_s * 1e3, 3),
+                "publish_full_ms": round(f_s * 1e3, 3),
+                "publish_speedup": round(f_s / d_s, 2) if d_s else None,
+                "probe_rows": int(pr.size),
+                "catalog_rows": int(idx.n_items),
+            }
+
+        result.update({
+            "metric": "live_freshness_p99_ms",
+            "value": round(fr_p99 * 1e3, 3),
+            "slo_ms": args.freshness_slo_ms,
+            "slo_met": bool(fr_p99 * 1e3 <= args.freshness_slo_ms),
+            "p50_ms": round(fr_p50 * 1e3, 3),
+            "serve": {
+                "p99_ms": round(p99 * 1e3, 3),
+                "p50_ms": round(p50 * 1e3, 3),
+                "slo_ms": args.slo_ms,
+                "slo_met": bool(p99 * 1e3 <= args.slo_ms),
+            },
+            "live": {
+                "events_scored": int(fr_n),
+                "updates_shed": int(upd_stats["shed"]),
+                "quarantined_rows": int(
+                    obs.counter_value("ingest.quarantined_rows")),
+                "publish_modes": modes,
+                **probe,
+            },
+        })
+        result["config"].update({
+            "update_qps": args.update_qps,
+            "update_items": bool(args.update_items),
+            "update_poison_frac": args.update_poison_frac,
+            "update_max_batch": updater.max_batch,
+            "update_max_wait_ms": updater.max_wait_s * 1e3,
+        })
     print(json.dumps(result))
     if args.bench_json:
         # same provenance contract as bench.py's banked variants: an
@@ -1426,6 +1589,27 @@ def main(argv=None):
     sb.add_argument("--foldin-frac", type=float, default=0.0,
                     help="fraction of requests carrying a fold-in "
                          "factor row instead of a user id")
+    sb.add_argument("--update-qps", type=float, default=0.0,
+                    help="concurrent rating-event rate through the "
+                         "live fold-in → publish pipeline; >0 makes "
+                         "the headline metric live_freshness_p99_ms")
+    sb.add_argument("--freshness-slo-ms", type=float, default=5000.0,
+                    help="arrival → servable p99 target for the live "
+                         "stream (breach dumps the updater's flight "
+                         "ring)")
+    sb.add_argument("--update-poison-frac", type=float, default=0.0,
+                    help="fraction of update events with a non-finite "
+                         "rating — must be quarantined, never folded")
+    sb.add_argument("--update-items", action="store_true",
+                    help="also fold the ITEM side of each micro-batch "
+                         "(exercises the index's incremental delta "
+                         "re-quantization)")
+    sb.add_argument("--update-max-batch", type=int, default=None,
+                    help="live micro-batch cap (default: the "
+                         "planner's live cadence)")
+    sb.add_argument("--update-max-wait-ms", type=float, default=None,
+                    help="live micro-batch deadline (default: the "
+                         "planner's live cadence)")
     sb.add_argument("--seed", type=int, default=0)
     sb.add_argument("--bench-json", default=None, metavar="PATH",
                     help="also bank the result JSON (with banked_at "

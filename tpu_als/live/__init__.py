@@ -1,0 +1,30 @@
+"""Continuous fold-in → publish: rating arrival to servable in seconds.
+
+The live half of BASELINE config 3 ("micro-batches of new ratings →
+incremental user-factor jit update") and ROADMAP item 3's freshness
+target.  The pieces existed in isolation — ``stream/microbatch.py``
+folds factors, ``serving/engine.py`` publishes atomically — and this
+package closes the loop:
+
+- :class:`~tpu_als.live.updater.LiveUpdater` — a background update
+  loop behind a bounded admission queue of rating events (the
+  batcher's deadline/shed vocabulary: a full queue raises the same
+  typed ``Overloaded``), accumulating micro-batches under the
+  planner's ``max_batch``/``max_wait_ms`` cadence, quarantining
+  poisoned events (the ``ingest_quarantined`` contract), folding via
+  ``FoldInServer``, and publishing through
+  ``ServingEngine.publish_update`` — the O(touched rows) incremental
+  path, never a full index rebuild.
+- Freshness is MEASURED, not assumed: every event's arrival →
+  servable latency (its fold-in's publish seq visible to the score
+  path) lands in ``live.freshness_seconds``; an SLO breach dumps the
+  updater's flight-recorder tail (queue_wait/quarantine/foldin/publish
+  span breakdown) into the obs trail.
+
+See docs/serving.md (freshness section) for the lifecycle and knobs,
+and the ``continuous-freshness`` scenario for the end-to-end proof.
+"""
+
+from tpu_als.live.updater import LiveUpdater
+
+__all__ = ["LiveUpdater"]

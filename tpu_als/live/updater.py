@@ -1,0 +1,240 @@
+"""Background update loop: rating events → fold-in → incremental publish.
+
+One thread owns the whole arrival-to-servable path so its latency is a
+single measurable quantity:
+
+1. **Admit.**  ``submit(user, item, rating)`` appends to a bounded
+   queue; at capacity it sheds with the serving batcher's own typed
+   :class:`~tpu_als.serving.batcher.Overloaded` (``live.shed`` counts
+   it) — producers see the identical backpressure contract the request
+   path uses.
+2. **Accumulate.**  The loop gathers up to ``max_batch`` events or
+   until the oldest has waited ``max_wait_ms`` (planner-resolved
+   cadence, ``plan.resolve_live_cadence``), whichever first — the
+   fold-in kernel's fixed cost amortizes over the batch.
+3. **Quarantine.**  Poisoned events (non-finite or out-of-range
+   ratings, ``core.ratings.invalid_rating_mask``) are dropped before
+   they can reach the factors, through the SAME obs contract streaming
+   ingest uses: one ``ingest_quarantined`` event + the
+   ``ingest.quarantined_rows`` counter.
+4. **Fold.**  ``FoldInServer.update`` solves the touched user rows
+   (and ``update_items`` the touched item rows when ``fold_items`` is
+   on — the path that exercises incremental index re-quantization).
+5. **Publish.**  ``ServingEngine.publish_update`` swaps the new
+   generation in atomically — retag for user-only batches, an
+   O(touched) delta re-quantization for item batches — never a full
+   O(catalog) rebuild while the live index is healthy.
+
+Freshness (``live.freshness_seconds``) is per EVENT, arrival →
+publish-visible, so the histogram's p99 is exactly the SLO quantity:
+how stale can a rating be before it influences recommendations.  A
+breach emits ``live_freshness_breach`` and dumps the updater's flight
+ring (queue_wait/quarantine/foldin/publish spans per batch), so the
+trail says WHERE the budget went — queued behind a slow fold-in, or a
+compaction-heavy publish.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from tpu_als import obs
+from tpu_als.core.ratings import invalid_rating_mask
+from tpu_als.obs.trace import FlightRecorder
+from tpu_als.resilience import faults
+from tpu_als.serving.batcher import Overloaded
+
+# the per-batch span breakdown the updater's flight ring carries
+LIVE_SPAN_KEYS = ("queue_wait", "quarantine", "foldin", "publish")
+
+
+class LiveUpdater:
+    """Continuous fold-in → publish over a :class:`FoldInServer` and a
+    :class:`ServingEngine`.
+
+    ``foldin`` wraps the model whose factors are updated; every publish
+    pushes that model's current U/V into ``engine``.  ``fold_items``
+    additionally solves the ITEM side of each batch (new/updated items
+    become recommendable; their rows ride the index's delta segment).
+    ``slo_s`` is the arrival → servable objective; None disables the
+    breach trigger but freshness is always measured.
+    """
+
+    def __init__(self, engine, foldin, *, max_queue=4096,
+                 max_batch=None, max_wait_ms=None, slo_s=None,
+                 fold_items=False, flight_capacity=64):
+        from tpu_als import plan as _plan
+
+        cad = _plan.resolve_live_cadence()
+        self.engine = engine
+        self.foldin = foldin
+        self.max_queue = int(max_queue)
+        self.max_batch = int(max_batch if max_batch is not None
+                             else cad["max_batch"])
+        self.max_wait_s = float(max_wait_ms if max_wait_ms is not None
+                                else cad["max_wait_ms"]) / 1e3
+        self.slo_s = float(slo_s) if slo_s is not None else None
+        self.fold_items = bool(fold_items)
+        self.flight = FlightRecorder(flight_capacity,
+                                     span_keys=LIVE_SPAN_KEYS)
+        self._queue = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = None
+
+    # -- producer side ------------------------------------------------
+    def submit(self, user, item, rating):
+        """Admit one rating event (original user/item ids).  Raises
+        :class:`Overloaded` when the queue is at capacity — the same
+        typed shed the serving batcher raises, so producers share one
+        backpressure contract."""
+        t_arrival = time.perf_counter()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("LiveUpdater is stopped")
+            if len(self._queue) >= self.max_queue:
+                obs.counter("live.shed")
+                raise Overloaded(
+                    f"live update queue at capacity ({self.max_queue})")
+            self._queue.append((user, item, float(rating), t_arrival))
+            self._cond.notify()
+
+    @property
+    def queue_depth(self):
+        with self._cond:
+            return len(self._queue)
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("updater already started")
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-als-live", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain_timeout_s=10.0):
+        """Close admission, drain the queue, join the loop."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(drain_timeout_s)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- update loop --------------------------------------------------
+    def _next_batch(self):
+        """Block for the first event, then accumulate until ``max_batch``
+        or the oldest event has waited ``max_wait_s``.  Returns None on
+        an idle timeout (the loop re-checks for shutdown); a closed,
+        non-empty queue drains immediately (no wait)."""
+        with self._cond:
+            if not self._queue:
+                if self._closed:
+                    return None
+                self._cond.wait(0.05)
+                if not self._queue:
+                    return None
+            t_oldest = self._queue[0][3]
+            while (len(self._queue) < self.max_batch
+                   and not self._closed):
+                left = self.max_wait_s - (time.perf_counter() - t_oldest)
+                if left <= 0:
+                    break
+                self._cond.wait(left)
+            batch = self._queue[:self.max_batch]
+            del self._queue[:self.max_batch]
+            obs.gauge("live.queue_depth", len(self._queue))
+            return batch
+
+    def _run(self):
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                with self._cond:
+                    if self._closed and not self._queue:
+                        return
+                continue
+            try:
+                self._process(batch)
+            except BaseException as e:  # noqa: BLE001 — loop must survive
+                if not isinstance(e, faults.InjectedFault):
+                    obs.emit("warning", what="live.update",
+                             reason=f"{type(e).__name__}: {e}")
+
+    def _process(self, batch):
+        t0 = time.perf_counter()
+        users = np.asarray([e[0] for e in batch])
+        items = np.asarray([e[1] for e in batch])
+        ratings = np.asarray([e[2] for e in batch], dtype=np.float32)
+        arrivals = np.asarray([e[3] for e in batch])
+        queue_wait = t0 - float(arrivals.min())
+
+        # quarantine BEFORE the factors can see a poisoned value — the
+        # streaming-ingest contract, same event + counter vocabulary
+        bad = invalid_rating_mask(ratings)
+        n_bad = int(bad.sum())
+        if n_bad:
+            nonfinite = int((~np.isfinite(ratings)).sum())
+            obs.counter("ingest.quarantined_rows", n_bad)
+            obs.emit("ingest_quarantined", path="live", rows=n_bad,
+                     reasons={"nonfinite": nonfinite,
+                              "out_of_range": n_bad - nonfinite})
+            keep = ~bad
+            users, items = users[keep], items[keep]
+            ratings, arrivals = ratings[keep], arrivals[keep]
+        quarantine_s = time.perf_counter() - t0
+        obs.histogram("live.batch_rows", len(ratings))
+        if len(ratings) == 0:
+            self.flight.record(
+                "quarantined",
+                {"queue_wait": queue_wait, "quarantine": quarantine_s})
+            return
+
+        p = self.foldin.model._params
+        frame = {p["userCol"]: users, p["itemCol"]: items,
+                 p["ratingCol"]: ratings}
+        tf = time.perf_counter()
+        touched_users = self.foldin.update(frame)
+        touched_item_rows = None
+        if self.fold_items:
+            t_items = self.foldin.update_items(frame)
+            touched_item_rows = self.foldin.model._item_map.to_dense(
+                np.asarray(t_items))
+        foldin_s = time.perf_counter() - tf
+
+        tp = time.perf_counter()
+        m = self.foldin.model
+        seq, mode = self.engine.publish_update(
+            m._U, m._V, touched_items=touched_item_rows)
+        publish_s = time.perf_counter() - tp
+
+        done = time.perf_counter()
+        worst = 0.0
+        for a in arrivals:
+            fr = done - float(a)
+            obs.histogram("live.freshness_seconds", fr)
+            worst = max(worst, fr)
+        touched = len(touched_users) + (
+            len(touched_item_rows) if touched_item_rows is not None
+            else 0)
+        obs.emit("live_update", seq=seq, events=len(ratings),
+                 touched=touched, mode=mode)
+        self.flight.record(
+            "ok",
+            {"queue_wait": queue_wait, "quarantine": quarantine_s,
+             "foldin": foldin_s, "publish": publish_s},
+            e2e_seconds=worst, seq=seq, mode=mode)
+        if self.slo_s is not None and worst > self.slo_s:
+            obs.emit("live_freshness_breach", seq=seq,
+                     freshness_seconds=worst, slo_s=self.slo_s)
+            self.flight.dump("freshness_breach")

@@ -106,6 +106,32 @@ METRICS = {
         "rating records routed to the quarantine sink by stream_ingest "
         "or the estimator's input scrub (malformed, non-finite, or "
         "out-of-range) instead of aborting the ingest"),
+    "serving.publish_seconds": (
+        "histogram", "seconds",
+        "wall-clock cost of one model publish, labeled "
+        "mode=full|retag|delta|compact|none — the O(touched)-vs-"
+        "O(catalog) incremental-publish claim is measured here"),
+    "live.freshness_seconds": (
+        "histogram", "seconds",
+        "rating-arrival -> servable: from the event entering the live "
+        "updater's admission queue to its fold-in's publish seq being "
+        "visible to the score path (tpu_als.live.updater)"),
+    "live.batch_rows": (
+        "histogram", "rows",
+        "rating events per live-updater micro-batch (accumulation "
+        "bounded by the planner's max_batch/max_wait_ms cadence)"),
+    "live.shed": (
+        "counter", "events",
+        "rating events refused at the live updater's admission queue "
+        "(queue at capacity; the typed Overloaded the producer sees)"),
+    "live.queue_depth": (
+        "gauge", "events",
+        "live-updater admission backlog sampled after each micro-batch "
+        "dequeue"),
+    "foldin.batch_rows": (
+        "histogram", "rows",
+        "entities solved per FoldInServer micro-batch (the padded "
+        "bucket is the next pow2 above this)"),
     "train.stage_seconds": (
         "histogram", "seconds",
         "fence-timed seconds of one attributed ALS stage (obs.trace."
@@ -245,6 +271,18 @@ EVENTS = {
         "a plan component resolved from the persistent autotune cache: "
         "entry path and how many banked probe verdicts were seeded "
         "into the in-process registry (zero probe executions)"),
+    "live_update": (
+        ("seq", "events", "touched", "mode"),
+        "one per live-updater micro-batch published: the resulting "
+        "publish seq, rating events folded, catalog rows touched, and "
+        "the publish mode (retag|delta|compact|full|none) "
+        "(tpu_als.live.updater)"),
+    "live_freshness_breach": (
+        ("seq", "freshness_seconds", "slo_s"),
+        "a live update's arrival->servable freshness exceeded the SLO; "
+        "the updater's flight-recorder tail (queue_wait/quarantine/"
+        "foldin/publish spans) is dumped alongside with "
+        "trigger='freshness_breach'"),
     "plan_cache_miss": (
         ("key", "component", "reason"),
         "a plan component was not servable from the cache (reason: "

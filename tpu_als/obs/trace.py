@@ -126,22 +126,28 @@ class FlightRecorder:
     ring as a ``flight_record`` event.  A monotonic watermark guarantees
     each record is emitted at most once, so repeated triggers (every
     request breaching a tiny SLO) cost O(new records), not O(ring).
+
+    ``span_keys`` names the breakdown each record carries — the serving
+    request spans by default; the live updater records its own
+    (queue_wait/quarantine/foldin/publish) through the same ring.
     """
 
-    def __init__(self, capacity=64):
+    def __init__(self, capacity=64, span_keys=SPAN_KEYS):
         self._ring = collections.deque(maxlen=int(capacity))
         self._lock = threading.Lock()
+        self._span_keys = tuple(span_keys)
         self._seq = 0
         self._dumped_seq = 0
 
     def record(self, status, spans, *, e2e_seconds=None, path=None,
                **extra):
-        """Append one request trace. ``spans`` maps SPAN_KEYS -> seconds
-        (missing/None = not reached, e.g. a shed never queues)."""
+        """Append one request trace. ``spans`` maps the recorder's span
+        keys -> seconds (missing/None = not reached, e.g. a shed never
+        queues)."""
         with self._lock:
             self._seq += 1
             rec = {"seq": self._seq, "status": status,
-                   "spans": {k: spans.get(k) for k in SPAN_KEYS},
+                   "spans": {k: spans.get(k) for k in self._span_keys},
                    "e2e_seconds": e2e_seconds, "path": path}
             rec.update(extra)
             self._ring.append(rec)

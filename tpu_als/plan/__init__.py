@@ -8,6 +8,7 @@ standalone).  See docs/planner.md.
 
 from tpu_als.plan.cache import PlanCacheCorrupt, SCHEMA_VERSION  # noqa: F401
 from tpu_als.plan.planner import (  # noqa: F401
+    DEFAULT_LIVE_CADENCE,
     GATHER_CANDIDATES,
     ExecutionPlan,
     armed,
@@ -18,6 +19,7 @@ from tpu_als.plan.planner import (  # noqa: F401
     probe_budget_s,
     resolve_execution_plan,
     resolve_gather_strategy,
+    resolve_live_cadence,
     resolve_serving_buckets,
     resolve_topk,
     resolve_training,

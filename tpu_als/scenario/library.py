@@ -21,6 +21,10 @@ into one assertable run each:
 ``preempt-resume``       the chaos_smoke kill-and-resume flow: CLI train
                          preempted at an iteration boundary exits 43,
                          ``--resume auto`` finishes cleanly.
+``continuous-freshness`` sustained rating-event stream (new users/items
+                         + poison) folds in and publishes incrementally
+                         under serve load; freshness p99 ≤ SLO, zero
+                         torn publishes, quarantine from the trail.
 ``flight-recorder``      every request breaches a microsecond SLO; the
                          engine's flight recorder dumps per-request span
                          breakdowns as ``flight_record`` events.
@@ -888,6 +892,194 @@ def _poisoned_stream():
 
 
 # ---------------------------------------------------------------------------
+# continuous-freshness
+
+
+def _cf_start(ctx):
+    import tpu_als
+    from tpu_als.core.ratings import _next_pow2
+    from tpu_als.io.movielens import synthetic_movielens
+    from tpu_als.live import LiveUpdater
+    from tpu_als.serving import ServingEngine
+    from tpu_als.stream.microbatch import FoldInServer
+
+    c = ctx.config
+    frame = synthetic_movielens(c["users"], c["items"], c["nnz"],
+                                seed=c["seed"])
+    model = tpu_als.ALS(rank=c["rank"], maxIter=c["iters"],
+                        regParam=0.05, seed=c["seed"]).fit(frame)
+    engine = ServingEngine(k=c["k"])
+    engine.publish(np.asarray(model._U), np.asarray(model._V))
+    engine.warmup()
+    engine.start()
+    ctx.defer(engine.stop)
+    srv = FoldInServer(model)
+    # the cold-start discipline scaled up: every (rows, width) shape the
+    # sustained stream can produce compiles BEFORE traffic, so measured
+    # freshness is fold-in + publish, never jit.  Both fold directions
+    # (fold_items streams touch the item side too), widths up to 4
+    # (history merge accretes ratings per entity across batches), and
+    # one table doubling of headroom (appended users push the fixed-U
+    # pad past its pow2 mid-stream otherwise).
+    rows, m = [], c["max_batch"]
+    while m >= 1:
+        rows.append(_next_pow2(m))
+        m //= 2
+    srv.prewarm(rows=tuple(sorted(set(rows))), widths=(1, 2, 4),
+                sides=("user", "item"), growth=1)
+    updater = LiveUpdater(
+        engine, srv, max_batch=c["max_batch"],
+        max_wait_ms=c["max_wait_ms"], fold_items=True,
+        slo_s=c["freshness_slo_ms"] / 1e3)
+    updater.start()
+    ctx.defer(updater.stop)           # LIFO: updater stops before engine
+    ctx.state.update(model=model, engine=engine, srv=srv,
+                     updater=updater,
+                     base_items=engine.published_index.n_items)
+
+
+def _cf_stream(ctx):
+    from tpu_als.serving import Overloaded
+
+    c, s = ctx.config, ctx.state
+    model, updater = s["model"], s["updater"]
+    rng = np.random.default_rng(c["seed"] + 1)
+    driver = _LoadDriver(s["engine"],
+                         n_users=np.asarray(model._U).shape[0],
+                         rate_hz=c["serve_qps"], seed=c["seed"])
+    driver.start()
+    user_ids = np.asarray(model._user_map.ids)
+    item_ids = np.asarray(model._item_map.ids)
+    new_user_base = int(user_ids.max()) + 1000
+    new_item_base = int(item_ids.max()) + 1000
+    n_events = max(1, int(c["update_qps"] * c["stream_s"]))
+    # schedule the poison deterministically inside the stream
+    poison_at = set(np.linspace(1, n_events - 1, int(c["poison_events"]),
+                                dtype=int).tolist())
+    shed = 0
+    first_new_user = None
+    t0 = time.perf_counter()
+    for j in range(n_events):
+        delay = (t0 + j / c["update_qps"]) - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        if j in poison_at:
+            ev = (int(rng.choice(user_ids)), int(rng.choice(item_ids)),
+                  float("nan"))
+        elif j % 11 == 3:   # a NEW user joins the service
+            ev = (new_user_base + j, int(rng.choice(item_ids)),
+                  float(rng.uniform(0.5, 5.0)))
+        elif j % 17 == 5:   # a NEW item enters the catalog
+            ev = (int(rng.choice(user_ids)), new_item_base + j,
+                  float(rng.uniform(0.5, 5.0)))
+        else:               # known user rates a known item
+            ev = (int(rng.choice(user_ids)), int(rng.choice(item_ids)),
+                  float(rng.uniform(0.5, 5.0)))
+        try:
+            updater.submit(*ev)
+            if (first_new_user is None and j not in poison_at
+                    and j % 11 == 3):
+                first_new_user = ev[0]
+        except Overloaded:
+            shed += 1
+    # drain: every admitted event must reach a publish before judging
+    deadline = time.perf_counter() + 30.0
+    while updater.queue_depth and time.perf_counter() < deadline:
+        time.sleep(0.02)
+    time.sleep(2.5 * c["max_wait_ms"] / 1e3)   # the in-flight batch
+    driver.stop()
+    ctx.facts.update(events=n_events, update_shed=shed,
+                     answered=driver.answered,
+                     hard_failures=driver.hard_failures)
+    ctx.state["new_user_raw"] = first_new_user
+
+
+def _cf_collect(ctx):
+    from tpu_als import obs
+
+    s = ctx.state
+    reg = obs.default_registry()
+    updates = [e for e in reg._events if e.get("type") == "live_update"]
+    ctx.facts["live_updates"] = len(updates)
+    # zero torn publishes, structurally: every live publish after the
+    # bootstrap one is incremental (retag/delta/compact) — a "full"
+    # mode here would mean the pipeline lost its index and silently
+    # paid O(catalog)
+    ctx.facts["all_incremental"] = bool(updates) and all(
+        e.get("mode") in ("retag", "delta", "compact") for e in updates)
+    # the fold-ins are servable: a user who EXISTS only via the stream
+    # answers from the published tables
+    nur = s.get("new_user_raw")
+    new_dense = (-1 if nur is None else
+                 int(s["model"]._user_map.to_dense(np.array([nur]))[0]))
+    ctx.facts["new_user_known"] = new_dense >= 0
+    if new_dense >= 0:
+        sc, _ = s["engine"].recommend(new_dense, timeout=10.0)
+        ctx.facts["new_user_served"] = bool(
+            np.isfinite(np.asarray(sc)).all())
+    else:
+        ctx.facts["new_user_served"] = False
+    idx = s["engine"].published_index
+    ctx.facts["catalog_grew"] = bool(
+        idx is not None and idx.n_items > s["base_items"])
+
+
+def _continuous_freshness():
+    return ScenarioSpec(
+        name="continuous-freshness",
+        doc="the live pipeline end to end: a sustained rating-event "
+            "stream (new users, new items, poisoned events) folds in "
+            "and publishes INCREMENTALLY under concurrent serve load; "
+            "freshness p99 holds the SLO, every publish after bootstrap "
+            "is retag/delta/compact (zero torn publishes, zero "
+            "O(catalog) rebuilds), and the poison count is re-derivable "
+            "from the obs trail alone.",
+        defaults=dict(seed=13, users=64, items=48, nnz=800, rank=8,
+                      iters=3, k=5, serve_qps=60.0, update_qps=150.0,
+                      stream_s=1.2, max_batch=32, max_wait_ms=25.0,
+                      poison_events=3, freshness_slo_ms=5000.0),
+        phases=(
+            Phase("fit-and-start", _cf_start,
+                  "fit, publish, warm serve + fold-in shapes, start "
+                  "the live updater"),
+            Phase("stream-under-serve", _cf_stream,
+                  "sustained update stream with poison, against live "
+                  "request load; drain before judging"),
+            Phase("collect", _cf_collect,
+                  "freshness, publish modes, and servability from the "
+                  "obs trail"),
+        ),
+        assertions=(
+            Assertion("freshness_p99_under_slo", "quantile",
+                      metric="live.freshness_seconds", q=0.99,
+                      scale_ms=True, op="<=", value="$freshness_slo_ms",
+                      doc="rating-arrival -> servable p99 vs the SLO"),
+            Assertion("zero_torn_publishes", "counter",
+                      metric="serving.fallback_exact", op="==", value=0,
+                      doc="no request ever saw a stale index"),
+            Assertion("all_publishes_incremental", "fact",
+                      fact="all_incremental", op="==", value=True),
+            Assertion("poison_quarantined_exactly", "counter",
+                      metric="ingest.quarantined_rows", op="==",
+                      value="$poison_events",
+                      doc="quarantine count == injected poison, from "
+                          "the counter alone"),
+            Assertion("quarantine_event", "event",
+                      event="ingest_quarantined", op=">=", value=1),
+            Assertion("live_updates_flowed", "event", event="live_update",
+                      op=">=", value=2),
+            Assertion("stream_new_user_served", "fact",
+                      fact="new_user_served", op="==", value=True),
+            Assertion("catalog_grew", "fact", fact="catalog_grew",
+                      op="==", value=True,
+                      doc="new items appended via the delta segment"),
+            Assertion("no_hard_failures", "fact", fact="hard_failures",
+                      op="==", value=0),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 _BUILDERS = (
@@ -899,6 +1091,7 @@ _BUILDERS = (
     _flight_recorder,
     _solver_divergence,
     _poisoned_stream,
+    _continuous_freshness,
 )
 
 SCENARIOS = {s.name: s for s in (b() for b in _BUILDERS)}

@@ -29,12 +29,13 @@ from tpu_als.serving.batcher import (
     bucket_for,
 )
 from tpu_als.serving.engine import NoModelPublished, ServingEngine
-from tpu_als.serving.index import Int8CandidateIndex
+from tpu_als.serving.index import Int8CandidateIndex, build_index
 
 __all__ = [
     "DEFAULT_BUCKETS",
     "DeadlineExceeded",
     "Int8CandidateIndex",
+    "build_index",
     "MicroBatcher",
     "NoModelPublished",
     "Overloaded",

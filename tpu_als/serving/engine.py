@@ -20,8 +20,18 @@ tickets.  The pieces the rest of the stack plugs into:
   ``quantize=False`` after a quantized one, or a ``serving.publish``
   corrupt-mode fault) is never scored against — the batch takes the
   exact path and ``serving.fallback_exact`` counts it.
+- **Incremental publishes.**  :meth:`ServingEngine.publish_update` is
+  the live fold-in → publish path: a user-only fold-in re-tags the
+  current index (zero quantization), an item fold-in re-quantizes ONLY
+  the touched/appended rows into the index's delta segment
+  (``serving/index.py``), and the segment is folded back into the base
+  when it crosses the planner-resolved compaction threshold.  Every
+  mode lands in the ``serving.publish_seconds`` histogram so the
+  O(touched)-vs-O(catalog) publish cost claim is measured, not assumed.
 - **Fault points.**  ``serving.publish`` fires inside publish (corrupt
-  = the new index is tagged stale); ``serving.score`` fires per batch
+  = the fresh index is dropped before the swap — the previous
+  generation's index is carried, stale by seq, or ``None`` on a first
+  publish); ``serving.score`` fires per batch
   (corrupt = treat the index as stale for this batch; raise = the
   injected error fails the batch's tickets, visible to every waiting
   caller).
@@ -123,6 +133,7 @@ class ServingEngine:
             default_deadline_s=default_deadline_s)
         self._model = None              # _Published; swapped atomically
         self._publish_lock = threading.Lock()
+        self._cadence = None            # plan-resolved, on first use
         self._seq = 0
         self._thread = None
         self._stopping = threading.Event()
@@ -138,6 +149,7 @@ class ServingEngine:
         if any, is carried but detected as stale and never used).
         Returns the publish sequence number.
         """
+        t0 = time.perf_counter()
         mode = faults.check("serving.publish")
         U = jnp.asarray(U, dtype=jnp.float32)
         V = jnp.asarray(V, dtype=jnp.float32)
@@ -152,23 +164,135 @@ class ServingEngine:
                 index = Int8CandidateIndex(V, valid, shortlist_k=sk,
                                            seq=seq)
                 if mode == "corrupt":
-                    # injected staleness: the index exists but belongs
-                    # to no live publish — the score path must detect
-                    # the seq mismatch and fall back to exact
-                    index.seq = -1
+                    # injected torn publish: quantization died mid-swap,
+                    # so the fresh index is never published.  The
+                    # previous generation's index is carried (stale by
+                    # seq, detected on the score path) or the publish
+                    # goes out index-less — _Published stays immutable
+                    # either way, no in-place seq mutation.
+                    index = (self._model.index
+                             if self._model is not None else None)
             elif index is None and self._model is not None:
                 index = self._model.index      # carried, now stale
             self._model = _Published(seq, U, V, valid, index)
             self._seq = seq
+        fresh = bool(index is not None and index.seq == seq)
         obs.counter("serving.publishes")
-        obs.emit("serving_publish", seq=seq, items=Ni,
-                 quantized=bool(index is not None and index.seq == seq))
+        obs.histogram("serving.publish_seconds",
+                      time.perf_counter() - t0,
+                      mode="full" if fresh else "none")
+        obs.emit("serving_publish", seq=seq, items=Ni, quantized=fresh,
+                 mode="full" if fresh else "none", delta_rows=0)
         return seq
+
+    def publish_update(self, U, V, *, touched_items=None,
+                       item_valid=None):
+        """Incremental publish after a fold-in: O(touched rows), not
+        O(catalog).  Returns ``(seq, mode)``.
+
+        ``touched_items``: logical catalog rows of ``V`` that changed
+        since the live publish (item fold-in); rows beyond the previous
+        catalog size are treated as appended automatically, so a pure
+        catalog-growth publish may pass ``touched_items=None``.  The
+        caller guarantees every OTHER row of ``V`` is unchanged — the
+        engine layers only the named/appended rows over the live index
+        (``Int8CandidateIndex.with_updates``).  Modes:
+
+        - ``retag``  — nothing in the catalog changed (user-only
+          fold-in): the live index is carried fresh, zero quantization;
+        - ``delta``  — touched/appended rows quantized into the delta
+          segment;
+        - ``compact``— the segment crossed the planner-resolved
+          threshold and was folded back into the base (memcpy-class);
+        - ``full``   — no usable live index (first publish, stale or
+          exact-mode predecessor, catalog shrank, or a malformed
+          update) → ordinary full rebuild;
+        - ``none``   — catalog too small to index; serving stays exact.
+        """
+        t0 = time.perf_counter()
+        U = jnp.asarray(U, dtype=jnp.float32)
+        # keep a host handle: the delta path gathers only the touched
+        # rows, and doing that in numpy costs O(touched) with no
+        # shape-varying device executable (a jnp gather would compile
+        # per distinct row-count — a recompile on every publish)
+        Vh = (V if isinstance(V, np.ndarray)
+              else np.asarray(V, dtype=np.float32))
+        V = jnp.asarray(V, dtype=jnp.float32)
+        Ni = int(V.shape[0])
+        valid_h = (np.ones(Ni, dtype=bool) if item_valid is None
+                   else np.asarray(item_valid, dtype=bool))
+        valid = jnp.asarray(valid_h)
+        touched = (np.empty(0, dtype=np.int64) if touched_items is None
+                   else np.unique(np.asarray(touched_items,
+                                             dtype=np.int64).ravel()))
+        cad = self._live_cadence()
+        with self._publish_lock:
+            seq = self._seq + 1
+            prev = self._model
+            cur = prev.index if prev is not None else None
+            index, mode = None, "full"
+            if (cur is not None and cur.seq == prev.seq
+                    and cur.n_items <= Ni):
+                try:
+                    if touched.size == 0 and Ni == cur.n_items:
+                        index, mode = cur.retag(seq), "retag"
+                    else:
+                        rows = np.union1d(touched,
+                                          np.arange(cur.n_items, Ni))
+                        if rows.size and int(rows[-1]) >= Ni:
+                            raise ValueError(
+                                f"touched row {int(rows[-1])} outside "
+                                f"the catalog [0, {Ni})")
+                        vrs = np.ascontiguousarray(
+                            Vh[rows], dtype=np.float32)
+                        vls = valid_h[rows]
+                        index = cur.with_updates(rows, vrs,
+                                                 valid_rows=vls, seq=seq)
+                        mode = "delta"
+                        if index.delta_count >= max(
+                                cad["compact_min_rows"],
+                                cad["compact_delta_frac"] * index.n_base):
+                            index, mode = index.compact(seq), "compact"
+                except ValueError as e:
+                    obs.emit("warning", what="serving.publish_update",
+                             reason=f"delta rejected, full rebuild: {e}")
+                    index, mode = None, "full"
+            if index is None:
+                sk = min(max(self.shortlist_k, self.k), Ni)
+                if sk >= self.k and Ni > 0:
+                    index = Int8CandidateIndex(V, valid,
+                                               shortlist_k=sk, seq=seq)
+                else:
+                    mode = "none"
+            self._model = _Published(seq, U, V, valid, index)
+            self._seq = seq
+        obs.counter("serving.publishes")
+        obs.histogram("serving.publish_seconds",
+                      time.perf_counter() - t0, mode=mode)
+        obs.emit("serving_publish", seq=seq, items=Ni,
+                 quantized=bool(index is not None), mode=mode,
+                 delta_rows=(index.delta_count
+                             if index is not None else 0))
+        return seq, mode
+
+    def _live_cadence(self):
+        if self._cadence is None:
+            from tpu_als import plan as _plan
+
+            self._cadence = _plan.resolve_live_cadence()
+        return self._cadence
 
     @property
     def published_seq(self):
         m = self._model
         return m.seq if m is not None else 0
+
+    @property
+    def published_index(self):
+        """The live generation's candidate index (None before the first
+        publish or while serving exact)."""
+        m = self._model
+        return m.index if m is not None else None
 
     def warmup(self):
         """Compile every (bucket, path) scoring executable now, against
@@ -190,6 +314,42 @@ class ServingEngine:
                     item_chunk=min(self.item_chunk,
                                    max(m.V.shape[0], 1)))
             s.block_until_ready()
+
+    def warmup_live(self, max_delta_rows=None):
+        """Compile the DELTA-path scoring executables incremental
+        publishes can produce — one per (bucket, delta-pad) pair —
+        before any live traffic, so a growing delta segment never puts
+        a compile on the request path.
+
+        Delta pads are the power-of-two ladder up to
+        ``max_delta_rows`` (default: the planner cadence's compaction
+        threshold plus one max_batch — the largest segment a publish
+        can carry before ``publish_update`` folds it back into the
+        base).  Cheap no-op when the model serves exact.
+        """
+        m = self._model
+        if m is None:
+            raise NoModelPublished("publish(U, V) before warmup")
+        idx = m.index
+        if idx is None or idx.seq != m.seq:
+            return
+        if max_delta_rows is None:
+            cad = self._live_cadence()
+            max_delta_rows = int(
+                max(cad["compact_min_rows"],
+                    cad["compact_delta_frac"] * idx.n_base)
+                + cad["max_batch"])
+        Vh = np.asarray(m.V, dtype=np.float32)
+        d = 1
+        while d <= min(max_delta_rows * 2 - 1, idx.n_items):
+            rows = np.arange(d, dtype=np.int64)
+            dummy = idx.with_updates(
+                rows, np.ascontiguousarray(Vh[rows]), seq=idx.seq)
+            for B in self.batcher.buckets:
+                s, _ = dummy.topk(
+                    jnp.zeros((B, m.rank), jnp.float32), self.k)
+                s.block_until_ready()
+            d <<= 1
 
     # -- request path -------------------------------------------------
     def submit(self, payload, k=None, deadline_s=None):

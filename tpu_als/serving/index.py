@@ -30,6 +30,36 @@ bounds the score error by ``~|u||v| r / 127``; a ``shortlist_k`` of a
 few times ``k`` absorbs it on real factor distributions, and callers
 that need certainty can set ``shortlist_k >= n_items`` (the shortlist
 then covers the catalog and equality is unconditional).
+
+Incremental re-quantization (the live fold-in → publish loop): a
+publish that changed 12 catalog rows must not re-quantize 50M.
+:meth:`Int8CandidateIndex.with_updates` quantizes ONLY the
+touched/appended rows into a small **delta segment** layered over the
+untouched base arrays — O(touched) quantization work per publish —
+and :meth:`compact` periodically folds the segment back into the base
+(a memcpy-class scatter, no re-quantization at all).  The pinned
+contract (``live_delta_index`` in analysis/contracts.py, property
+matrix in tests/test_live.py): delta-segment and compacted ``topk``
+are BITWISE equal to a full :func:`build_index` rebuild of the updated
+catalog, under the same true-top-k-survives-the-shortlist condition as
+the base contract.  Three ingredients make that exact rather than
+approximate:
+
+- per-row symmetric quantization has no cross-row state, so a touched
+  row quantized alone is bit-identical to the same row quantized
+  inside a full-catalog rebuild;
+- the int8 shortlist GEMM accumulates in **int32** — exact integer
+  arithmetic, order-independent — so scoring the base and the delta
+  segment as two GEMMs yields approx scores elementwise bitwise equal
+  to the rebuild's single GEMM, and the shortlist selects the same
+  candidate value-set;
+- the exact rescore keeps the base path's ``nr,cr->nc`` contraction at
+  the same ``[n, n*shortlist_k]`` shapes, gathering candidate columns
+  from base or delta by position.
+
+Base rows overridden by the delta are masked to ``NEG_INF`` in the
+base GEMM (their fresh values live in the segment), so a row is never
+scored twice and never scored stale.
 """
 
 from __future__ import annotations
@@ -40,6 +70,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_als.core.ratings import _next_pow2
 from tpu_als.ops.topk import NEG_INF
 
 
@@ -76,6 +107,55 @@ def _int8_topk(U, Vq, sv, V, valid, k, shortlist_k):
     return s, jnp.take_along_axis(cand, sel, axis=1)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "shortlist_k"))
+def _int8_topk_delta(U, Vq, sv, V, valid, drows, dVq, dsv, dV, dvalid,
+                     last_id, k, shortlist_k):
+    """The base kernel with a delta segment: two int8 GEMMs (base +
+    segment), overridden base columns masked, one shortlist over the
+    concatenated approx scores, and the SAME-shaped exact rescore as
+    the base path (see module docstring for why this stays bitwise).
+
+    ``drows`` maps segment slots to logical catalog ids; padding slots
+    carry ``n_base`` (out of base scatter range, ``dvalid`` False).
+    ``last_id`` clamps returned ids into the logical catalog.
+    """
+    n = U.shape[0]
+    nb = Vq.shape[0]
+    d = dVq.shape[0]
+    Uq, su = _quantize_rows(U)
+    acc = jnp.einsum("nr,cr->nc", Uq, Vq,
+                     preferred_element_type=jnp.int32)
+    approx_b = acc.astype(jnp.float32) * su[:, None] * sv[None, :]
+    # a base row the segment overrides (or an appended id, out of base
+    # range and dropped) must never shortlist from its stale value
+    over = jnp.zeros((nb,), jnp.bool_).at[drows].set(True, mode="drop")
+    approx_b = jnp.where((valid & ~over)[None, :], approx_b, NEG_INF)
+    acc_d = jnp.einsum("nr,cr->nc", Uq, dVq,
+                       preferred_element_type=jnp.int32)
+    approx_d = acc_d.astype(jnp.float32) * su[:, None] * dsv[None, :]
+    approx_d = jnp.where(dvalid[None, :], approx_d, NEG_INF)
+    approx = jnp.concatenate([approx_b, approx_d], axis=1)
+    _, cand = jax.lax.top_k(approx, shortlist_k)    # positions in nb+d
+    flat = cand.reshape(-1)
+    in_base = flat < nb
+    base_ix = jnp.minimum(flat, nb - 1)
+    delta_ix = jnp.clip(flat - nb, 0, d - 1)
+    Vc = jnp.where(in_base[:, None], jnp.take(V, base_ix, axis=0),
+                   jnp.take(dV, delta_ix, axis=0))  # [n*sk, r]
+    exact_all = jnp.einsum("nr,cr->nc", U, Vc,
+                           preferred_element_type=jnp.float32)
+    rows = (jnp.arange(n, dtype=jnp.int32)[:, None] * shortlist_k
+            + jnp.arange(shortlist_k, dtype=jnp.int32)[None, :])
+    exact = jnp.take_along_axis(exact_all, rows, axis=1)
+    cand_ok = jnp.where(in_base, jnp.take(valid & ~over, base_ix),
+                        jnp.take(dvalid, delta_ix))
+    exact = jnp.where(cand_ok.reshape(n, shortlist_k), exact, NEG_INF)
+    s, sel = jax.lax.top_k(exact, k)
+    logical = jnp.where(in_base, flat, jnp.take(drows, delta_ix))
+    logical = jnp.minimum(logical, last_id).reshape(n, shortlist_k)
+    return s, jnp.take_along_axis(logical, sel, axis=1)
+
+
 class Int8CandidateIndex:
     """Quantize-once-per-publish candidate index over the item factors.
 
@@ -98,10 +178,172 @@ class Int8CandidateIndex:
         self.n_items = Ni
         self.shortlist_k = min(int(shortlist_k), Ni)
         self.seq = seq
+        self._clear_delta()
+
+    # -- delta segment (incremental re-quantization) -------------------
+
+    def _clear_delta(self):
+        # host-side merged delta state (small: O(delta rows)); the
+        # padded device mirrors the kernel consumes are built lazily
+        self.d_rows = np.empty(0, dtype=np.int64)
+        self._dV = np.empty((0, int(self.V.shape[1])), dtype=np.float32)
+        self._dVq = np.empty((0, int(self.V.shape[1])), dtype=np.int8)
+        self._dsv = np.empty(0, dtype=np.float32)
+        self._dvalid = np.empty(0, dtype=bool)
+        self._dev_delta = None
+
+    @property
+    def n_base(self):
+        """Rows held by the base (pre-delta) arrays."""
+        return int(self.Vq.shape[0])
+
+    @property
+    def delta_count(self):
+        """Rows currently carried by the delta segment."""
+        return int(self.d_rows.size)
+
+    def _copy_shell(self, seq):
+        new = object.__new__(Int8CandidateIndex)
+        new.V, new.valid = self.V, self.valid
+        new.Vq, new.sv = self.Vq, self.sv
+        new.n_items = self.n_items
+        new.shortlist_k = self.shortlist_k
+        new.seq = self.seq if seq is None else int(seq)
+        new.d_rows = self.d_rows
+        new._dV, new._dVq = self._dV, self._dVq
+        new._dsv, new._dvalid = self._dsv, self._dvalid
+        new._dev_delta = self._dev_delta
+        return new
+
+    def retag(self, seq):
+        """A shallow copy sharing every array, tagged for a new publish.
+
+        The zero-cost incremental publish: a USER fold-in changes no
+        catalog row, so the index is carried FRESH (scored against)
+        instead of rebuilt or marked stale.  Instances are treated as
+        immutable — the engine never re-tags in place.
+        """
+        return self._copy_shell(seq)
+
+    def with_updates(self, rows, V_rows, valid_rows=None, seq=None):
+        """A new index with ``rows`` of the catalog re-quantized into
+        the delta segment — O(len(rows)) quantization work, the base
+        arrays shared untouched.
+
+        ``rows`` are logical catalog ids; ids ``>= n_items`` APPEND
+        (catalog growth from an item fold-in) and must leave no hole
+        above the current catalog size.  A row already in the segment
+        is replaced (newest wins).  Quantizing only the touched rows is
+        bitwise-identical to a full rebuild because quantization is
+        strictly per-row (the ``live_delta_index`` contract).
+        """
+        rows = np.asarray(rows, dtype=np.int64).ravel()
+        r = int(self.V.shape[1])
+        V_rows = np.asarray(V_rows, dtype=np.float32).reshape(len(rows), r)
+        valid_rows = (np.ones(len(rows), dtype=bool) if valid_rows is None
+                      else np.asarray(valid_rows, dtype=bool).ravel())
+        if len(rows) == 0:
+            return self._copy_shell(seq)
+        if rows.min() < 0:
+            raise ValueError("negative catalog row id in delta update")
+        # newest-wins dedup inside the call: keep each id's LAST row
+        uniq, first_rev = np.unique(rows[::-1], return_index=True)
+        last = len(rows) - 1 - first_rev
+        rows, V_rows, valid_rows = uniq, V_rows[last], valid_rows[last]
+        n_new = int(max(self.n_items, int(rows.max()) + 1))
+        appended = rows[rows >= self.n_items]
+        if len(appended) != n_new - self.n_items:
+            gap = sorted(set(range(self.n_items, n_new))
+                         - set(appended.tolist()))
+            raise ValueError(
+                f"append gap: ids {gap} missing — appended rows must "
+                "be contiguous above the current catalog")
+        # quantize ONLY the touched rows, padded to pow2 so repeated
+        # delta publishes hit a bounded jit cache
+        n_pad = _next_pow2(len(rows))
+        Vp = np.zeros((n_pad, r), dtype=np.float32)
+        Vp[:len(rows)] = V_rows
+        q, s = _quantize_rows(jnp.asarray(Vp))
+        q = np.asarray(q)[:len(rows)]
+        s = np.asarray(s)[:len(rows)]
+        new = self._copy_shell(seq)
+        new.n_items = n_new
+        if self.d_rows.size:       # merge: older entries for the same
+            keep = ~np.isin(self.d_rows, rows)   # id are superseded
+            new.d_rows = np.concatenate([self.d_rows[keep], rows])
+            new._dV = np.concatenate([self._dV[keep], V_rows])
+            new._dVq = np.concatenate([self._dVq[keep], q])
+            new._dsv = np.concatenate([self._dsv[keep], s])
+            new._dvalid = np.concatenate([self._dvalid[keep], valid_rows])
+        else:
+            new.d_rows, new._dV, new._dVq = rows, V_rows, q
+            new._dsv, new._dvalid = s, valid_rows
+        new._dev_delta = None
+        return new
+
+    def compact(self, seq=None):
+        """Fold the delta segment back into the base arrays.
+
+        A memcpy-class scatter — the segment's already-quantized rows
+        are placed, nothing is re-quantized — yielding arrays bitwise
+        equal to a full :func:`build_index` rebuild of the updated
+        catalog, and scoring through the identical base kernel again.
+        """
+        if not self.d_rows.size:
+            return self._copy_shell(seq)
+        r = int(self.V.shape[1])
+        grow = self.n_items - self.n_base
+        V, Vq, sv, valid = self.V, self.Vq, self.sv, self.valid
+        if grow:
+            V = jnp.concatenate([V, jnp.zeros((grow, r), jnp.float32)])
+            Vq = jnp.concatenate([Vq, jnp.zeros((grow, r), jnp.int8)])
+            sv = jnp.concatenate([sv, jnp.ones(grow, jnp.float32)])
+            valid = jnp.concatenate([valid, jnp.zeros(grow, jnp.bool_)])
+        ix = jnp.asarray(self.d_rows, dtype=jnp.int32)
+        new = self._copy_shell(seq)
+        new.V = V.at[ix].set(jnp.asarray(self._dV))
+        new.Vq = Vq.at[ix].set(jnp.asarray(self._dVq))
+        new.sv = sv.at[ix].set(jnp.asarray(self._dsv))
+        new.valid = valid.at[ix].set(jnp.asarray(self._dvalid))
+        new._clear_delta()
+        return new
+
+    def _device_delta(self):
+        """Padded device mirrors of the segment (built once per delta
+        generation; padding slots carry id ``n_base`` — dropped by the
+        kernel's scatter — and ``valid=False``)."""
+        if self._dev_delta is None:
+            d = self.delta_count
+            d_pad = _next_pow2(d)
+            r = int(self.V.shape[1])
+            rows = np.full(d_pad, self.n_base, dtype=np.int32)
+            rows[:d] = self.d_rows
+            dV = np.zeros((d_pad, r), dtype=np.float32)
+            dV[:d] = self._dV
+            dVq = np.zeros((d_pad, r), dtype=np.int8)
+            dVq[:d] = self._dVq
+            dsv = np.ones(d_pad, dtype=np.float32)
+            dsv[:d] = self._dsv
+            dvalid = np.zeros(d_pad, dtype=bool)
+            dvalid[:d] = self._dvalid
+            self._dev_delta = (jnp.asarray(rows), jnp.asarray(dVq),
+                               jnp.asarray(dsv), jnp.asarray(dV),
+                               jnp.asarray(dvalid))
+        return self._dev_delta
+
+    def block_until_ready(self):
+        """Fence every device array this index owns (bench timing)."""
+        arrs = [self.V, self.valid, self.Vq, self.sv]
+        if self.delta_count:
+            arrs.extend(self._device_delta())
+        jax.block_until_ready(arrs)
+        return self
 
     def nbytes_quantized(self):
         """HBM the shortlist pass reads per batch (vs 4x for f32)."""
-        return int(np.prod(self.Vq.shape)) + 4 * self.n_items
+        base = int(np.prod(self.Vq.shape)) + 4 * self.n_base
+        r = int(self.V.shape[1])
+        return base + self.delta_count * (r + 4)
 
     def topk(self, U, k, shortlist_k=None):
         """Top-k of ``U @ V.T`` via int8 shortlist + exact f32 rescore.
@@ -109,7 +351,9 @@ class Int8CandidateIndex:
         Returns ``(scores [n, k], indices [n, k])`` matching
         ``chunked_topk_scores`` bitwise (see module docstring for the
         conditions).  ``k`` is capped by the shortlist, the shortlist by
-        the catalog.
+        the catalog.  With a delta segment live the shortlist runs over
+        base + segment; without one this is byte-for-byte the original
+        single-kernel path.
         """
         sk = self.shortlist_k if shortlist_k is None else \
             min(int(shortlist_k), self.n_items)
@@ -117,6 +361,23 @@ class Int8CandidateIndex:
             raise ValueError(
                 f"k={k} exceeds shortlist_k={sk}; the shortlist must "
                 "contain at least k candidates")
-        return _int8_topk(jnp.asarray(U, dtype=jnp.float32),
-                          self.Vq, self.sv, self.V, self.valid,
-                          k=int(k), shortlist_k=sk)
+        U = jnp.asarray(U, dtype=jnp.float32)
+        if not self.delta_count:
+            return _int8_topk(U, self.Vq, self.sv, self.V, self.valid,
+                              k=int(k), shortlist_k=sk)
+        drows, dVq, dsv, dV, dvalid = self._device_delta()
+        return _int8_topk_delta(
+            U, self.Vq, self.sv, self.V, self.valid,
+            drows, dVq, dsv, dV, dvalid,
+            jnp.int32(self.n_items - 1), k=int(k), shortlist_k=sk)
+
+
+def build_index(V, item_valid=None, shortlist_k=64, seq=0):
+    """Full-rebuild reference: quantize the ENTIRE catalog from scratch.
+
+    O(catalog) — what every publish cost before the delta segment, and
+    the bitwise reference the ``live_delta_index`` contract judges
+    :meth:`Int8CandidateIndex.with_updates` / :meth:`compact` against.
+    """
+    return Int8CandidateIndex(V, item_valid=item_valid,
+                              shortlist_k=shortlist_k, seq=seq)

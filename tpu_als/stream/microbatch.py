@@ -19,6 +19,7 @@ between refits.
 
 from __future__ import annotations
 
+import collections
 import time
 
 import numpy as np
@@ -32,10 +33,28 @@ from tpu_als.ops.solve import compute_yty
 from tpu_als.utils.frame import as_frame
 
 
+def _pad_rows_pow2(F):
+    """Pad a factor table to a power-of-two row count with zero rows.
+
+    The fold-in kernel only ever GATHERS rows of the fixed side (by
+    dense ids < the real row count) and, on the implicit path, reads
+    ``F^T F`` — zero rows change neither.  Without this, every
+    appended entity changes the table's leading dim and the jitted
+    solve recompiles per micro-batch (a compile treadmill the live
+    pipeline's freshness SLO cannot absorb); with it, compiles happen
+    only at doublings."""
+    n = int(F.shape[0])
+    n_pad = _next_pow2(n)
+    if n_pad == n:
+        return F
+    return jnp.concatenate(
+        [F, jnp.zeros((n_pad - n, F.shape[1]), F.dtype)])
+
+
 class FoldInServer:
     """Incremental user-factor updates against a fitted model."""
 
-    def __init__(self, model, keep_history=True):
+    def __init__(self, model, keep_history=True, stats_window=512):
         self.model = model
         self.keep_history = keep_history
         self._history = {}  # original user id -> (item_dense[], rating[])
@@ -45,11 +64,15 @@ class FoldInServer:
         self._implicit = bool(p.get("implicitPrefs", False))
         self._alpha = float(p.get("alpha", 1.0))
         self._nonnegative = bool(p.get("nonnegative", False))
-        self._V = jnp.asarray(model._V)
+        self._V = _pad_rows_pow2(jnp.asarray(model._V))
         self._YtY = compute_yty(self._V) if self._implicit else None
-        self.stats = []  # (batch_size, touched_users, latency_seconds)
+        # (batch_size, touched_users, latency_seconds) — bounded: a
+        # long-lived live pipeline folds in forever, and the durable
+        # record is the registered obs histograms, not this ring
+        self.stats = collections.deque(maxlen=int(stats_window))
 
-    def prewarm(self, rows=(256, 512, 1024), widths=(2, 4, 8, 16, 32)):
+    def prewarm(self, rows=(256, 512, 1024), widths=(2, 4, 8, 16, 32),
+                sides=("user",), growth=0):
         """Pre-compile the fold-in kernel for a (rows, width) shape grid.
 
         ``update`` pads batches to power-of-two shapes, so the jit cache
@@ -58,18 +81,38 @@ class FoldInServer:
         a run (observed: p95 11x p50 on the first 30 batches).  Serving
         deployments call this once at startup with the shapes their
         batch size implies; entries are cached per process.
+
+        ``sides`` picks the fold directions to compile ("user" solves
+        against the item table, "item" against the user table — a live
+        pipeline with ``fold_items`` needs both).  ``growth`` also
+        compiles against the fixed table padded up that many extra
+        doublings: a stream that appends entities eventually pushes the
+        fixed side past its current pow2 pad, and that recompile should
+        be paid here, not mid-stream against a freshness SLO.  Shapes
+        shared between sides (equal table pads) hit the same jit-cache
+        entry, so requesting both costs no duplicate compiles.
         """
-        for n in rows:
-            for w in widths:
-                fold_in(
-                    self._V,
-                    jnp.zeros((n, w), jnp.int32),
-                    jnp.zeros((n, w), jnp.float32),
-                    jnp.zeros((n, w), jnp.float32),
-                    self._reg, implicit_prefs=self._implicit,
-                    alpha=self._alpha, nonnegative=self._nonnegative,
-                    YtY=self._YtY,
-                ).block_until_ready()
+        for side in sides:
+            F0 = (self._V if side == "user"
+                  else _pad_rows_pow2(jnp.asarray(self.model._U)))
+            for g in range(int(growth) + 1):
+                n_pad = int(F0.shape[0]) << g
+                F = (F0 if g == 0 else jnp.concatenate(
+                    [F0, jnp.zeros((n_pad - int(F0.shape[0]),
+                                    F0.shape[1]), F0.dtype)]))
+                YtY = compute_yty(F) if self._implicit else None
+                for n in rows:
+                    for w in widths:
+                        fold_in(
+                            F,
+                            jnp.zeros((n, w), jnp.int32),
+                            jnp.zeros((n, w), jnp.float32),
+                            jnp.zeros((n, w), jnp.float32),
+                            self._reg, implicit_prefs=self._implicit,
+                            alpha=self._alpha,
+                            nonnegative=self._nonnegative,
+                            YtY=YtY,
+                        ).block_until_ready()
 
     def update(self, batch):
         """Process one micro-batch frame (userCol/itemCol/ratingCol of the
@@ -150,7 +193,7 @@ class FoldInServer:
             # grown — read it live (one transfer per item batch; item
             # batches are the rare direction, so this stays off the
             # user hot path)
-            F = jnp.asarray(m._U)
+            F = _pad_rows_pow2(jnp.asarray(m._U))
             YtY = compute_yty(F) if self._implicit else None
         else:
             F, YtY = self._V, self._YtY
@@ -163,12 +206,14 @@ class FoldInServer:
         self._write_back(touched, x, items_side)
         if items_side:
             # refresh the serving-side cache the USER fold-in path reads
-            self._V = jnp.asarray(m._V)
+            self._V = _pad_rows_pow2(jnp.asarray(m._V))
             if self._implicit:
                 self._YtY = compute_yty(self._V)
         dt = time.perf_counter() - t0
         self.stats.append((len(solved_raw), n, dt))
         obs.histogram("foldin.update_seconds", dt,
+                      side="item" if items_side else "user")
+        obs.histogram("foldin.batch_rows", n,
                       side="item" if items_side else "user")
         obs.counter("foldin.ratings", len(solved_raw))
         return touched
@@ -198,7 +243,9 @@ class FoldInServer:
     def latency(self, q=0.5, skip_warmup=False):
         """Latency quantile over processed batches.  ``skip_warmup`` drops
         the first batch (jit compile) — what latency benchmarks want."""
-        stats = self.stats[1:] if skip_warmup else self.stats
+        stats = list(self.stats)
+        if skip_warmup:
+            stats = stats[1:]
         lat = sorted(s[2] for s in stats)
         if not lat:
             return float("nan")

@@ -69,11 +69,17 @@ DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
 #   own short step — it was only reachable inside the 1200s headline_ab
 #   omnibus, which never fit a window (tests/test_roofline.py pins the
 #   modeled waste reduction; this banks the measured iters/sec).
+#   Round-8 reorder: cg2_headline moved to the BACK of the queue — its
+#   number is already banked (headline_cg2.out, 0.810 iters/sec, 08:32
+#   window) and the A/B driver skips banked variants, so a re-run only
+#   buys a confirmation; it must not claim a short window ahead of
+#   unmeasured steps.  ml100k's timeout 300s -> 480s: the 08:3x windows
+#   showed data staging + compile alone can eat ~4 minutes, so 300s was
+#   timing out runs that were seconds from banking.
 STEPS=(
-  "cg2_headline|700|python bench.py --no-auto-config --iters 5 --ab cg2 --ab-dir sweep_logs --probe-attempts 1"
   "gather_headline|700|python bench.py --no-auto-config --iters 5 --ab gather --ab-dir sweep_logs --probe-attempts 1"
   "wg15_headline|700|python bench.py --no-auto-config --iters 5 --ab wg15 --ab-dir sweep_logs --probe-attempts 1"
-  "ml100k|300|python bench.py --no-auto-config --mode ml100k --probe-attempts 1"
+  "ml100k|480|python bench.py --no-auto-config --mode ml100k --probe-attempts 1"
   "reconfirm_f32|580|python bench.py --no-auto-config --iters 5 --probe-attempts 1"
   "headline_ab|1200|python bench.py --no-auto-config --iters 5 --ab bf16,wg15,bf16_wg15,cg2_bf16,cg3,cg2_dense,cg2 --ab-dir sweep_logs --probe-attempts 1"
   "overlap_ab|1200|python bench.py --no-auto-config --iters 5 --ab ringdb,agchunk --ab-dir sweep_logs --probe-attempts 1"
@@ -88,6 +94,7 @@ STEPS=(
   "kernel_lab_r256|580|python scripts/kernel_lab.py --rank 256 --n 8192 --panels 4 8 16"
   "ablate_full_cg2|900|python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2"
   "twotower_20ep|1500|python bench.py --no-auto-config --mode twotower --probe-attempts 1"
+  "cg2_headline|700|python bench.py --no-auto-config --iters 5 --ab cg2 --ab-dir sweep_logs --probe-attempts 1"
 )
 
 step_ok() {  # decide DONE from the step's .out: bench JSON without error,

@@ -1,0 +1,101 @@
+#!/bin/bash
+# Tenancy smoke: the multi-tenant control plane's CI gate, CPU-only
+# (no accelerator, no network).  Four stages, fail-fast:
+#
+#   1. the tenancy test tier — registry/spec contracts, stride
+#      fair-share policy (weighted goodput, virtual-clock join floor),
+#      typed per-tenant shedding, per-batch fault isolation, the
+#      tenant label vocabulary (runtime + static), seq-space
+#      namespacing — plus the serving companions every tenant engine
+#      publishes through,
+#   2. the static checks — the obs-schema shim (tenancy.* metrics,
+#      tenant_registered/tenant_removed events, the serving.*/live.*
+#      tenant-label pins) plus the analysis gate (scripts/lint_smoke.sh)
+#      and the tenant-isolation scenario run end to end: the fault
+#      matrix (torn publish, poisoned stream, guardrail rollback, 10x
+#      spike) lands on tenant A while tenant B must stay bitwise-equal
+#      to its solo run,
+#   3. one END-TO-END 3-tenant serve-bench with per-tenant live update
+#      streams, judged per tenant (every tenant's p99 in SLO, weighted
+#      goodput fairness ratio bounded), banked with banked_at
+#      provenance and sanity-checked,
+#   4. the bench regression gate over the committed result banks
+#      (scripts/bench_gate.sh — regressions, null banks, missing
+#      provenance all exit non-zero).
+#
+# Usage: scripts/tenancy_smoke.sh   (from the repo root; ~2 min on CPU)
+set -u
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+fail=0
+
+echo "== tenancy smoke 1/4: tenancy test tier =="
+python -m pytest tests/test_tenancy.py tests/test_serving.py \
+    tests/test_live.py -q -m 'not slow' -p no:cacheprovider || fail=1
+
+echo "== tenancy smoke 2/4: static checks + tenant-isolation scenario =="
+python scripts/check_obs_schema.py || fail=1
+scripts/lint_smoke.sh || fail=1
+python -m tpu_als.cli scenario run tenant-isolation || fail=1
+
+echo "== tenancy smoke 3/4: end-to-end 3-tenant serve-bench =="
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+python -m tpu_als.cli serve-bench \
+    --tenants 3 --users 1000 --items 3000 --rank 32 --k 10 \
+    --shortlist-k 64 --qps 90 --duration 4 --slo-ms 2000 \
+    --max-wait-ms 2 --update-qps 45 --update-max-batch 16 \
+    --freshness-slo-ms 10000 --fairness-bound 1.5 \
+    --bench-json "$work/BENCH_tenancy_smoke.json" \
+    >"$work/tenancy.out" 2>"$work/tenancy.log"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "FAIL: serve-bench --tenants exited $rc" >&2
+    tail -5 "$work/tenancy.log" >&2
+    fail=1
+else
+    python - "$work/BENCH_tenancy_smoke.json" <<'EOF' || fail=1
+import json, sys
+
+r = json.load(open(sys.argv[1]))
+problems = []
+if r["metric"] != "tenancy_worst_p99_ms":
+    problems.append(f"unexpected metric {r['metric']!r}")
+if not r["slo_met"]:
+    problems.append(
+        f"worst per-tenant p99 {r['value']}ms / fairness "
+        f"{r['fairness_ratio']} blew the loose SLO "
+        f"({r['slo_ms']}ms, bound {r['fairness_bound']})")
+tenants = r["tenants"]
+if len(tenants) != 3:
+    problems.append(f"expected 3 tenants, report carries {len(tenants)}")
+for name, t in tenants.items():
+    if not t["scored"]:
+        problems.append(f"tenant {name}: no request completed")
+    if not t["slo_met"]:
+        problems.append(f"tenant {name}: p99 {t['p99_ms']}ms out of SLO")
+    if not t.get("publish_modes"):
+        problems.append(f"tenant {name}: live stream published nothing")
+if len(r["shape_classes"]) != 1:
+    problems.append("same-shaped tenants landed in different "
+                    f"shape classes: {r['shape_classes']}")
+if "banked_at" not in r or "+00:00" not in r["banked_at"]:
+    problems.append("missing/naive banked_at provenance stamp")
+for p in problems:
+    print(f"FAIL: tenancy serve-bench result: {p}", file=sys.stderr)
+worst = max(t["p99_ms"] for t in tenants.values())
+print(f"tenancy serve-bench: worst p99={worst}ms fairness="
+      f"{r['fairness_ratio']} tenants={sorted(tenants)}")
+sys.exit(1 if problems else 0)
+EOF
+fi
+
+echo "== tenancy smoke 4/4: bench regression gate =="
+bash scripts/bench_gate.sh || fail=1
+
+if [ "$fail" -ne 0 ]; then
+    echo "tenancy smoke: FAIL" >&2
+    exit 1
+fi
+echo "tenancy smoke: OK"

@@ -916,6 +916,11 @@ def main(argv=None):
         for msg in vocab.check_plan_vocabulary(REPO):
             path, _, rest = msg.partition(": ")
             findings.append(Finding(path, 1, "unregistered-name", rest))
+        # same repo-level footing for the tenancy label contract: every
+        # serving.*/live.* metric keeps its tenant dimension
+        for msg in vocab.check_tenant_vocabulary(REPO):
+            path, _, rest = msg.partition(": ")
+            findings.append(Finding(path, 1, "unregistered-name", rest))
 
     baseline_path = None if args.baseline == "none" else args.baseline
     if args.write_baseline:

@@ -92,6 +92,14 @@ DEFAULT_ROOTS = ("tpu_als", "scripts", "bench.py")
 PLAN_EVENTS = ("plan_resolved", "plan_probe", "plan_cache_hit",
                "plan_cache_miss")
 
+# the tenancy contract pins the LABEL vocabulary the same way: every
+# serving.*/live.* series must declare the tenant label (the tenant-
+# isolation scenario and serve-bench --tenants read per-tenant tails
+# from exactly these names), and serving.publish_seconds must keep its
+# historical "mode" dimension alongside tenant — dropping either key
+# silently voids the per-tenant SLO assertions without failing a test
+TENANT_PREFIXES = ("serving.", "live.")
+
 
 def _load_standalone(name, relpath, repo):
     """Load one stdlib-only registry module by file path, bypassing the
@@ -145,6 +153,40 @@ def check_plan_vocabulary(repo=REPO):
     return errors
 
 
+def check_tenant_vocabulary(repo=REPO):
+    """Every serving.*/live.* metric must declare the ``tenant`` label
+    (schema.TENANT_LABELED), and ``serving.publish_seconds`` must keep
+    its ``mode`` dimension — the multi-tenant obs contract
+    (docs/tenancy.md)."""
+    schema, _ = load_registries(repo)
+    errors = []
+    labels = getattr(schema, "LABELS", {})
+    tenant_labeled = set(getattr(schema, "TENANT_LABELED", ()))
+    for name in sorted(schema.METRICS):
+        if name.startswith(TENANT_PREFIXES) \
+                and name not in tenant_labeled:
+            errors.append(
+                f"tpu_als/obs/schema.py: metric {name!r} matches the "
+                "tenant-attributed prefixes "
+                f"({'/'.join(TENANT_PREFIXES)}) but does not declare "
+                "the 'tenant' label key in LABELS — per-tenant SLO "
+                "reads would silently return the cross-tenant series "
+                "(docs/tenancy.md)")
+    if "mode" not in labels.get("serving.publish_seconds", ()):
+        errors.append(
+            "tpu_als/obs/schema.py: LABELS['serving.publish_seconds'] "
+            "must keep the 'mode' key — the publish-mode histogram "
+            "(retag/delta/full) is the incremental-publish contract "
+            "(docs/serving.md)")
+    for name in tenant_labeled:
+        if name not in schema.METRICS:
+            errors.append(
+                f"tpu_als/obs/schema.py: LABELS declares {name!r} but "
+                "METRICS does not — a label table entry for an "
+                "undeclared metric is dead vocabulary")
+    return errors
+
+
 def py_files(paths):
     for p in paths:
         if os.path.isfile(p):
@@ -155,6 +197,28 @@ def py_files(paths):
                 for name in sorted(files):
                     if name.endswith(".py"):
                         yield os.path.join(root, name)
+
+
+_TENANT_KW_RE = re.compile(r"\btenant\s*=")
+
+
+def _call_block(text, start):
+    """The balanced ``(...)`` call text opening at/after ``start`` (the
+    _assertion_blocks idiom; our call sites carry no parens inside their
+    string literals)."""
+    open_pos = text.find("(", start)
+    if open_pos < 0:
+        return ""
+    depth = 0
+    for i in range(open_pos, min(len(text), open_pos + 4000)):
+        ch = text[i]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_pos:i + 1]
+    return text[open_pos:open_pos + 4000]
 
 
 def _assertion_blocks(text):
@@ -226,6 +290,14 @@ def check_file(path, repo=REPO):
                 add(lineno,
                     f"{where}: metric {name!r} is declared as a "
                     f"{decl[0]}, used as a {want_kind} ({method})")
+            elif (method not in ACCESSOR_KIND and not in_obs
+                  and name not in getattr(schema, "TENANT_LABELED", ())
+                  and _TENANT_KW_RE.search(_call_block(text, m.start()))):
+                add(lineno,
+                    f"{where}: {method} of {name!r} passes a tenant= "
+                    "label, but the metric does not declare the "
+                    "'tenant' key in tpu_als.obs.schema.LABELS — the "
+                    "write would raise at runtime (docs/tenancy.md)")
 
     for pos, block in _assertion_blocks(text):
         lineno = line_of(pos)
@@ -314,6 +386,7 @@ def main(argv=None):
     errors = []
     if args.paths is None:          # fixture runs scan only their files
         errors.extend(check_plan_vocabulary())
+        errors.extend(check_tenant_vocabulary())
     nfiles = 0
     for path in py_files(paths):
         nfiles += 1

@@ -795,6 +795,249 @@ def cmd_foldin_bench(args):
     }))
 
 
+def _serve_bench_tenants(args):
+    """The ``--tenants N`` branch: N same-shaped models behind one
+    :class:`MultiTenantEngine`, equal open-loop load per tenant, judged
+    per tenant from the LABELED obs series.
+
+    Headline metric is ``tenancy_worst_p99_ms`` — the worst per-tenant
+    e2e p99 — and ``slo_met`` requires BOTH every tenant's p99 within
+    ``--slo-ms`` AND the weighted goodput fairness ratio (max/min of
+    served-rows-per-weight) within ``--fairness-bound``: a report where
+    one tenant starves is a failing report even if the aggregate tail
+    looks healthy.  ``--update-qps > 0`` gives every tenant its own
+    live fold-in stream (per-tenant publish-mode histograms in the
+    report).  Same-shaped tenants share compiled executables — warmup
+    cost is paid once, not N times (docs/tenancy.md).
+    """
+    import datetime as _dt
+    import threading
+    import time
+
+    from tpu_als import obs
+    from tpu_als.tenancy import (MultiTenantEngine, TenantOverloaded,
+                                 TenantSpec)
+
+    if args.tenants < 2:
+        raise SystemExit("serve-bench: --tenants needs >= 2")
+    rng = np.random.default_rng(args.seed)
+    names = [f"t{i}" for i in range(args.tenants)]
+    weights = ([float(w) for w in args.tenant_weights.split(",")]
+               if args.tenant_weights else [1.0] * args.tenants)
+    if len(weights) != args.tenants:
+        raise SystemExit("serve-bench: --tenant-weights needs exactly "
+                         f"{args.tenants} comma-separated weights")
+    buckets = (tuple(int(b) for b in args.buckets.split(","))
+               if args.buckets else None)
+
+    eng = MultiTenantEngine()
+    factors = {}
+    for name, w in zip(names, weights):
+        U = rng.normal(size=(args.users, args.rank)).astype(np.float32)
+        V = rng.normal(size=(args.items, args.rank)).astype(np.float32)
+        factors[name] = (U, V)
+        eng.add_tenant(
+            TenantSpec(name=name, weight=w, k=args.k,
+                       shortlist_k=args.shortlist_k, buckets=buckets,
+                       max_queue=args.max_queue,
+                       max_wait_s=args.max_wait_ms / 1e3,
+                       default_deadline_s=(args.deadline_ms / 1e3
+                                           if args.deadline_ms
+                                           else None),
+                       slo_s=args.slo_ms / 1e3),
+            U, V, quantize=not args.exact)
+    with obs.span("serve_bench.warmup"):
+        # tenant 0 pays the compiles; the rest hit the process-global
+        # cache (same shape-class, same rank)
+        eng.warmup()
+
+    updaters = {}
+    if args.update_qps > 0:
+        from tpu_als.api.estimator import ALSModel
+        from tpu_als.core.ratings import IdMap, _next_pow2
+        from tpu_als.stream.microbatch import FoldInServer
+
+        with obs.span("serve_bench.live_prewarm"):
+            for name in names:
+                U, V = factors[name]
+                model = ALSModel(
+                    args.rank, IdMap(ids=np.arange(args.users)),
+                    IdMap(ids=np.arange(args.items)), U.copy(),
+                    V.copy(),
+                    {"userCol": "user", "itemCol": "item",
+                     "ratingCol": "rating", "regParam": 0.05,
+                     "implicitPrefs": False, "alpha": 1.0,
+                     "nonnegative": False})
+                srv = FoldInServer(model, keep_history=False)
+                upd = eng.attach_live(
+                    name, srv, max_batch=args.update_max_batch,
+                    max_wait_ms=args.update_max_wait_ms,
+                    slo_s=args.freshness_slo_ms / 1e3)
+                if name == names[0]:
+                    ladder = tuple(sorted(
+                        {_next_pow2(max(1, upd.max_batch >> s))
+                         for s in range(upd.max_batch.bit_length())}))
+                    srv.prewarm(rows=ladder, widths=(1, 2),
+                                sides=("user",))
+                updaters[name] = upd
+
+    per_qps = args.qps / args.tenants
+    n_req = max(1, int(per_qps * args.duration))
+    path = "exact" if args.exact else "int8"
+    print(f"serve-bench: {args.tenants} tenants x {n_req} requests at "
+          f"{per_qps:g} rps each over {args.duration:g}s ({path} path, "
+          f"{args.items:,} items, rank {args.rank})", file=sys.stderr)
+
+    shed = {name: 0 for name in names}
+
+    def _drive(name, seed):
+        trng = np.random.default_rng(seed)
+        uids = trng.integers(0, args.users, n_req)
+        tickets = []
+        t0 = time.perf_counter()
+        for j in range(n_req):
+            delay = (t0 + j / per_qps) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                tickets.append(eng.submit(name, int(uids[j])))
+            except TenantOverloaded:
+                shed[name] += 1
+        for t in tickets:
+            try:
+                t.result(timeout=max(5.0, 10 * args.slo_ms / 1e3))
+            except Exception:   # noqa: BLE001 — counted from obs below
+                pass
+
+    def _drive_updates(name, seed):
+        urng = np.random.default_rng(seed)
+        n_upd = max(1, int(args.update_qps / args.tenants
+                           * args.duration))
+        uu = urng.integers(0, args.users, n_upd)
+        ii = urng.integers(0, args.items, n_upd)
+        rr = urng.uniform(0.5, 5.0, n_upd).astype(np.float32)
+        tu = time.perf_counter()
+        for j in range(n_upd):
+            delay = (tu + j / (args.update_qps / args.tenants)
+                     - time.perf_counter())
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                updaters[name].submit(int(uu[j]), int(ii[j]),
+                                      float(rr[j]))
+            except Exception:   # noqa: BLE001 — live.shed counts it
+                pass
+
+    eng.start()
+    try:
+        with obs.span("serve_bench.drive"):
+            threads = [threading.Thread(
+                target=_drive, args=(name, args.seed + 100 + i),
+                name=f"serve-bench-{name}")
+                for i, name in enumerate(names)]
+            threads += [threading.Thread(
+                target=_drive_updates, args=(name, args.seed + 200 + i),
+                name=f"serve-bench-upd-{name}")
+                for i, name in enumerate(updaters)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            deadline = time.perf_counter() + 30.0
+            while (any(u.queue_depth for u in updaters.values())
+                   and time.perf_counter() < deadline):
+                time.sleep(0.02)
+    finally:
+        eng.stop()
+
+    per_tenant, worst_p99, modes_all = {}, 0.0, {}
+    goodput = []
+    events = obs.default_registry()._events
+    for name, w in zip(names, weights):
+        p50 = obs.histogram_quantile("serving.e2e_seconds", 0.5,
+                                     tenant=name)
+        p99 = obs.histogram_quantile("serving.e2e_seconds", 0.99,
+                                     tenant=name)
+        scored = obs.histogram_count("serving.e2e_seconds", tenant=name)
+        if scored == 0:
+            raise SystemExit(f"serve-bench: tenant {name!r} completed "
+                             "no request — its histogram is empty")
+        shed_obs = obs.counter_value("serving.shed", tenant=name)
+        admitted = obs.counter_value("serving.requests", tenant=name)
+        assert shed[name] == shed_obs, (name, shed[name], shed_obs)
+        served = obs.counter_value("tenancy.served_rows", tenant=name)
+        goodput.append(served / w)
+        modes = {}
+        for e in events:
+            if (e.get("type") == "live_update"
+                    and e.get("tenant") == name):
+                modes[e["mode"]] = modes.get(e["mode"], 0) + 1
+        for m, c in modes.items():
+            modes_all[m] = modes_all.get(m, 0) + c
+        worst_p99 = max(worst_p99, p99)
+        per_tenant[name] = {
+            "p50_ms": round(p50 * 1e3, 3),
+            "p99_ms": round(p99 * 1e3, 3),
+            "slo_met": bool(p99 * 1e3 <= args.slo_ms),
+            "scored": int(scored),
+            "shed_rate": (round(shed_obs / (admitted + shed_obs), 4)
+                          if admitted + shed_obs else 0.0),
+            "served_rows": int(served),
+            "weight": w,
+            **({"publish_modes": modes} if modes else {}),
+        }
+    fairness = (max(goodput) / min(goodput)) if min(goodput) else None
+    all_in_slo = all(t["slo_met"] for t in per_tenant.values())
+    # Fairness is a CONTENTION property: weighted goodput (served/weight)
+    # can only equalize when the scheduler actually arbitrates.  An
+    # unsaturated bench serves every tenant's full demand, so unequal
+    # weights read as an "unfair" ratio while nobody was refused
+    # anything — judge the ratio only when some tenant shed (always
+    # report it).
+    contended = any(shed[name] > 0 for name in names)
+    fair_ok = (not contended or (fairness is not None
+                                 and fairness <= args.fairness_bound))
+    result = {
+        "metric": "tenancy_worst_p99_ms",
+        "value": round(worst_p99 * 1e3, 3),
+        "unit": "ms",
+        "slo_ms": args.slo_ms,
+        "fairness_ratio": (round(fairness, 3)
+                           if fairness is not None else None),
+        "fairness_bound": args.fairness_bound,
+        "fairness_judged": contended,
+        "slo_met": bool(all_in_slo and fairness is not None
+                        and fair_ok),
+        "tenants": per_tenant,
+        "shape_classes": {k: sorted(v) for k, v in
+                          eng.registry.shape_classes().items()},
+        **({"publish_modes": modes_all} if modes_all else {}),
+        "config": {
+            "path": path, "tenants": args.tenants,
+            "tenant_weights": weights, "users": args.users,
+            "items": args.items, "rank": args.rank, "k": args.k,
+            "shortlist_k": args.shortlist_k, "qps": args.qps,
+            "qps_per_tenant": per_qps, "duration_s": args.duration,
+            "max_queue": args.max_queue,
+            "max_wait_ms": args.max_wait_ms,
+            "deadline_ms": args.deadline_ms,
+            "update_qps": args.update_qps,
+        },
+    }
+    print(json.dumps(result))
+    if args.bench_json:
+        with open(args.bench_json, "w") as f:
+            json.dump({
+                **result,
+                "banked_by": "tpu_als serve-bench --tenants",
+                "banked_at": _dt.datetime.now(
+                    _dt.timezone.utc).isoformat(timespec="seconds"),
+            }, f, indent=2)
+            f.write("\n")
+        print(f"result banked to {args.bench_json}", file=sys.stderr)
+    return result
+
+
 def cmd_serve_bench(args):
     """Open-loop serving latency benchmark: synthetic factors, a fixed
     request rate for a fixed window, p50/p99/shed-rate read back from
@@ -813,6 +1056,10 @@ def cmd_serve_bench(args):
     becomes ``live_freshness_p99_ms`` judged against
     ``--freshness-slo-ms``, with an O(touched)-vs-O(catalog)
     publish-cost probe (min-of-3, device-fenced) alongside.
+
+    ``--tenants N`` switches to the multi-tenant variant: N same-shaped
+    models behind one MultiTenantEngine, judged per tenant
+    (see :func:`_serve_bench_tenants`).
     """
     import datetime as _dt
     import threading
@@ -820,6 +1067,9 @@ def cmd_serve_bench(args):
 
     from tpu_als import obs
     from tpu_als.serving import Overloaded, ServingEngine
+
+    if args.tenants:
+        return _serve_bench_tenants(args)
 
     rng = np.random.default_rng(args.seed)
     U = rng.normal(size=(args.users, args.rank)).astype(np.float32)
@@ -1610,6 +1860,20 @@ def main(argv=None):
     sb.add_argument("--update-max-wait-ms", type=float, default=None,
                     help="live micro-batch deadline (default: the "
                          "planner's live cadence)")
+    sb.add_argument("--tenants", type=int, default=0,
+                    help=">= 2 runs the multi-tenant variant: N "
+                         "same-shaped models behind one "
+                         "MultiTenantEngine, equal open-loop load per "
+                         "tenant, headline tenancy_worst_p99_ms judged "
+                         "per tenant plus a goodput fairness ratio "
+                         "(docs/tenancy.md)")
+    sb.add_argument("--tenant-weights", default=None,
+                    help="comma-separated fair-share weights, one per "
+                         "tenant (default: all 1.0); the fairness "
+                         "ratio is computed on served rows per weight")
+    sb.add_argument("--fairness-bound", type=float, default=1.5,
+                    help="max/min weighted-goodput ratio above which "
+                         "the multi-tenant report fails its SLO")
     sb.add_argument("--seed", type=int, default=0)
     sb.add_argument("--bench-json", default=None, metavar="PATH",
                     help="also bank the result JSON (with banked_at "

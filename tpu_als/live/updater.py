@@ -61,16 +61,25 @@ class LiveUpdater:
     become recommendable; their rows ride the index's delta segment).
     ``slo_s`` is the arrival → servable objective; None disables the
     breach trigger but freshness is always measured.
+
+    ``tenant`` (default: the engine's own tenant) labels every live.*
+    metric this loop writes and tags its events/flight records, so a
+    freshness breach in a multi-tenant process names its tenant from
+    the obs trail alone (docs/tenancy.md).
     """
 
     def __init__(self, engine, foldin, *, max_queue=4096,
                  max_batch=None, max_wait_ms=None, slo_s=None,
-                 fold_items=False, flight_capacity=64):
+                 fold_items=False, flight_capacity=64, tenant=None):
         from tpu_als import plan as _plan
 
         cad = _plan.resolve_live_cadence()
         self.engine = engine
         self.foldin = foldin
+        if tenant is None:
+            tenant = getattr(engine, "tenant", None)
+        self.tenant = str(tenant) if tenant is not None else None
+        self._labels = {"tenant": self.tenant} if self.tenant else {}
         self.max_queue = int(max_queue)
         self.max_batch = int(max_batch if max_batch is not None
                              else cad["max_batch"])
@@ -96,7 +105,7 @@ class LiveUpdater:
             if self._closed:
                 raise RuntimeError("LiveUpdater is stopped")
             if len(self._queue) >= self.max_queue:
-                obs.counter("live.shed")
+                obs.counter("live.shed", **self._labels)
                 raise Overloaded(
                     f"live update queue at capacity ({self.max_queue})")
             self._queue.append((user, item, float(rating), t_arrival))
@@ -153,7 +162,8 @@ class LiveUpdater:
                 self._cond.wait(left)
             batch = self._queue[:self.max_batch]
             del self._queue[:self.max_batch]
-            obs.gauge("live.queue_depth", len(self._queue))
+            obs.gauge("live.queue_depth", len(self._queue),
+                      **self._labels)
             return batch
 
     def _run(self):
@@ -188,16 +198,18 @@ class LiveUpdater:
             obs.counter("ingest.quarantined_rows", n_bad)
             obs.emit("ingest_quarantined", path="live", rows=n_bad,
                      reasons={"nonfinite": nonfinite,
-                              "out_of_range": n_bad - nonfinite})
+                              "out_of_range": n_bad - nonfinite},
+                     **self._labels)
             keep = ~bad
             users, items = users[keep], items[keep]
             ratings, arrivals = ratings[keep], arrivals[keep]
         quarantine_s = time.perf_counter() - t0
-        obs.histogram("live.batch_rows", len(ratings))
+        obs.histogram("live.batch_rows", len(ratings), **self._labels)
         if len(ratings) == 0:
             self.flight.record(
                 "quarantined",
-                {"queue_wait": queue_wait, "quarantine": quarantine_s})
+                {"queue_wait": queue_wait, "quarantine": quarantine_s},
+                **self._labels)
             return
 
         p = self.foldin.model._params
@@ -222,19 +234,20 @@ class LiveUpdater:
         worst = 0.0
         for a in arrivals:
             fr = done - float(a)
-            obs.histogram("live.freshness_seconds", fr)
+            obs.histogram("live.freshness_seconds", fr, **self._labels)
             worst = max(worst, fr)
         touched = len(touched_users) + (
             len(touched_item_rows) if touched_item_rows is not None
             else 0)
         obs.emit("live_update", seq=seq, events=len(ratings),
-                 touched=touched, mode=mode)
+                 touched=touched, mode=mode, **self._labels)
         self.flight.record(
             "ok",
             {"queue_wait": queue_wait, "quarantine": quarantine_s,
              "foldin": foldin_s, "publish": publish_s},
-            e2e_seconds=worst, seq=seq, mode=mode)
+            e2e_seconds=worst, seq=seq, mode=mode, **self._labels)
         if self.slo_s is not None and worst > self.slo_s:
             obs.emit("live_freshness_breach", seq=seq,
-                     freshness_seconds=worst, slo_s=self.slo_s)
+                     freshness_seconds=worst, slo_s=self.slo_s,
+                     **self._labels)
             self.flight.dump("freshness_breach")

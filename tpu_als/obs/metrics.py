@@ -130,12 +130,14 @@ class MetricsRegistry:
     # -- instruments ---------------------------------------------------
     def counter(self, name, value=1, **labels):
         schema.check_metric(name, "counter")
+        schema.check_labels(name, labels)
         key = (name, _labels_key(labels))
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + value
 
     def gauge(self, name, value, **labels):
         schema.check_metric(name, "gauge")
+        schema.check_labels(name, labels)
         key = (name, _labels_key(labels))
         with self._lock:
             self._gauges[key] = value
@@ -146,6 +148,7 @@ class MetricsRegistry:
 
     def histogram(self, name, value, **labels):
         schema.check_metric(name, "histogram")
+        schema.check_labels(name, labels)
         key = (name, _labels_key(labels))
         with self._lock:
             h = self._hists.get(key)

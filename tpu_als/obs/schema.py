@@ -9,6 +9,16 @@ MetricsSystem source names — SURVEY.md §5.5).
 
 Adding a metric or event type = add a row here + a row in the matching
 table of docs/observability.md.
+
+Labels are vocabulary too: ``LABELS`` declares which label keys each
+metric's writers may attach, and the registry rejects any other key at
+call time — an ad-hoc label would mint a series dimension nothing
+downstream (the Prometheus exposition, `observe summarize`, the bench
+judges) knows how to aggregate.  The ``tenant`` label is the multi-
+tenant attribution contract: every ``serving.*``/``live.*`` series
+carries it (``TENANT_LABELED`` is derived, so adding a serving metric
+without deciding its tenant story is impossible — the static check in
+``analysis/vocab.py`` pins exactly that).
 """
 
 from __future__ import annotations
@@ -138,7 +148,59 @@ METRICS = {
         "stage), labeled stage=<perf.roofline stage name> so "
         "`observe attribution` can join measured time against the "
         "modeled floor"),
+    "tenancy.tenants": (
+        "gauge", "tenants",
+        "models currently registered with the multi-tenant control "
+        "plane (tpu_als.tenancy.registry)"),
+    "tenancy.served_rows": (
+        "counter", "rows",
+        "requests completed per tenant by the fair-share scheduler "
+        "(labeled tenant=<name>; the goodput series the fairness "
+        "ratio is computed from)"),
+    "tenancy.batch_errors": (
+        "counter", "batches",
+        "micro-batches whose scoring raised, failed in isolation "
+        "(labeled tenant=<name>: the failing tenant's tickets erred, "
+        "every other tenant kept serving)"),
 }
+
+# metric name -> label keys its writers may attach.  Any key outside
+# this row raises at call time (metrics.MetricsRegistry) and fails the
+# static check (analysis/vocab.py) — labels are declared vocabulary,
+# not free-form tags.  Metrics absent from this table take no labels.
+LABELS = {
+    "train.comm_bytes_per_iter": ("strategy",),
+    "train.gather_block_rows": ("n_blocks", "side"),
+    "train.stage_seconds": ("stage",),
+    "serve.request_seconds": ("strategy",),
+    "foldin.update_seconds": ("side",),
+    "foldin.batch_rows": ("side",),
+    "serving.enqueue_seconds": ("tenant",),
+    "serving.score_seconds": ("path", "tenant"),
+    "serving.e2e_seconds": ("tenant",),
+    "serving.batch_rows": ("tenant",),
+    "serving.queue_depth": ("tenant",),
+    "serving.requests": ("tenant",),
+    "serving.shed": ("tenant",),
+    "serving.expired": ("tenant",),
+    "serving.fallback_exact": ("tenant",),
+    "serving.publishes": ("tenant",),
+    "serving.publish_seconds": ("mode", "tenant"),
+    "live.freshness_seconds": ("tenant",),
+    "live.batch_rows": ("tenant",),
+    "live.shed": ("tenant",),
+    "live.queue_depth": ("tenant",),
+    "tenancy.served_rows": ("tenant",),
+    "tenancy.batch_errors": ("tenant",),
+}
+
+# every metric allowed to carry the multi-tenant attribution label —
+# derived from LABELS so it can never drift from the table above; the
+# analysis gate additionally pins that every serving.*/live.* metric
+# appears here (a new serving series without a tenant story is a lint
+# failure, the same way serving.publish_seconds' mode label is pinned)
+TENANT_LABELED = tuple(sorted(
+    n for n, keys in LABELS.items() if "tenant" in keys))
 
 # event type -> (required fields beyond ts/type, help text).  Extra
 # fields are allowed (events are self-describing JSON); missing required
@@ -283,6 +345,16 @@ EVENTS = {
         "the updater's flight-recorder tail (queue_wait/quarantine/"
         "foldin/publish spans) is dumped alongside with "
         "trigger='freshness_breach'"),
+    "tenant_registered": (
+        ("tenant", "users", "items", "shape_class"),
+        "one per TenantRegistry.register: the tenant's published table "
+        "sizes and its planner shape-class (tenants sharing a "
+        "shape-class share the plan-cache entry and, with equal "
+        "rank/buckets, the compiled scoring executables)"),
+    "tenant_removed": (
+        ("tenant",),
+        "a tenant was deregistered from the control plane; its engine "
+        "was stopped and its device buffers released"),
     "plan_cache_miss": (
         ("key", "component", "reason"),
         "a plan component was not servable from the cache (reason: "
@@ -304,6 +376,22 @@ def check_metric(name, kind):
         raise TypeError(
             f"metric {name!r} is declared as a {decl[0]}, used as a "
             f"{kind}")
+
+
+def check_labels(name, labels):
+    """Raise if a write attaches a label key ``name``'s LABELS row does
+    not declare (no row = no labels).  Values are free; KEYS are the
+    vocabulary — each declared key is one series dimension downstream
+    readers aggregate over."""
+    if not labels:
+        return
+    allowed = LABELS.get(name, ())
+    unknown = sorted(k for k in labels if k not in allowed)
+    if unknown:
+        raise ValueError(
+            f"metric {name!r} does not declare label key(s) {unknown} "
+            f"(declared: {list(allowed)}) — add them to "
+            "tpu_als.obs.schema.LABELS before writing the series")
 
 
 def check_event(etype, fields):

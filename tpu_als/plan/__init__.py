@@ -21,6 +21,7 @@ from tpu_als.plan.planner import (  # noqa: F401
     resolve_gather_strategy,
     resolve_live_cadence,
     resolve_serving_buckets,
+    resolve_tenant_plan,
     resolve_topk,
     resolve_training,
     shape_class,

@@ -348,6 +348,30 @@ def resolve_live_cadence(*, rank=0, requested=None):
             "compact_min_rows": int(out["compact_min_rows"])}
 
 
+def resolve_tenant_plan(*, rank, n_users=None, n_items=None,
+                        requested_buckets=None, requested_cadence=None):
+    """Per-tenant execution plan for the multi-tenant control plane:
+    the serving bucket ladder + live cadence this tenant's engine and
+    updater run with, plus the tenant's ``shape_class``.
+
+    The bucket/cadence components key on (device, jax, rank, dtype) —
+    deliberately NOT on the tenant's name — so every same-shaped tenant
+    resolves to the SAME plan entry (one probe walk total, zero for
+    warm caches) and, with equal buckets/rank/catalog shape-class,
+    shares the process-global compiled scoring executables.  That
+    compile sharing is what makes N tenants on one mesh cheaper than N
+    processes (docs/tenancy.md).
+    """
+    sc = shape_class(n_users=n_users, n_items=n_items)
+    return {
+        "shape_class": sc,
+        "buckets": resolve_serving_buckets(rank=rank,
+                                           requested=requested_buckets),
+        "cadence": resolve_live_cadence(rank=rank,
+                                        requested=requested_cadence),
+    }
+
+
 def probe_budget_s(default_s):
     """Bench probe-budget suggestion; see
     ``plan.cache.suggested_probe_budget`` (bench.py loads that module

@@ -28,6 +28,10 @@ into one assertable run each:
 ``flight-recorder``      every request breaches a microsecond SLO; the
                          engine's flight recorder dumps per-request span
                          breakdowns as ``flight_record`` events.
+``tenant-isolation``     the multi-tenant fault matrix lands on tenant A
+                         (torn publish, poisoned stream, rollback, 10×
+                         spike) while tenant B's top-k stays bitwise
+                         equal to its solo run, in SLO, zero shed.
 
 All run on CPU in seconds (they are tier-1 tests via
 tests/test_scenarios.py) and bank ``BENCH_scenario_<name>.json`` on
@@ -1080,6 +1084,291 @@ def _continuous_freshness():
 
 
 # ---------------------------------------------------------------------------
+# tenant-isolation
+
+
+def _ti_solo(ctx):
+    """Tenant B alone: publish its factors into a solo engine and serve
+    the seeded query set synchronously — the bitwise reference the
+    multi-tenant run must reproduce under a fault storm on A."""
+    from tpu_als import plan as _plan
+    from tpu_als.serving import ServingEngine
+
+    c = ctx.config
+    rng = np.random.default_rng(c["seed"])
+    Ub = rng.normal(size=(c["users"], c["rank"])).astype(np.float32)
+    Vb = rng.normal(size=(c["items"], c["rank"])).astype(np.float32)
+    uids = np.random.default_rng(c["seed"] + 1).integers(
+        0, c["users"], c["n_queries"])
+    # the same planner resolution the registry applies to tenant B —
+    # bitwise equality needs the same bucket ladder, hence the same
+    # padded shapes and compiled executables
+    tplan = _plan.resolve_tenant_plan(rank=c["rank"],
+                                      n_users=c["users"],
+                                      n_items=c["items"])
+    solo = ServingEngine(k=c["k"], buckets=tplan["buckets"])
+    solo.publish(Ub, Vb)
+    solo.warmup()
+    results = []
+    for uid in uids:
+        # one ticket per batch, drained synchronously — the multi-tenant
+        # driver blocks per request, so its batches are 1-row too and
+        # the compiled (bucket=1) path is byte-identical across runs
+        t = solo.submit(int(uid))
+        solo.serve_batch(solo.batcher.next_batch(timeout=0))
+        s, ix = t.result(timeout=10.0)
+        results.append((np.asarray(s).copy(), np.asarray(ix).copy()))
+    solo.stop()
+    ctx.state.update(Ub=Ub, Vb=Vb, uids=uids, solo_results=results)
+
+
+def _ti_start(ctx):
+    """Two tenants behind one front door: A with the full live stack
+    (its own model, fold-in, updater) and a deliberately small admission
+    queue; B with the SAME factors the solo run served."""
+    import tpu_als
+    from tpu_als import obs
+    from tpu_als.io.movielens import synthetic_movielens
+    from tpu_als.stream.microbatch import FoldInServer
+    from tpu_als.tenancy import MultiTenantEngine, TenantSpec
+
+    c = ctx.config
+    frame = synthetic_movielens(c["a_users"], c["a_items"], c["a_nnz"],
+                                seed=c["seed"] + 2)
+    model = tpu_als.ALS(rank=c["rank"], maxIter=2, regParam=0.05,
+                        seed=c["seed"]).fit(frame)
+    eng = MultiTenantEngine()
+    eng.add_tenant(
+        TenantSpec(name="a", max_queue=c["a_max_queue"]),
+        np.asarray(model._U), np.asarray(model._V))
+    eng.add_tenant(TenantSpec(name="b", k=c["k"]), ctx.state["Ub"],
+                   ctx.state["Vb"])
+    eng.warmup()
+    srv = FoldInServer(model)
+    eng.attach_live("a", srv, max_batch=16, max_wait_ms=10.0)
+    eng.start()
+    ctx.defer(eng.stop)
+    # per-tenant baselines: the facts judge DELTAS over this scenario,
+    # not whatever the registry accumulated before it
+    ctx.state.update(
+        eng=eng, model=model,
+        base=dict(
+            b_shed=obs.counter_value("serving.shed", tenant="b"),
+            a_shed=obs.counter_value("serving.shed", tenant="a"),
+            a_exact=obs.counter_value("serving.fallback_exact",
+                                      tenant="a")))
+
+
+def _ti_storm(ctx):
+    """The storm, aimed at A only, while B's seeded queries run: a 10×
+    spike past A's queue budget, a torn publish into A's seq-space, NaN
+    poison into A's live stream, and a guardrails=recover re-fit with a
+    mid-train corrupt — every fault armed in-phase and cleared, so only
+    A's lifecycle can observe it."""
+    from tpu_als.core.als import AlsConfig, train
+    from tpu_als.core.ratings import build_csr_buckets
+    from tpu_als.resilience import faults, guardrails
+    from tpu_als.tenancy import TenantOverloaded
+
+    c, s = ctx.config, ctx.state
+    eng, model = s["eng"], s["model"]
+    b_results, b_errors = [], []
+
+    def drive_b():
+        t0 = time.perf_counter()
+        for j, uid in enumerate(s["uids"]):
+            delay = (t0 + j / c["b_qps"]) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                sc, ix = eng.recommend("b", int(uid), timeout=10.0)
+                b_results.append((np.asarray(sc).copy(),
+                                  np.asarray(ix).copy()))
+            except Exception as e:   # noqa: BLE001 — the judged bucket
+                b_errors.append(type(e).__name__)
+
+    driver = threading.Thread(target=drive_b, name="scenario-tenant-b",
+                              daemon=True)
+    driver.start()
+
+    # 1. traffic spike vs A's small queue: its typed shed, nobody else's
+    spike_shed = 0
+    tickets = []
+    for _ in range(c["spike_submits"]):
+        try:
+            tickets.append(eng.submit("a", 0))
+        except TenantOverloaded as e:
+            assert e.tenant == "a"
+            spike_shed += 1
+
+    # 2. torn publish into A's seq-space: the corrupt tags A's int8
+    # index stale; A's next requests degrade to the exact path
+    faults.install("serving.publish=corrupt@once")
+    try:
+        eng.publish("a", np.asarray(model._U), np.asarray(model._V))
+    finally:
+        faults.clear()
+    for uid in (0, 1, 2):
+        # A's queue may still be draining the spike backlog; backing
+        # off on ITS typed shed is exactly the client contract
+        for _ in range(500):
+            try:
+                eng.recommend("a", uid, timeout=10.0)
+                break
+            except TenantOverloaded:
+                time.sleep(0.01)
+
+    # 3. poison A's live stream (quarantined, attributed to A) plus a
+    # few clean events so A's pipeline demonstrably still publishes
+    updater = eng.tenant("a").updater
+    rngA = np.random.default_rng(c["seed"] + 3)
+    user_ids = np.asarray(model._user_map.ids)
+    item_ids = np.asarray(model._item_map.ids)
+    for _ in range(c["poison_events"]):
+        updater.submit(int(rngA.choice(user_ids)),
+                       int(rngA.choice(item_ids)), float("nan"))
+    for _ in range(c["good_events"]):
+        updater.submit(int(rngA.choice(user_ids)),
+                       int(rngA.choice(item_ids)),
+                       float(rngA.uniform(0.5, 5.0)))
+
+    # 4. guardrails=recover re-fit for A with a mid-train corrupt: the
+    # sentinel trips, rolls back, and the recovered factors publish
+    # into A's seq-space
+    u = rngA.integers(0, c["a_users"], c["a_nnz"])
+    i = rngA.integers(0, c["a_items"], c["a_nnz"])
+    r = rngA.uniform(0.5, 5.0, c["a_nnz"]).astype(np.float32)
+    ucsr = build_csr_buckets(u, i, r, c["a_users"], min_width=4,
+                             chunk_elems=1 << 12)
+    icsr = build_csr_buckets(i, u, r, c["a_items"], min_width=4,
+                             chunk_elems=1 << 12)
+    faults.install("solve.gram=corrupt@nth=2")
+    try:
+        with guardrails.scoped("recover"):
+            Ua2, Va2 = train(ucsr, icsr,
+                             AlsConfig(rank=c["rank"], max_iter=4,
+                                       reg_param=0.1, seed=c["seed"]))
+    finally:
+        faults.clear()
+    eng.publish("a", np.asarray(Ua2), np.asarray(Va2))
+
+    # drain: A's spike tickets resolve or expire, A's live queue
+    # empties, B's driver finishes its query list
+    for t in tickets:
+        try:
+            t.result(timeout=10.0)
+        except Exception:   # noqa: BLE001 — A's outcomes judged via obs
+            pass
+    deadline = time.perf_counter() + 30.0
+    while updater.queue_depth and time.perf_counter() < deadline:
+        time.sleep(0.02)
+    driver.join(60.0)
+    ctx.state.update(b_results=b_results)
+    ctx.facts.update(a_spike_shed=spike_shed,
+                     b_hard_failures=len(b_errors))
+
+
+def _ti_judge(ctx):
+    """The isolation verdict, from B's answers and the labeled trail:
+    B bitwise vs solo, B's tail and shed in budget, A's storm evidence
+    attributed to A."""
+    from tpu_als import obs
+
+    s, base = ctx.state, ctx.state["base"]
+    solo, multi = s["solo_results"], s["b_results"]
+    ok = len(solo) == len(multi)
+    for (ss, si), (ms, mi) in zip(solo, multi):
+        ok = ok and bool(np.array_equal(ss, ms)
+                         and np.array_equal(si, mi))
+    ctx.facts["b_topk_bitwise"] = ok
+    p99 = obs.histogram_quantile("serving.e2e_seconds", 0.99,
+                                 tenant="b")
+    ctx.facts["b_p99_ms"] = (1e3 * float(p99)
+                             if p99 == p99 else float("inf"))
+    ctx.facts["b_shed"] = int(
+        obs.counter_value("serving.shed", tenant="b") - base["b_shed"])
+    ctx.facts["a_shed"] = int(
+        obs.counter_value("serving.shed", tenant="a") - base["a_shed"])
+    ctx.facts["a_fallback_exact"] = int(
+        obs.counter_value("serving.fallback_exact", tenant="a")
+        - base["a_exact"])
+    events = obs.default_registry()._events
+    ctx.facts["a_quarantine_attributed"] = bool(any(
+        e.get("type") == "ingest_quarantined" and e.get("tenant") == "a"
+        for e in events))
+    ctx.facts["a_live_published"] = bool(any(
+        e.get("type") == "live_update" and e.get("tenant") == "a"
+        for e in events))
+
+
+def _tenant_isolation():
+    return ScenarioSpec(
+        name="tenant-isolation",
+        doc="the multi-tenant fault matrix: a torn publish, a poisoned "
+            "live stream, a guardrail-rollback re-fit and a 10× spike "
+            "all land on tenant A while tenant B serves its seeded "
+            "queries — B's top-k stays BITWISE equal to its solo run, "
+            "its p99/shed hold the SLO, and every piece of A's storm is "
+            "attributed to A in the labeled obs trail (docs/tenancy.md).",
+        defaults=dict(seed=21, users=64, items=96, rank=8, k=5,
+                      n_queries=40, b_qps=80.0, b_slo_ms=500.0,
+                      a_users=48, a_items=36, a_nnz=600,
+                      a_max_queue=8, spike_submits=64,
+                      poison_events=3, good_events=8),
+        phases=(
+            Phase("solo-baseline", _ti_solo,
+                  "tenant B alone: the bitwise reference answers"),
+            Phase("multi-tenant-start", _ti_start,
+                  "register A (full live stack, small queue) and B "
+                  "(the solo factors) behind one front door"),
+            Phase("fault-storm", _ti_storm,
+                  "spike + torn publish + poison + rollback, all on A, "
+                  "under B's query load; drain before judging"),
+            Phase("judge", _ti_judge,
+                  "B bitwise + SLO, A's evidence from the labeled "
+                  "trail"),
+        ),
+        assertions=(
+            Assertion("b_topk_bitwise", "fact", fact="b_topk_bitwise",
+                      op="==", value=True,
+                      doc="B's answers under A's storm == B's solo "
+                          "answers, bit for bit"),
+            Assertion("b_p99_under_slo", "fact", fact="b_p99_ms",
+                      op="<=", value="$b_slo_ms"),
+            Assertion("b_zero_shed", "fact", fact="b_shed",
+                      op="==", value=0,
+                      doc="A's overload never consumed B's queue "
+                          "budget"),
+            Assertion("b_no_hard_failures", "fact",
+                      fact="b_hard_failures", op="==", value=0),
+            Assertion("a_spike_shed", "fact", fact="a_spike_shed",
+                      op=">=", value=1,
+                      doc="the spike DID overflow A's small queue "
+                          "(typed TenantOverloaded naming A)"),
+            Assertion("a_degraded_exact", "fact",
+                      fact="a_fallback_exact", op=">=", value=1,
+                      doc="A's torn publish degraded A to the exact "
+                          "path"),
+            Assertion("a_quarantine_attributed", "fact",
+                      fact="a_quarantine_attributed", op="==",
+                      value=True,
+                      doc="the poison's quarantine event carries "
+                          "tenant=a"),
+            Assertion("a_live_recovered", "fact",
+                      fact="a_live_published", op="==", value=True,
+                      doc="A's live pipeline still published after the "
+                          "poison"),
+            Assertion("quarantine_event", "event",
+                      event="ingest_quarantined", op=">=", value=1),
+            Assertion("sentinel_tripped", "event",
+                      event="guardrail_tripped", op=">=", value=1),
+            Assertion("rolled_back", "event", event="train_rollback",
+                      op=">=", value=1),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 _BUILDERS = (
@@ -1092,6 +1381,7 @@ _BUILDERS = (
     _solver_divergence,
     _poisoned_stream,
     _continuous_freshness,
+    _tenant_isolation,
 )
 
 SCENARIOS = {s.name: s for s in (b() for b in _BUILDERS)}

@@ -113,7 +113,7 @@ class MicroBatcher:
     """
 
     def __init__(self, buckets=DEFAULT_BUCKETS, max_queue=1024,
-                 max_wait_s=0.002, default_deadline_s=None):
+                 max_wait_s=0.002, default_deadline_s=None, labels=None):
         if not buckets or list(buckets) != sorted(set(buckets)):
             raise ValueError(f"buckets must be sorted and unique, got "
                              f"{buckets!r}")
@@ -121,6 +121,10 @@ class MicroBatcher:
         self.max_queue = int(max_queue)
         self.max_wait_s = float(max_wait_s)
         self.default_deadline_s = default_deadline_s
+        # obs attribution (e.g. tenant=<name> from the multi-tenant
+        # control plane); every serving.* series this queue writes
+        # carries these label keys, validated by obs.schema.LABELS
+        self.labels = dict(labels) if labels else {}
         self._q = collections.deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -145,7 +149,7 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("batcher is closed")
             if len(self._q) >= self.max_queue:
-                obs.counter("serving.shed")
+                obs.counter("serving.shed", **self.labels)
                 raise Overloaded(
                     f"admission queue at capacity ({self.max_queue}); "
                     "shedding")
@@ -182,8 +186,9 @@ class MicroBatcher:
         now = time.perf_counter()
         for t in batch:
             t.t_dequeue = now
-            obs.histogram("serving.enqueue_seconds", now - t.t_submit)
-        obs.gauge("serving.queue_depth", depth_after)
+            obs.histogram("serving.enqueue_seconds", now - t.t_submit,
+                          **self.labels)
+        obs.gauge("serving.queue_depth", depth_after, **self.labels)
         return batch
 
     def close(self):

@@ -110,12 +110,19 @@ class ServingEngine:
     than this triggers a flight-recorder dump (``flight_record`` events
     carrying the last ``flight_capacity`` per-request traces).  None
     disables the breach trigger; shed and degraded dumps stay on.
+
+    ``tenant``: multi-tenant attribution — when set, every serving.*
+    metric this engine (and its batcher) writes carries a
+    ``tenant=<name>`` label, and its ``serving_publish`` events and
+    flight-recorder dumps carry a ``tenant`` field, so a breach in a
+    shared process is attributable from the obs trail alone
+    (tpu_als.tenancy; docs/tenancy.md).
     """
 
     def __init__(self, k=10, buckets=None, shortlist_k=64,
                  max_queue=1024, max_wait_s=0.002,
                  default_deadline_s=None, item_chunk=8192,
-                 slo_s=None, flight_capacity=64):
+                 slo_s=None, flight_capacity=64, tenant=None):
         if buckets is None:
             # bucket plan from the execution planner: a banked ladder
             # for this device/jax key wins, else DEFAULT_BUCKETS — and
@@ -127,10 +134,12 @@ class ServingEngine:
         self.shortlist_k = int(shortlist_k)
         self.item_chunk = int(item_chunk)
         self.slo_s = float(slo_s) if slo_s is not None else None
+        self.tenant = str(tenant) if tenant is not None else None
+        self._labels = {"tenant": self.tenant} if self.tenant else {}
         self.flight = FlightRecorder(flight_capacity)
         self.batcher = MicroBatcher(
             buckets=buckets, max_queue=max_queue, max_wait_s=max_wait_s,
-            default_deadline_s=default_deadline_s)
+            default_deadline_s=default_deadline_s, labels=self._labels)
         self._model = None              # _Published; swapped atomically
         self._publish_lock = threading.Lock()
         self._cadence = None            # plan-resolved, on first use
@@ -177,12 +186,13 @@ class ServingEngine:
             self._model = _Published(seq, U, V, valid, index)
             self._seq = seq
         fresh = bool(index is not None and index.seq == seq)
-        obs.counter("serving.publishes")
+        obs.counter("serving.publishes", **self._labels)
         obs.histogram("serving.publish_seconds",
                       time.perf_counter() - t0,
-                      mode="full" if fresh else "none")
+                      mode="full" if fresh else "none", **self._labels)
         obs.emit("serving_publish", seq=seq, items=Ni, quantized=fresh,
-                 mode="full" if fresh else "none", delta_rows=0)
+                 mode="full" if fresh else "none", delta_rows=0,
+                 **self._labels)
         return seq
 
     def publish_update(self, U, V, *, touched_items=None,
@@ -266,13 +276,15 @@ class ServingEngine:
                     mode = "none"
             self._model = _Published(seq, U, V, valid, index)
             self._seq = seq
-        obs.counter("serving.publishes")
+        obs.counter("serving.publishes", **self._labels)
         obs.histogram("serving.publish_seconds",
-                      time.perf_counter() - t0, mode=mode)
+                      time.perf_counter() - t0, mode=mode,
+                      **self._labels)
         obs.emit("serving_publish", seq=seq, items=Ni,
                  quantized=bool(index is not None), mode=mode,
                  delta_rows=(index.delta_count
-                             if index is not None else 0))
+                             if index is not None else 0),
+                 **self._labels)
         return seq, mode
 
     def _live_cadence(self):
@@ -382,11 +394,12 @@ class ServingEngine:
         except Overloaded:
             # a shed never queues: its trace is the admission span alone
             self.flight.record(
-                "shed", {"admission": time.perf_counter() - t_enter})
+                "shed", {"admission": time.perf_counter() - t_enter},
+                **self._labels)
             self.flight.dump("shed")
             raise
         t.t_admit = time.perf_counter() - t_enter
-        obs.counter("serving.requests")
+        obs.counter("serving.requests", **self._labels)
         return t
 
     def recommend(self, payload, k=None, deadline_s=None, timeout=None):
@@ -436,7 +449,7 @@ class ServingEngine:
                             {"admission": t.t_admit,
                              "queue_wait": (t.t_dequeue - t.t_submit
                                             if t.t_dequeue else None)},
-                            error=type(e).__name__)
+                            error=type(e).__name__, **self._labels)
                 if not isinstance(e, faults.InjectedFault):
                     obs.emit("warning", what="serving.batch",
                              reason=f"{type(e).__name__}: {e}")
@@ -451,13 +464,13 @@ class ServingEngine:
         live = []
         for t in batch:
             if t.deadline is not None and now > t.deadline:
-                obs.counter("serving.expired")
+                obs.counter("serving.expired", **self._labels)
                 self.flight.record(
                     "expired",
                     {"admission": t.t_admit,
                      "queue_wait": (t.t_dequeue - t.t_submit
                                     if t.t_dequeue else None)},
-                    e2e_seconds=now - t.t_submit)
+                    e2e_seconds=now - t.t_submit, **self._labels)
                 t.fail(DeadlineExceeded(
                     "deadline passed while queued "
                     f"({now - t.t_submit:.4f}s since submit)"))
@@ -478,13 +491,13 @@ class ServingEngine:
             else:
                 rows[j] = t.payload
                 rowmask[j] = True
-        obs.histogram("serving.batch_rows", n)
+        obs.histogram("serving.batch_rows", n, **self._labels)
 
         index = m.index
         use_index = (index is not None and index.seq == m.seq
                      and mode != "corrupt")
         if index is not None and not use_index:
-            obs.counter("serving.fallback_exact", n)
+            obs.counter("serving.fallback_exact", n, **self._labels)
         path = "int8" if use_index else "exact"
         t0 = time.perf_counter()
         Ub = _select_rows(m.U, jnp.asarray(ids), jnp.asarray(rows),
@@ -498,14 +511,15 @@ class ServingEngine:
         s = np.asarray(s)
         ix = np.asarray(ix)
         score_s = time.perf_counter() - t0
-        obs.histogram("serving.score_seconds", score_s, path=path)
+        obs.histogram("serving.score_seconds", score_s, path=path,
+                      **self._labels)
         done = time.perf_counter()
         breached = False
         for j, t in enumerate(live):
             kk = t.k or self.k
             t.complete((s[j, :kk], ix[j, :kk]))
             e2e = done - t.t_submit
-            obs.histogram("serving.e2e_seconds", e2e)
+            obs.histogram("serving.e2e_seconds", e2e, **self._labels)
             # rescore is fused into the int8 top-k executable (one
             # jitted call — serving/index.py), so it is not separable
             # from score without un-fusing the kernel; None records that
@@ -516,7 +530,7 @@ class ServingEngine:
                                 if t.t_dequeue else None),
                  "score": score_s,
                  "respond": time.perf_counter() - done},
-                e2e_seconds=e2e, path=path)
+                e2e_seconds=e2e, path=path, **self._labels)
             if self.slo_s is not None and e2e > self.slo_s:
                 breached = True
         if breached:

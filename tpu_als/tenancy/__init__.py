@@ -1,0 +1,25 @@
+"""Multi-tenant model control plane: many models on one mesh.
+
+``tpu_als.tenancy.registry`` holds tenant identity — each tenant owns a
+full single-tenant serving stack (engine, int8 index, optional live
+updater) with namespaced publish seq-spaces and tenant-labeled obs.
+``tpu_als.tenancy.scheduler`` is the shared admission front door: one
+:class:`MultiTenantEngine` with weighted fair-share scheduling, typed
+per-tenant shedding (:class:`TenantOverloaded`) and per-batch fault
+isolation.  See docs/tenancy.md.
+"""
+
+from tpu_als.tenancy.registry import (  # noqa: F401
+    GUARDRAIL_MODES,
+    DuplicateTenant,
+    TenancyError,
+    Tenant,
+    TenantRegistry,
+    TenantSpec,
+    UnknownTenant,
+)
+from tpu_als.tenancy.scheduler import (  # noqa: F401
+    FairShareScheduler,
+    MultiTenantEngine,
+    TenantOverloaded,
+)

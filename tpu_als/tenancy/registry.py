@@ -1,0 +1,253 @@
+"""Tenant registry: N independent model universes in one process.
+
+Each registered tenant owns the full single-tenant serving stack —
+its own :class:`~tpu_als.serving.engine.ServingEngine` (factors, int8
+candidate index, admission queue, flight recorder, SLO) and optionally
+its own fold-in + :class:`~tpu_als.live.LiveUpdater` pipeline — so the
+isolation properties the single-tenant pieces already prove carry over
+verbatim:
+
+- **Namespaced seq-spaces.**  Publish sequence numbers live on the
+  tenant's engine; tenant A's torn publish can tag only A's index
+  stale.  There is no shared generation state to corrupt.
+- **Per-tenant budgets.**  Queue depth (``max_queue``), coalescing
+  window, deadlines and the latency SLO are all per-tenant knobs on
+  the tenant's own batcher/engine; one tenant's overload raises
+  :class:`~tpu_als.tenancy.scheduler.TenantOverloaded` naming that
+  tenant and sheds only its requests.
+- **Attributable obs.**  The engine/updater are constructed with
+  ``tenant=<name>``, so every ``serving.*``/``live.*`` series, every
+  ``serving_publish``/``live_update`` event and every flight-recorder
+  dump carries the tenant — a breach in the shared process is
+  attributable from the trail alone.
+
+What IS shared is deliberate: the planner's plan cache (bucket ladder
+and live cadence key on device/rank/dtype, not tenant name) and JAX's
+process-global compile cache — same-shaped tenants reuse one set of
+compiled scoring executables (``plan.resolve_tenant_plan``), the
+compile-sharing win that makes N tenants on one mesh cheaper than N
+processes.  See docs/tenancy.md.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass, field
+
+from tpu_als import obs
+
+# tenant names become metric label values and event fields; keep them
+# to a slug so downstream tooling (PromQL selectors, file names) never
+# needs quoting or escaping
+_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9_-]{0,31}$")
+
+GUARDRAIL_MODES = ("off", "abort", "recover")
+
+
+class TenancyError(RuntimeError):
+    """Base class for control-plane failures."""
+
+
+class UnknownTenant(TenancyError):
+    """An operation named a tenant nobody registered; carries
+    ``available`` so every surface can list what IS registered."""
+
+    def __init__(self, name, available):
+        self.name = name
+        self.available = tuple(available)
+        super().__init__(
+            f"unknown tenant {name!r} (registered: "
+            f"{', '.join(self.available) or '<none>'})")
+
+
+class DuplicateTenant(TenancyError):
+    """``register`` was called twice for one name — tenant identity is
+    the isolation boundary, so silently replacing a live engine would
+    strand its in-flight tickets."""
+
+    def __init__(self, name):
+        self.name = name
+        super().__init__(f"tenant {name!r} is already registered "
+                         "(remove it first)")
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """Declarative per-tenant serving contract.
+
+    ``weight`` is the fair-share scheduling weight (a weight-2 tenant
+    is entitled to twice the served rows of a weight-1 tenant under
+    contention); the queue/deadline/SLO fields are the tenant's own
+    admission budgets, applied to ITS engine only.  ``buckets=None``
+    resolves through the planner per shape-class
+    (``plan.resolve_tenant_plan``).  ``guardrail_mode`` is the
+    training-side posture the tenant's re-fits run under
+    (``resilience.guardrails.scoped``).
+    """
+
+    name: str
+    weight: float = 1.0
+    k: int = 10
+    shortlist_k: int = 64
+    buckets: tuple = None
+    max_queue: int = 1024
+    max_wait_s: float = 0.002
+    default_deadline_s: float = None
+    slo_s: float = None
+    freshness_slo_s: float = None
+    fold_items: bool = False
+    guardrail_mode: str = "abort"
+    flight_capacity: int = 64
+
+    def __post_init__(self):
+        if not _NAME_RE.match(self.name or ""):
+            raise ValueError(
+                f"tenant name {self.name!r} must match "
+                f"{_NAME_RE.pattern} (it becomes a metric label value)")
+        if not self.weight > 0:
+            raise ValueError(f"tenant {self.name!r}: weight must be "
+                             f"> 0, got {self.weight}")
+        if self.guardrail_mode not in GUARDRAIL_MODES:
+            raise ValueError(
+                f"tenant {self.name!r}: guardrail_mode "
+                f"{self.guardrail_mode!r} not in {GUARDRAIL_MODES}")
+
+
+@dataclass
+class Tenant:
+    """One admitted tenant: its spec, its engine, and (when live
+    updates are attached) its fold-in pipeline.  ``shape_class`` is the
+    planner bucketing its plan resolved under — tenants sharing it (at
+    equal rank) share compiled executables."""
+
+    spec: TenantSpec
+    engine: object
+    shape_class: str = "generic"
+    foldin: object = None
+    updater: object = None
+    served_rows: int = 0            # scheduler-maintained goodput
+    vtime: float = field(default=0.0, repr=False)   # fair-share clock
+
+    @property
+    def name(self):
+        return self.spec.name
+
+
+class TenantRegistry:
+    """The control plane's source of truth: name -> :class:`Tenant`.
+
+    ``register`` builds the tenant's engine (tenant-labeled), resolves
+    its plan per shape-class, and performs the tenant's FIRST atomic
+    publish — a tenant is never registered without a servable model.
+    Thread-safe; the scheduler iterates a snapshot.
+    """
+
+    def __init__(self):
+        self._tenants = {}
+        self._lock = threading.Lock()
+
+    # -- membership ---------------------------------------------------
+    def register(self, spec, U, V, *, item_valid=None, quantize=True):
+        """Admit one tenant and publish its initial factors.  Returns
+        the :class:`Tenant`.  Raises :class:`DuplicateTenant` on a name
+        collision, ``ValueError`` on a malformed spec."""
+        import numpy as np
+
+        from tpu_als import plan as _plan
+        from tpu_als.serving.engine import ServingEngine
+
+        U = np.asarray(U, dtype=np.float32)
+        V = np.asarray(V, dtype=np.float32)
+        tplan = _plan.resolve_tenant_plan(
+            rank=U.shape[1], n_users=U.shape[0], n_items=V.shape[0],
+            requested_buckets=spec.buckets)
+        engine = ServingEngine(
+            k=spec.k, buckets=tplan["buckets"],
+            shortlist_k=spec.shortlist_k, max_queue=spec.max_queue,
+            max_wait_s=spec.max_wait_s,
+            default_deadline_s=spec.default_deadline_s,
+            slo_s=spec.slo_s, flight_capacity=spec.flight_capacity,
+            tenant=spec.name)
+        tenant = Tenant(spec=spec, engine=engine,
+                        shape_class=tplan["shape_class"])
+        with self._lock:
+            if spec.name in self._tenants:
+                raise DuplicateTenant(spec.name)
+            self._tenants[spec.name] = tenant
+            n_now = len(self._tenants)
+        engine.publish(U, V, item_valid=item_valid, quantize=quantize)
+        obs.gauge("tenancy.tenants", n_now)
+        obs.emit("tenant_registered", tenant=spec.name,
+                 users=int(U.shape[0]), items=int(V.shape[0]),
+                 shape_class=tenant.shape_class,
+                 weight=spec.weight)
+        return tenant
+
+    def attach_live(self, name, foldin, **updater_kwargs):
+        """Wire a live fold-in → publish pipeline onto a registered
+        tenant: its own :class:`LiveUpdater` over ``foldin``, labeled
+        with the tenant's name (the updater is created but NOT started
+        — lifecycle belongs to the caller/engine front door)."""
+        from tpu_als.live import LiveUpdater
+
+        tenant = self.get(name)
+        if tenant.updater is not None:
+            raise TenancyError(
+                f"tenant {name!r} already has a live updater attached")
+        updater_kwargs.setdefault("fold_items", tenant.spec.fold_items)
+        if tenant.spec.freshness_slo_s is not None:
+            updater_kwargs.setdefault("slo_s",
+                                      tenant.spec.freshness_slo_s)
+        tenant.foldin = foldin
+        tenant.updater = LiveUpdater(tenant.engine, foldin,
+                                     tenant=name, **updater_kwargs)
+        return tenant.updater
+
+    def remove(self, name):
+        """Deregister a tenant: stop its updater and engine, drop the
+        reference (releasing its device buffers)."""
+        with self._lock:
+            tenant = self._tenants.pop(name, None)
+            n_now = len(self._tenants)
+        if tenant is None:
+            raise UnknownTenant(name, self.names())
+        if tenant.updater is not None:
+            tenant.updater.stop()
+        tenant.engine.stop()
+        obs.gauge("tenancy.tenants", n_now)
+        obs.emit("tenant_removed", tenant=name)
+        return tenant
+
+    # -- lookup -------------------------------------------------------
+    def get(self, name):
+        with self._lock:
+            tenant = self._tenants.get(name)
+        if tenant is None:
+            raise UnknownTenant(name, self.names())
+        return tenant
+
+    def names(self):
+        with self._lock:
+            return tuple(self._tenants)
+
+    def tenants(self):
+        """Snapshot of the registered tenants (safe to iterate while
+        register/remove proceed on other threads)."""
+        with self._lock:
+            return tuple(self._tenants.values())
+
+    def __len__(self):
+        with self._lock:
+            return len(self._tenants)
+
+    def __contains__(self, name):
+        with self._lock:
+            return name in self._tenants
+
+    def shape_classes(self):
+        """shape_class -> tenant names, the compile-sharing report."""
+        out = {}
+        for t in self.tenants():
+            out.setdefault(t.shape_class, []).append(t.name)
+        return out

@@ -1,0 +1,263 @@
+"""Fair-share admission front door: one scheduler, N tenant engines.
+
+The single-tenant :class:`ServingEngine` pairs one background thread
+with one queue.  N tenants could run N threads, but then the OS
+scheduler — not the control plane — decides who gets the device under
+contention, and a flooded tenant's thread can starve its neighbors'
+score calls.  Instead the :class:`MultiTenantEngine` runs ONE scheduler
+thread over every tenant's queue and makes the sharing policy explicit:
+
+- **Stride (weighted fair-share) scheduling.**  Each tenant carries a
+  virtual time advanced by ``served_rows / weight`` whenever one of its
+  micro-batches is scored; each round the backlogged tenant with the
+  minimum virtual time is served next.  Over any contention window a
+  tenant's share of served rows converges to ``weight / Σweights`` —
+  a flooding tenant cannot buy more than its share, it can only fill
+  its own queue and shed.
+- **Typed per-tenant shedding.**  Admission rides each tenant's own
+  bounded :class:`MicroBatcher`; at capacity the submit raises
+  :class:`TenantOverloaded` (an :class:`Overloaded` subclass naming
+  the tenant), counted into ``serving.shed{tenant=...}``.  A neighbor
+  with a drained queue is untouched.
+- **Fault isolation per batch.**  A tenant batch that raises (an
+  injected ``serving.score`` fault, a poisoned model) fails only that
+  batch's tickets (the single-engine ``_run`` contract) and counts
+  ``tenancy.batch_errors{tenant=...}``; the scheduler round continues
+  with the next tenant.
+- **Lazy virtual-time admission.**  A tenant that joins (or idles) is
+  admitted at the CURRENT minimum virtual time, not zero — otherwise
+  a newcomer would monopolize the mesh "catching up" on time it never
+  queued for (the classic stride-scheduler join rule).
+
+See docs/tenancy.md for the policy walkthrough and the
+``tenant-isolation`` scenario for the proof under faults.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tpu_als import obs
+from tpu_als.resilience import faults
+from tpu_als.serving.batcher import Overloaded
+from tpu_als.tenancy.registry import TenantRegistry, TenantSpec
+
+__all__ = ["FairShareScheduler", "MultiTenantEngine",
+           "TenantOverloaded"]
+
+
+class TenantOverloaded(Overloaded):
+    """One tenant's admission queue is at capacity.  Subclasses the
+    serving :class:`Overloaded` so existing back-off handlers keep
+    working; carries ``tenant`` so load balancers shed per tenant, not
+    per process."""
+
+    def __init__(self, tenant, message):
+        self.tenant = tenant
+        super().__init__(f"tenant {tenant!r}: {message}")
+
+
+class FairShareScheduler:
+    """Stride scheduling over the registry's tenants.
+
+    Pure policy, no threads: :meth:`pick` selects the backlogged tenant
+    with minimum virtual time (ties break by name, deterministically);
+    :meth:`charge` advances the served tenant's clock by
+    ``rows / weight``.  Virtual times live on the :class:`Tenant`
+    records, so the goodput accounting and the policy state are one
+    structure; the scheduler itself carries only the global virtual
+    clock (the vtime of the last tenant it picked) and the set of
+    tenants active in the previous round.
+    """
+
+    def __init__(self):
+        self._clock = 0.0
+        self._active = set()
+
+    def pick(self, backlogged):
+        """The next tenant to serve among ``backlogged`` (non-empty).
+        A tenant entering the rotation — newly registered, or returning
+        from idle — is floored to the global virtual clock first:
+        joining (or sitting idle) must not bank retroactive share.
+        Tenants that stayed in the rotation keep their earned deficit
+        untouched, so weighted shares hold exactly under contention."""
+        for t in backlogged:
+            if t.name not in self._active and t.vtime < self._clock:
+                t.vtime = self._clock
+        self._active = {t.name for t in backlogged}
+        chosen = min(backlogged, key=lambda t: (t.vtime, t.name))
+        self._clock = max(self._clock, chosen.vtime)
+        return chosen
+
+    def charge(self, tenant, rows):
+        tenant.vtime += rows / tenant.spec.weight
+        tenant.served_rows += rows
+        obs.counter("tenancy.served_rows", rows, tenant=tenant.name)
+
+
+class MultiTenantEngine:
+    """Many models behind one admission front door.
+
+    ``submit``/``recommend`` take the tenant name first; publishes and
+    live updates are delegated to the named tenant's own engine/updater
+    (seq-spaces stay per-tenant).  One scheduler thread drives every
+    tenant's batcher through :class:`FairShareScheduler`; the per-batch
+    serve path is the single-tenant ``ServingEngine.serve_batch``,
+    unchanged — this class adds policy, not scoring.
+    """
+
+    def __init__(self, registry=None, idle_wait_s=0.05):
+        self.registry = registry if registry is not None \
+            else TenantRegistry()
+        self.scheduler = FairShareScheduler()
+        self.idle_wait_s = float(idle_wait_s)
+        self._work = threading.Event()
+        self._stopping = threading.Event()
+        self._thread = None
+
+    # -- tenant lifecycle ---------------------------------------------
+    def add_tenant(self, spec, U, V, **publish_kwargs):
+        """Register a tenant (see :meth:`TenantRegistry.register`);
+        ``spec`` may be a :class:`TenantSpec` or a plain name."""
+        if isinstance(spec, str):
+            spec = TenantSpec(name=spec)
+        return self.registry.register(spec, U, V, **publish_kwargs)
+
+    def remove_tenant(self, name):
+        return self.registry.remove(name)
+
+    def attach_live(self, name, foldin, **updater_kwargs):
+        """Attach and START the tenant's live fold-in pipeline (the
+        front door owns running tenants' lifecycles)."""
+        updater = self.registry.attach_live(name, foldin,
+                                            **updater_kwargs)
+        updater.start()
+        return updater
+
+    def tenant(self, name):
+        return self.registry.get(name)
+
+    # -- per-tenant model lifecycle -----------------------------------
+    def publish(self, name, U, V, **kwargs):
+        """Atomic publish into ONE tenant's seq-space."""
+        return self.registry.get(name).engine.publish(U, V, **kwargs)
+
+    def publish_update(self, name, U, V, **kwargs):
+        """Incremental (fold-in) publish into one tenant's seq-space;
+        returns ``(seq, mode)``."""
+        return self.registry.get(name).engine.publish_update(
+            U, V, **kwargs)
+
+    def published_seq(self, name):
+        return self.registry.get(name).engine.published_seq
+
+    def warmup(self, name=None):
+        """Compile the scoring executables (one tenant, or all).
+        Same-shaped tenants hit JAX's process-global compile cache
+        after the first — the compile-sharing win ``resolve_tenant_
+        plan`` keys for."""
+        tenants = ([self.registry.get(name)] if name is not None
+                   else self.registry.tenants())
+        for t in tenants:
+            t.engine.warmup()
+
+    # -- request path -------------------------------------------------
+    def submit(self, name, payload, k=None, deadline_s=None):
+        """Admit one request for ``name``; returns its ticket.  Raises
+        :class:`UnknownTenant` for an unregistered name and
+        :class:`TenantOverloaded` when THAT tenant's queue is full —
+        the refusal never touches a neighbor's budget."""
+        tenant = self.registry.get(name)
+        try:
+            ticket = tenant.engine.submit(payload, k=k,
+                                          deadline_s=deadline_s)
+        except Overloaded as e:
+            raise TenantOverloaded(name, str(e)) from None
+        self._work.set()
+        return ticket
+
+    def recommend(self, name, payload, k=None, deadline_s=None,
+                  timeout=None):
+        """Submit + block: ``(scores, indices)`` for one request."""
+        return self.submit(name, payload, k=k,
+                           deadline_s=deadline_s).result(timeout)
+
+    # -- scheduler loop -----------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._stopping.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-als-tenancy", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain_timeout_s=10.0):
+        """Stop every tenant's updater, close every admission queue,
+        drain in-flight batches, join the scheduler."""
+        for t in self.registry.tenants():
+            if t.updater is not None:
+                t.updater.stop()
+            t.engine.batcher.close()
+        self._stopping.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(drain_timeout_s)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _backlogged(self):
+        return [t for t in self.registry.tenants()
+                if t.engine.batcher.depth() > 0]
+
+    def _run(self):
+        while True:
+            served = self._drain_round()
+            if not served:
+                if self._stopping.is_set() and not self._backlogged():
+                    return
+                self._work.wait(self.idle_wait_s)
+                self._work.clear()
+
+    def _drain_round(self):
+        """Serve until every queue is empty, one fair-share pick per
+        micro-batch.  Returns whether anything was served."""
+        served_any = False
+        while True:
+            backlogged = self._backlogged()
+            if not backlogged:
+                return served_any
+            tenant = self.scheduler.pick(backlogged)
+            # timeout=0: we just saw depth > 0; a race to empty simply
+            # returns None and the round re-checks the backlog
+            batch = tenant.engine.batcher.next_batch(timeout=0)
+            if not batch:
+                continue
+            served_any = True
+            try:
+                tenant.engine.serve_batch(batch)
+            except BaseException as e:  # noqa: BLE001 — isolate the tenant
+                # the single-engine _run contract, scoped to ONE
+                # tenant: its undone tickets fail, its error is
+                # counted against it, and the round moves on — a
+                # neighbor's batch never sees this exception
+                for t in batch:
+                    if not t.done():
+                        t.fail(e)
+                        tenant.engine.flight.record(
+                            "failed",
+                            {"admission": t.t_admit,
+                             "queue_wait": (t.t_dequeue - t.t_submit
+                                            if t.t_dequeue else None)},
+                            error=type(e).__name__, tenant=tenant.name)
+                obs.counter("tenancy.batch_errors", tenant=tenant.name)
+                if not isinstance(e, faults.InjectedFault):
+                    obs.emit("warning", what="tenancy.batch",
+                             reason=f"tenant {tenant.name!r}: "
+                                    f"{type(e).__name__}: {e}")
+            self.scheduler.charge(tenant, len(batch))

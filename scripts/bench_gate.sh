@@ -5,6 +5,10 @@
 #
 #   - the LATEST round of every bench series against the best prior
 #     round, beyond a noise band (default 10%, unit-direction aware),
+#   - the TREND of each series: a least-squares fit over the last
+#     --trend-window rounds (default 5, needs >= 3 points) drifting in
+#     the worse direction beyond the band fails even when the latest
+#     round alone passes — the slow-slide case (--no-trend disables),
 #   - ``value: null`` banks with no sweep-fallback recovery,
 #   - multichip rounds whose latest attempt is not ok,
 #   - direct banks missing tz-aware ``banked_at`` provenance.
@@ -16,6 +20,7 @@
 # Typed exit codes:  0 OK   1 REGRESSION   2 NULL BANK   3 PROVENANCE
 #
 # Usage: scripts/bench_gate.sh [root] [--noise F] [--strict] [--json]
+#                              [--no-trend] [--trend-window N]
 #        (root defaults to the repo root — the committed banks)
 set -u
 
@@ -32,9 +37,13 @@ ap = argparse.ArgumentParser(prog="bench_gate.sh")
 ap.add_argument("root", nargs="?", default=".")
 ap.add_argument("--noise", type=float, default=0.10)
 ap.add_argument("--strict", action="store_true")
+ap.add_argument("--no-trend", dest="trend", action="store_false",
+                default=True)
+ap.add_argument("--trend-window", type=int, default=5)
 ap.add_argument("--json", action="store_true")
 a = ap.parse_args()
-result = regress.check(a.root, noise=a.noise, strict=a.strict)
+result = regress.check(a.root, noise=a.noise, strict=a.strict,
+                       trend=a.trend, trend_window=a.trend_window)
 print(json.dumps(result) if a.json else regress.render(result))
 sys.exit(result["exit_code"])
 ' "$@"

@@ -8,9 +8,10 @@
 #      into the stdlib-only stage 1 fails here, not in a jax-less CI
 #      container.  The checked-in baseline (lint_baseline.txt) is
 #      policy-EMPTY, so any finding is a failure.
-#   2. the jaxpr contract registry — the four byte pins
-#      (ne_audit, guardrails_disarmed, plan_cache_off, comm_audit)
-#      re-verified through the real CLI on an 8-device CPU backend.
+#   2. the jaxpr contract registry — the named byte pins (ne_audit,
+#      guardrails_disarmed, tracing_disarmed, plan_cache_off,
+#      comm_audit, live_delta_index) re-verified through the real CLI
+#      on an 8-device CPU backend.
 #
 # Usage: scripts/lint_smoke.sh   (from the repo root; ~1 min on CPU)
 set -u

@@ -215,8 +215,8 @@ def test_contracts_resolvable_by_name():
     from tpu_als.analysis import contracts
 
     assert set(contracts.names()) == {
-        "ne_audit", "guardrails_disarmed", "plan_cache_off",
-        "comm_audit", "live_delta_index"}
+        "ne_audit", "guardrails_disarmed", "tracing_disarmed",
+        "plan_cache_off", "comm_audit", "live_delta_index"}
     for name in contracts.names():
         c = contracts.get(name)
         assert c.name == name

@@ -207,3 +207,103 @@ def test_bench_gate_is_jax_free(tmp_path):
         env={**os.environ, "PYTHONPATH": str(poison)})
     assert p.returncode == 0, p.stdout + p.stderr
     assert "verdict: OK" in p.stdout
+
+
+# -- the trend-aware gate (--trend) ----------------------------------------
+
+
+_MASKED_SLIDE = [10.0, 9.2, 8.6, 8.0, 9.2]
+# latest 9.2 vs best prior 10.0 is -8%: INSIDE the 10% band, so the
+# plain latest-vs-best gate passes — but the least-squares fit over all
+# five rounds loses ~11% of its starting value: the masking case the
+# trend gate exists for
+
+
+def _series(d, vals, unit="iters/sec", name="BENCH"):
+    for n, v in enumerate(vals, 1):
+        _write(d, f"{name}_r{n:02d}.json", _round(n, v, unit=unit))
+
+
+def test_trend_catches_masked_regression(tmp_path):
+    _series(tmp_path, _MASKED_SLIDE)
+    assert regress.check(str(tmp_path))["exit_code"] == regress.EXIT_OK
+    result = regress.check(str(tmp_path), trend=True)
+    assert result["exit_code"] == regress.EXIT_REGRESSION
+    assert any("trend" in f["message"] and "falling" in f["message"]
+               for f in result["findings"])
+
+
+def test_trend_clean_on_stable_series(tmp_path):
+    _series(tmp_path, [10.0, 10.2, 9.9, 10.1, 10.0])
+    assert regress.check(str(tmp_path), trend=True)["exit_code"] == \
+        regress.EXIT_OK
+
+
+def test_trend_direction_aware(tmp_path):
+    # an IMPROVING series drifts steeply but in the better direction
+    _series(tmp_path, [8.0, 8.6, 9.2, 10.0])
+    assert regress.check(str(tmp_path), trend=True)["exit_code"] == \
+        regress.EXIT_OK
+    # lower-better unit: the same RISING values are now a regression
+    _series(tmp_path, [8.0, 8.6, 9.2, 10.0], unit="ms",
+            name="BENCH_lat")
+    result = regress.check(str(tmp_path), trend=True)
+    assert result["exit_code"] == regress.EXIT_REGRESSION
+    assert any("rising" in f["message"] for f in result["findings"])
+
+
+def test_trend_needs_three_points(tmp_path):
+    # a 2-point slide is latest-vs-best territory; the trend fit stays
+    # quiet (this also keeps the committed 2-point BENCH_r history
+    # trend-clean at the repo root)
+    _series(tmp_path, [10.0, 8.9])
+    result = regress.check(str(tmp_path), trend=True)
+    assert not any("trend" in f["message"] for f in result["findings"])
+
+
+def test_trend_window_bounds_the_fit(tmp_path):
+    # ancient history outside the window must not drag the fit: the
+    # last 3 rounds are flat, the slide is 5 rounds old (the plain
+    # latest-vs-best finding fires either way — judge the TREND
+    # findings specifically)
+    _series(tmp_path, [14.0, 12.0, 10.0, 10.0, 10.0, 10.0])
+
+    def trend_findings(window):
+        result = regress.check(str(tmp_path), trend=True,
+                               trend_window=window)
+        return [f for f in result["findings"] if "trend" in f["message"]]
+
+    assert not trend_findings(3)
+    assert trend_findings(6)
+
+
+def test_committed_banks_gate_clean_with_trend():
+    # scripts/bench_gate.sh now runs with trend ON by default — the
+    # committed history must hold under the stronger gate
+    result = regress.check(REPO, trend=True)
+    assert result["exit_code"] == regress.EXIT_OK
+    assert result["trend"] is True
+
+
+def test_trend_cli_flag(tmp_path, capsys):
+    _series(tmp_path, _MASKED_SLIDE)
+    cli_main(["observe", "regress", str(tmp_path)])
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as e:
+        cli_main(["observe", "regress", str(tmp_path), "--trend"])
+    assert e.value.code == regress.EXIT_REGRESSION
+    out = capsys.readouterr().out
+    assert "trend window 5" in out
+
+
+def test_bench_gate_script_no_trend_flag(tmp_path):
+    # the script gates with trend by default; --no-trend restores the
+    # plain latest-vs-best behaviour
+    _series(tmp_path, _MASKED_SLIDE)
+    gate = os.path.join(REPO, "scripts", "bench_gate.sh")
+    p = subprocess.run(["bash", gate, str(tmp_path)],
+                       capture_output=True, text=True)
+    assert p.returncode == regress.EXIT_REGRESSION, p.stdout + p.stderr
+    p = subprocess.run(["bash", gate, str(tmp_path), "--no-trend"],
+                       capture_output=True, text=True)
+    assert p.returncode == 0, p.stdout + p.stderr

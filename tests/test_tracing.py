@@ -1,0 +1,559 @@
+"""End-to-end causal tracing (tpu_als/obs/tracing.py + explain + the
+propagation sites in serving/live/tenancy).
+
+Four layers:
+
+1. the context mechanics — deterministic ids, arming discipline,
+   chaining semantics, schema validation at the emit site;
+2. propagation through the real subsystems, happy path AND the fault
+   matrix (shed, expired, torn publish, tenant batch failure, live
+   poison-quarantine): every outcome leaves a COMPLETE linked span
+   tree in the trail, refusals included;
+3. the read side — ``observe explain`` reconstructs trees from the
+   JSONL alone (jax-free, pinned by a poisoned-jax subprocess), the
+   tail filters slice by tenant/trace, flight records carry the
+   structural tenant + trace attribution;
+4. the zero-overhead contract — disarmed tracing leaves the production
+   step's jaxpr byte-identical (``tracing_disarmed`` in the contract
+   registry).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tpu_als import obs
+from tpu_als.obs import report, tracing
+from tpu_als.obs import explain as explain_mod
+from tpu_als.obs.trace import FlightRecorder
+from tpu_als.resilience import faults
+from tpu_als.serving import DeadlineExceeded, Overloaded, ServingEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    """Disarmed faults + tracing, fresh registry, counter reset to a
+    known seed so span/trace ids in assertions are literal."""
+    faults.clear()
+    tracing.disable_tracing()
+    tracing.reset_trace_ids(seed=0)
+    reg = obs.reset()
+    yield reg
+    faults.clear()
+    tracing.disable_tracing()
+
+
+def _spans(reg):
+    return [e for e in reg._events if e.get("type") == "trace_span"]
+
+
+def _engine(rng, n=30, Ni=60, r=8, k=5, **kw):
+    eng = ServingEngine(k=k, buckets=(8,), shortlist_k=16,
+                        max_wait_s=0.0, **kw)
+    U = rng.normal(size=(n, r)).astype(np.float32)
+    V = rng.normal(size=(Ni, r)).astype(np.float32)
+    eng.publish(U, V)
+    return eng, U, V
+
+
+def _drain_one(eng):
+    batch = eng.batcher.next_batch(timeout=1.0)
+    assert batch is not None
+    eng.serve_batch(batch)
+    return batch
+
+
+# ---------------------------------------------------------------------------
+# 1. context mechanics
+
+
+def test_disarmed_is_the_default_and_mints_nothing(_fresh, rng):
+    assert not tracing.tracing_armed()
+    assert tracing.start_trace("serve.admit") is None
+    assert tracing.record_span(None, "serve.queue") is None
+    eng, _, _ = _engine(rng)
+    t = eng.submit(0)
+    _drain_one(eng)
+    t.result(timeout=1.0)
+    assert t.trace is None
+    assert not _spans(_fresh)
+
+
+def test_deterministic_ids_replay(_fresh):
+    with tracing.traced():
+        a = tracing.start_trace("serve.admit")
+        b = tracing.record_span(a, "serve.queue")
+        first = (a.trace_id, a.span_id, b.span_id)
+        tracing.reset_trace_ids(seed=0)
+        a2 = tracing.start_trace("serve.admit")
+        b2 = tracing.record_span(a2, "serve.queue")
+    assert (a2.trace_id, a2.span_id, b2.span_id) == first
+    # a different seed produces a disjoint id namespace
+    tracing.reset_trace_ids(seed=7)
+    with tracing.traced():
+        c = tracing.start_trace("serve.admit")
+    assert c.trace_id.startswith("t07-")
+    assert c.trace_id != a.trace_id
+
+
+def test_chaining_links_parent_ids(_fresh):
+    with tracing.traced():
+        ctx = tracing.start_trace("serve.admit", tenant="a")
+        child = tracing.record_span(ctx, "serve.queue", seconds=0.5)
+    assert child.trace_id == ctx.trace_id
+    assert child.parent_id == ctx.span_id
+    assert child.tenant == "a"
+    evs = _spans(_fresh)
+    assert [e["name"] for e in evs] == ["serve.admit", "serve.queue"]
+    assert evs[0]["parent_id"] is None
+    assert evs[1]["parent_id"] == evs[0]["span_id"]
+    assert all(e["tenant"] == "a" for e in evs)
+
+
+def test_undeclared_span_name_and_status_raise(_fresh):
+    with tracing.traced():
+        with pytest.raises(KeyError, match="TRACE_SPANS"):
+            tracing.start_trace("serve.bogus")
+        ctx = tracing.start_trace("serve.admit")
+        with pytest.raises(ValueError, match="undeclared status"):
+            tracing.record_span(ctx, "serve.queue", status="meh")
+
+
+def test_traced_scope_restores_prior_state(_fresh):
+    assert not tracing.tracing_armed()
+    with tracing.traced():
+        assert tracing.tracing_armed()
+        with tracing.traced():         # nested arming stays armed
+            assert tracing.tracing_armed()
+        assert tracing.tracing_armed()
+    assert not tracing.tracing_armed()
+    tracing.enable_tracing()
+    with tracing.traced():
+        pass
+    assert tracing.tracing_armed()     # pre-armed state is restored
+
+
+def test_env_flag_arms(monkeypatch, _fresh):
+    monkeypatch.setenv("TPU_ALS_TRACE", "1")
+    assert tracing.tracing_armed()
+    assert tracing.start_trace("serve.admit") is not None
+    monkeypatch.setenv("TPU_ALS_TRACE", "0")
+    assert not tracing.tracing_armed()
+
+
+# ---------------------------------------------------------------------------
+# 2. propagation under the fault matrix
+
+
+def test_serve_happy_path_full_chain(rng, _fresh):
+    with tracing.traced():
+        eng, _, _ = _engine(rng)
+        t = eng.submit(0)
+        _drain_one(eng)
+        t.result(timeout=1.0)
+    evs = _spans(_fresh)
+    assert [e["name"] for e in evs] == \
+        ["serve.admit", "serve.queue", "serve.score"]
+    assert len({e["trace_id"] for e in evs}) == 1
+    for parent, child in zip(evs, evs[1:]):
+        assert child["parent_id"] == parent["span_id"]
+    score = evs[-1]
+    assert score["seconds"] is not None and score["path"] in \
+        ("int8", "exact")
+
+
+def test_serve_shed_is_traced(rng, _fresh):
+    with tracing.traced():
+        eng, _, _ = _engine(rng, max_queue=2)
+        with pytest.raises(Overloaded):
+            for _ in range(10):        # engine loop not running
+                eng.submit(0)
+    evs = _spans(_fresh)
+    shed = [e for e in evs if e["status"] == "shed"]
+    assert shed and shed[-1]["name"] == "serve.queue"
+    # the shed queue hop chains off ITS request's admission span
+    admit = {e["span_id"]: e for e in evs if e["name"] == "serve.admit"}
+    assert shed[-1]["parent_id"] in admit
+    fl = [e for e in _fresh._events if e["type"] == "flight_record"]
+    assert fl[-1]["status"] == "shed"
+    assert fl[-1]["trace_id"] == shed[-1]["trace_id"]
+
+
+def test_serve_expired_is_traced(rng, _fresh):
+    with tracing.traced():
+        eng, _, _ = _engine(rng)
+        t_dead = eng.submit(0, deadline_s=0.0)
+        t_ok = eng.submit(1)
+        time.sleep(0.01)
+        _drain_one(eng)
+        with pytest.raises(DeadlineExceeded):
+            t_dead.result(timeout=1.0)
+        t_ok.result(timeout=1.0)
+    evs = _spans(_fresh)
+    expired = [e for e in evs if e["name"] == "serve.expired"]
+    assert len(expired) == 1 and expired[0]["status"] == "expired"
+    # both requests still have complete trees: admit -> queue -> leaf
+    by_trace = {}
+    for e in evs:
+        by_trace.setdefault(e["trace_id"], []).append(e["name"])
+    assert sorted(tuple(v) for v in by_trace.values()) == sorted([
+        ("serve.admit", "serve.queue", "serve.expired"),
+        ("serve.admit", "serve.queue", "serve.score")])
+
+
+def test_torn_publish_degraded_serve_is_traced(rng, _fresh):
+    """A torn publish (fresh index dropped, stale one carried) forces
+    the exact-score fallback; the request that rode the degraded path
+    says so in its own span tree."""
+    faults.install("serving.publish=corrupt@nth=2")
+    with tracing.traced():
+        eng, U, V = _engine(rng)
+        eng.publish(U, V)              # torn: carries the stale index
+        t = eng.submit(0)
+        _drain_one(eng)
+        t.result(timeout=1.0)
+    score = [e for e in _spans(_fresh) if e["name"] == "serve.score"]
+    assert score and score[-1]["path"] == "exact"
+    assert _fresh.snapshot()["counters"]["serving.fallback_exact"] == 1
+
+
+def test_serve_score_raise_failed_span(rng, _fresh):
+    faults.install("serving.score=raise@nth=1")
+    with tracing.traced():
+        eng, _, _ = _engine(rng)
+        eng.start()
+        try:
+            t = eng.submit(0)
+            with pytest.raises(Exception):
+                t.result(timeout=5.0)
+        finally:
+            eng.stop()
+    failed = [e for e in _spans(_fresh) if e["status"] == "failed"]
+    assert failed and failed[-1]["name"] == "serve.score"
+    assert failed[-1]["error"]
+
+
+def test_tenancy_round_links_scheduler_pick(rng, _fresh):
+    from tpu_als.tenancy import MultiTenantEngine, TenantOverloaded
+
+    with tracing.traced():
+        mte = MultiTenantEngine()
+        mte.add_tenant("a", rng.normal(size=(20, 4)).astype(np.float32),
+                       rng.normal(size=(15, 4)).astype(np.float32))
+        mte.warmup("a")
+        with mte:
+            mte.recommend("a", 2, timeout=10.0)
+    evs = _spans(_fresh)
+    assert [e["name"] for e in evs] == \
+        ["serve.admit", "serve.queue", "tenancy.round", "serve.score"]
+    rd = evs[2]
+    assert rd["round"] == 1 and rd["batch_rows"] == 1
+    assert all(e["tenant"] == "a" for e in evs)
+    for parent, child in zip(evs, evs[1:]):
+        assert child["parent_id"] == parent["span_id"]
+
+
+def test_tenant_overloaded_shed_is_traced(rng, _fresh):
+    from tpu_als.tenancy import (MultiTenantEngine, TenantOverloaded,
+                                 TenantSpec)
+
+    with tracing.traced():
+        mte = MultiTenantEngine()
+        mte.add_tenant(TenantSpec(name="b", max_queue=2),
+                       rng.normal(size=(20, 4)).astype(np.float32),
+                       rng.normal(size=(15, 4)).astype(np.float32))
+        with pytest.raises(TenantOverloaded):
+            for _ in range(10):        # scheduler not running
+                mte.submit("b", 2)
+    shed = [e for e in _spans(_fresh) if e["status"] == "shed"]
+    assert shed and shed[-1]["tenant"] == "b"
+
+
+def test_tenant_batch_failure_failed_spans(rng, _fresh):
+    from tpu_als.tenancy import MultiTenantEngine
+
+    faults.install("serving.score=raise@every=1")
+    with tracing.traced():
+        mte = MultiTenantEngine()
+        tn = mte.add_tenant(
+            "c", rng.normal(size=(20, 4)).astype(np.float32),
+            rng.normal(size=(15, 4)).astype(np.float32))
+        tk = mte.submit("c", 1)
+        mte._drain_round()             # one synchronous scheduler round
+        assert tk.done()
+        tn.engine.flight.dump("degraded")   # surface the ring
+    evs = _spans(_fresh)
+    names = [e["name"] for e in evs]
+    assert names == ["serve.admit", "serve.queue", "tenancy.round",
+                     "serve.score"]
+    assert evs[-1]["status"] == "failed"
+    fl = [e for e in _fresh._events if e["type"] == "flight_record"]
+    assert fl[-1]["status"] == "failed"
+    assert fl[-1]["tenant"] == "c"                 # structural label
+    assert fl[-1]["trace_id"] == evs[-1]["trace_id"]
+
+
+def _live_stack(rng, **updater_kw):
+    import tpu_als
+    from tpu_als.io.movielens import synthetic_movielens
+    from tpu_als.live import LiveUpdater
+    from tpu_als.stream.microbatch import FoldInServer
+
+    frame = synthetic_movielens(40, 30, 400, seed=1)
+    model = tpu_als.ALS(rank=4, maxIter=2, seed=1).fit(frame)
+    eng = ServingEngine(k=5)
+    eng.publish(np.asarray(model._U), np.asarray(model._V))
+    srv = FoldInServer(model)
+    up = LiveUpdater(eng, srv, max_batch=8, max_wait_ms=5.0,
+                     **updater_kw)
+    uids = np.asarray(model._user_map.ids)
+    iids = np.asarray(model._item_map.ids)
+    return up, uids, iids
+
+
+def test_live_chain_poison_quarantine_and_breach(rng, _fresh):
+    """One good and one poisoned rating through the REAL update loop:
+    the good event's tree runs admit -> queue -> foldin -> publish ->
+    visible; the poisoned one ENDS at quarantine; the breach event
+    names the worst trace and the publish links its trace ids."""
+    with tracing.traced():
+        up, uids, iids = _live_stack(rng, slo_s=1e-9)
+        up.start()
+        try:
+            up.submit(int(uids[0]), int(iids[0]), 4.0)
+            up.submit(int(uids[1]), int(iids[1]), float("nan"))
+            deadline = time.perf_counter() + 15.0
+            while up.queue_depth and time.perf_counter() < deadline:
+                time.sleep(0.02)
+            time.sleep(0.2)
+        finally:
+            up.stop()
+    by_trace = {}
+    for e in _spans(_fresh):
+        by_trace.setdefault(e["trace_id"], []).append(e)
+    chains = {t: [e["name"] for e in evs] for t, evs in by_trace.items()}
+    full = [t for t, names in chains.items()
+            if names == ["live.admit", "live.queue", "live.foldin",
+                         "live.publish", "live.visible"]]
+    poisoned = [t for t, names in chains.items()
+                if names == ["live.admit", "live.queue",
+                             "live.quarantine"]]
+    assert len(full) == 1 and len(poisoned) == 1
+    q = by_trace[poisoned[0]][-1]
+    assert q["status"] == "quarantined"
+    # every tree is parent-linked end to end
+    for evs in by_trace.values():
+        for parent, child in zip(evs, evs[1:]):
+            assert child["parent_id"] == parent["span_id"]
+    breach = [e for e in _fresh._events
+              if e["type"] == "live_freshness_breach"]
+    assert breach and breach[-1]["trace_id"] == full[0]
+    pub = [e for e in _fresh._events if e["type"] == "serving_publish"
+           and e.get("trace_ids")]
+    assert pub and pub[-1]["trace_ids"] == [full[0]]
+    fl = [e for e in _fresh._events if e["type"] == "flight_record"
+          and e.get("trace_ids")]
+    assert fl and full[0] in fl[-1]["trace_ids"]
+
+
+def test_live_shed_is_traced(rng, _fresh):
+    with tracing.traced():
+        up, uids, iids = _live_stack(rng, max_queue=2)
+        with pytest.raises(Overloaded):   # loop not running: queue fills
+            for j in range(10):
+                up.submit(int(uids[0]), int(iids[0]), 3.0)
+    shed = [e for e in _spans(_fresh)
+            if e["name"] == "live.admit" and e["status"] == "shed"]
+    assert shed
+
+
+# ---------------------------------------------------------------------------
+# 3. the read side: explain, tail filters, flight labels
+
+
+def _traced_breach_rundir(rng, tmp_path):
+    """A finalized run dir whose trail carries a complete live chain
+    and a freshness breach — the explain acceptance fixture."""
+    run_dir = str(tmp_path / "run")
+    obs.configure(os.path.join(run_dir, "obs"))
+    try:
+        tracing.reset_trace_ids(seed=0)
+        with tracing.traced():
+            up, uids, iids = _live_stack(rng, slo_s=1e-9)
+            up.start()
+            try:
+                up.submit(int(uids[0]), int(iids[0]), 4.0)
+                deadline = time.perf_counter() + 15.0
+                while up.queue_depth and time.perf_counter() < deadline:
+                    time.sleep(0.02)
+                time.sleep(0.2)
+            finally:
+                up.stop()
+        obs.finalize()
+    finally:
+        obs.deconfigure()
+    return run_dir
+
+
+def test_explain_reconstructs_breach_tree_from_jsonl(rng, tmp_path,
+                                                     _fresh):
+    run_dir = _traced_breach_rundir(rng, tmp_path)
+    out = explain_mod.explain(run_dir, breach="last")
+    assert out.startswith("breach: ") and "freshness_breach" in out
+    for hop in ("live.admit", "live.queue", "live.foldin",
+                "live.publish", "live.visible"):
+        assert hop in out
+    # indentation encodes the causal nesting: visible is the deepest
+    lines = out.splitlines()
+    depth = {ln.strip().lstrip("└─ ").split()[0]: len(ln) - len(ln.lstrip())
+             for ln in lines if "live." in ln}
+    assert depth["live.visible"] > depth["live.foldin"] \
+        > depth["live.admit"]
+    # the publish this trace rode is cross-referenced
+    assert "serving_publish names this trace" in out
+    # --trace renders the same tree; unknown ids are typed errors
+    tid = next(ln.split()[1].rstrip(":") for ln in lines
+               if ln.startswith("trace "))
+    assert "live.visible" in explain_mod.explain(run_dir, trace=tid)
+    with pytest.raises(ValueError, match="not in the trail"):
+        explain_mod.explain(run_dir, trace="t99-ffffffff")
+    # no selector: the per-trace index
+    assert tid in explain_mod.explain(run_dir)
+
+
+def test_explain_cli(rng, tmp_path, _fresh, capsys):
+    from tpu_als.cli import main as cli_main
+
+    run_dir = _traced_breach_rundir(rng, tmp_path)
+    cli_main(["observe", "explain", run_dir, "--breach", "last"])
+    out = capsys.readouterr().out
+    assert "live.visible" in out and "breach" in out
+    with pytest.raises(SystemExit):
+        cli_main(["observe", "explain", str(tmp_path / "nope")])
+
+
+def test_explain_is_jax_free(rng, tmp_path, _fresh):
+    """The explain module must run standalone on a host with no jax —
+    a breach is diagnosed from a copied run dir, not the serving host."""
+    run_dir = _traced_breach_rundir(rng, tmp_path)
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax.py").write_text(
+        'raise ImportError("jax must not be imported by observe '
+        'explain")\n')
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tpu_als", "obs",
+                                      "explain.py"),
+         run_dir, "--breach", "last"],
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": str(poison)})
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "live.visible" in p.stdout
+
+
+def test_explain_breach_on_breach_free_trail_is_typed(tmp_path):
+    d = tmp_path / "obs"
+    d.mkdir()
+    (d / "events.jsonl").write_text(json.dumps(
+        {"ts": 1, "type": "trace_span", "trace_id": "t1", "span_id": "a",
+         "parent_id": None, "name": "serve.admit", "status": "ok",
+         "seconds": None}) + "\n")
+    with pytest.raises(ValueError, match="no breach-shaped"):
+        explain_mod.explain(str(d), breach="last")
+
+
+def test_tail_filters_tenant_and_trace(tmp_path, _fresh):
+    d = tmp_path / "obs"
+    d.mkdir()
+    rows = [
+        {"ts": 1, "type": "trace_span", "trace_id": "t1", "span_id": "a",
+         "parent_id": None, "name": "serve.admit", "status": "ok",
+         "seconds": None, "tenant": "x"},
+        {"ts": 2, "type": "trace_span", "trace_id": "t2", "span_id": "b",
+         "parent_id": None, "name": "serve.admit", "status": "ok",
+         "seconds": None, "tenant": "y"},
+        {"ts": 3, "type": "serving_publish", "seq": 4, "mode": "retag",
+         "items": 9, "seconds": 0.1, "trace_ids": ["t1"]},
+    ]
+    with open(d / "events.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    by_tenant = report.cmd_tail(str(d), tenant="x")
+    assert "t1" in by_tenant and "t2" not in by_tenant
+    by_trace = [json.loads(ln) for ln in
+                report.cmd_tail(str(d), trace="t1").splitlines()]
+    # trace filter matches trace_id AND trace_ids membership
+    assert {e["type"] for e in by_trace} == \
+        {"trace_span", "serving_publish"}
+    assert all("t2" not in json.dumps(e) for e in by_trace)
+    # filters compose with -n: last 1 of tenant x's events only
+    assert len(report.cmd_tail(str(d), n=1, tenant="x").splitlines()) \
+        == 1
+
+
+def test_flight_recorder_structural_labels(_fresh):
+    rec = FlightRecorder(capacity=4, span_keys=("a",),
+                         labels={"tenant": "z"})
+    rec.record("ok", {"a": 0.1}, trace_id="t1")
+    rec.dump("slo_breach")
+    evs = [e for e in _fresh._events if e["type"] == "flight_record"]
+    assert evs and evs[-1]["tenant"] == "z"
+    assert evs[-1]["trace_id"] == "t1"
+
+
+def test_scenario_runner_arms_tracing_scoped(_fresh):
+    from tpu_als.scenario.library import Phase, ScenarioSpec
+    from tpu_als.scenario.runner import run_scenario
+
+    seen = {}
+
+    def probe(ctx):
+        seen["armed"] = tracing.tracing_armed()
+        seen["ctx"] = tracing.start_trace("serve.admit")
+
+    spec = ScenarioSpec(name="t", doc="d", defaults={},
+                        phases=(Phase("p", probe, "probe arming"),),
+                        assertions=())
+    assert not tracing.tracing_armed()
+    result = run_scenario(spec, registry=_fresh)
+    assert result["passed"]
+    assert seen["armed"] and seen["ctx"] is not None
+    assert not tracing.tracing_armed()     # restored after the run
+
+
+def test_trace_vocabulary_static_checks():
+    from tpu_als.analysis import vocab
+
+    assert vocab.check_trace_vocabulary() == []
+    assert vocab.check_tenant_vocabulary() == []
+
+
+def test_vocab_flags_undeclared_span_literal(tmp_path):
+    from tpu_als.analysis import vocab
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from tpu_als.obs import tracing\n"
+        'ctx = tracing.start_trace("serve.nonsense")\n'
+        'tracing.record_span(ctx, "live.bogus", seconds=1.0)\n')
+    msgs = [m for _, m in vocab.check_file(str(bad))]
+    assert len(msgs) == 2
+    assert all("TRACE_SPANS" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# 4. zero overhead disarmed
+
+
+def test_tracing_disarmed_step_jaxpr_byte_identical():
+    from tpu_als.analysis import contracts
+
+    result = contracts.verify("tracing_disarmed")
+    assert result.ok, result.detail

@@ -1,6 +1,6 @@
 """Unified jaxpr-contract registry: the repo's byte-level pins, by name.
 
-Four subsystems carry the same load-bearing discipline — a claim about
+Several subsystems carry the same load-bearing discipline — a claim about
 the TRACED program, pinned byte-for-byte against the jaxpr rather than
 against the claimant's own inputs:
 
@@ -11,6 +11,9 @@ against the claimant's own inputs:
 - ``guardrails_disarmed`` — arming the divergence sentinels must not
   perturb the production step's traced graph (``str(jax.make_jaxpr)``
   byte-identity, armed vs disarmed).
+- ``tracing_disarmed``    — arming causal tracing (``obs.tracing``)
+  must not perturb the production step's traced graph either: trace
+  context is host-side state on tickets/events, never a jit operand.
 - ``plan_cache_off``      — ``TPU_ALS_PLAN_CACHE=off`` vs a warm cache
   dir resolves the byte-identical step jaxpr: the planner supplies probe
   verdicts, never a different program.
@@ -211,6 +214,31 @@ def _pin_guardrails_disarmed(a):
     return f"armed == disarmed step jaxpr ({len(a['disarmed'])} chars)"
 
 
+# -- tracing_disarmed -------------------------------------------------------
+
+def _build_tracing_disarmed():
+    import jax
+
+    from tpu_als.core.als import AlsConfig
+    from tpu_als.obs import tracing
+
+    step, U0, V0, _, _ = _tiny_step_and_factors(
+        AlsConfig(rank=4, max_iter=2))
+    disarmed = str(jax.make_jaxpr(step)(U0, V0))
+    with tracing.traced():
+        armed = str(jax.make_jaxpr(step)(U0, V0))
+    return {"disarmed": disarmed, "armed": armed}
+
+
+def _pin_tracing_disarmed(a):
+    _require(a["disarmed"] == a["armed"],
+             "arming causal tracing changed the production step's jaxpr "
+             f"({len(a['disarmed'])} vs {len(a['armed'])} chars) — trace "
+             "context leaked into the traced graph (it must stay "
+             "host-side: ids on tickets/events, never in jit)")
+    return f"armed == disarmed step jaxpr ({len(a['disarmed'])} chars)"
+
+
 # -- plan_cache_off ---------------------------------------------------------
 
 def _build_plan_cache_off():
@@ -376,6 +404,11 @@ _REGISTRY = {
                  _pin_guardrails_disarmed,
                  "tests/test_guardrails.py::"
                  "test_disarmed_step_jaxpr_is_byte_identical, PR 8"),
+        Contract("tracing_disarmed", _build_tracing_disarmed,
+                 _pin_tracing_disarmed,
+                 "tests/test_tracing.py::"
+                 "test_tracing_disarmed_step_jaxpr_byte_identical, "
+                 "PR 13"),
         Contract("plan_cache_off", _build_plan_cache_off,
                  _pin_plan_cache_off,
                  "tests/test_plan.py::"

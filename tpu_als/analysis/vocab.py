@@ -76,6 +76,17 @@ FAULT_SPEC_RE = re.compile(
     r"\bfault_spec\s*=\s*(?P<body>\([^)]*\)|['\"][^'\"]*['\"])",
     re.DOTALL)
 
+# causal-trace span literals: every start_trace/record_span call site
+# must name a span declared in schema.TRACE_SPANS — same stance as the
+# metric vocabulary, so `observe explain` trees never carry a hop name
+# the docs table doesn't list.  record_span's first argument is the
+# parent context (may span a newline), so skip one comma-delimited arg.
+TRACE_START_RE = re.compile(
+    r"\btracing\.start_trace\(\s*(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)")
+TRACE_RECORD_RE = re.compile(
+    r"\btracing\.record_span\(\s*[^,]+,\s*"
+    r"(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)")
+
 # inline event dicts: a line carrying both a "ts" key and a literal
 # "type" value (the hand-built shape allowed where importing tpu_als is
 # off-limits)
@@ -184,6 +195,74 @@ def check_tenant_vocabulary(repo=REPO):
                 f"tpu_als/obs/schema.py: LABELS declares {name!r} but "
                 "METRICS does not — a label table entry for an "
                 "undeclared metric is dead vocabulary")
+    # the flight ring stamps tenant (and trace ids) STRUCTURALLY on
+    # every record; a span key colliding with a reserved record field
+    # would silently overwrite the attribution
+    reserved = set(getattr(schema, "FLIGHT_RESERVED", ())) \
+        | {"tenant", "trace_id", "trace_ids"}
+    for attr in ("SERVE_SPAN_KEYS", "LIVE_SPAN_KEYS"):
+        overlap = sorted(set(getattr(schema, attr, ())) & reserved)
+        if overlap:
+            errors.append(
+                f"tpu_als/obs/schema.py: {attr} overlaps the reserved "
+                f"flight-record field names ({', '.join(overlap)}) — a "
+                "span named like a structural field would overwrite the "
+                "tenant/trace attribution on every record "
+                "(docs/observability.md)")
+    return errors
+
+
+def check_trace_vocabulary(repo=REPO):
+    """The causal-tracing contract: ``trace_span`` is declared with the
+    six linkage fields ``observe explain`` rebuilds trees from, the span
+    vocabulary is non-empty, the emitter (``obs/tracing.py``) writes the
+    declared event type, and every declared span name is actually
+    recorded somewhere under ``tpu_als/`` — dead vocabulary in the docs
+    table is as misleading as an undeclared hop."""
+    schema, _ = load_registries(repo)
+    errors = []
+    decl = schema.EVENTS.get("trace_span")
+    if decl is None:
+        errors.append(
+            "tpu_als/obs/schema.py: event type 'trace_span' is not "
+            "declared in EVENTS — the causal-tracing trail has no "
+            "schema (docs/observability.md)")
+    else:
+        for k in ("trace_id", "span_id", "parent_id", "name", "status",
+                  "seconds"):
+            if k not in decl[0]:
+                errors.append(
+                    "tpu_als/obs/schema.py: EVENTS['trace_span'] is "
+                    f"missing the {k!r} field — `observe explain` "
+                    "links spans by exactly these keys")
+    spans = getattr(schema, "TRACE_SPANS", ())
+    if not spans:
+        errors.append(
+            "tpu_als/obs/schema.py: TRACE_SPANS is empty/missing — the "
+            "span-name vocabulary is the explain trees' legend")
+    tracing_py = os.path.join(repo, "tpu_als", "obs", "tracing.py")
+    if not os.path.exists(tracing_py):
+        errors.append("tpu_als/obs/tracing.py: missing (the trace_span "
+                      "emitter)")
+    else:
+        with open(tracing_py, encoding="utf-8") as f:
+            if '"trace_span"' not in f.read():
+                errors.append(
+                    "tpu_als/obs/tracing.py: never emits the declared "
+                    "'trace_span' event type")
+    used = set()
+    for path in py_files([os.path.join(repo, "tpu_als")]):
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for name in spans:
+            if f'"{name}"' in text:
+                used.add(name)
+    for name in spans:
+        if name not in used:
+            errors.append(
+                f"tpu_als/obs/schema.py: TRACE_SPANS declares {name!r} "
+                "but no call site under tpu_als/ records it — dead "
+                "vocabulary (remove it or record the hop)")
     return errors
 
 
@@ -326,6 +405,19 @@ def check_file(path, repo=REPO):
                         "not a declared metric (declare it in "
                         "tpu_als.obs.schema.METRICS)")
 
+    if not in_obs:
+        trace_spans = getattr(schema, "TRACE_SPANS", ())
+        for regex in (TRACE_START_RE, TRACE_RECORD_RE):
+            for m in regex.finditer(text):
+                name = m.group("name")
+                if name not in trace_spans:
+                    lineno = line_of(m.start())
+                    add(lineno,
+                        f"{rel}:{lineno}: trace span {name!r} is not "
+                        "declared in tpu_als.obs.schema.TRACE_SPANS — "
+                        "explain trees must only carry documented hop "
+                        "names")
+
     in_faults = in_obs or path.replace(os.sep, "/").endswith(
         "tpu_als/resilience/faults.py")
     for m in FAULT_CALL_RE.finditer(text) if not in_obs else ():
@@ -387,6 +479,7 @@ def main(argv=None):
     if args.paths is None:          # fixture runs scan only their files
         errors.extend(check_plan_vocabulary())
         errors.extend(check_tenant_vocabulary())
+        errors.extend(check_trace_vocabulary())
     nfiles = 0
     for path in py_files(paths):
         nfiles += 1

@@ -1397,7 +1397,8 @@ def cmd_observe(args):
         from tpu_als.obs import regress as regress_mod
 
         result = regress_mod.check(args.root, noise=args.noise,
-                                   strict=args.strict)
+                                   strict=args.strict, trend=args.trend,
+                                   trend_window=args.trend_window)
         if args.as_json:
             print(json.dumps(result))
         else:
@@ -1489,6 +1490,24 @@ def cmd_observe(args):
             print(render(report_d))
         return
 
+    if args.action == "explain":
+        from tpu_als.obs import explain as explain_mod
+
+        try:
+            print(explain_mod.explain(args.run_dir, trace=args.trace,
+                                      breach=args.breach))
+        except (FileNotFoundError, ValueError) as err:
+            raise SystemExit(str(err))
+        except BrokenPipeError:
+            # `observe explain RUN | head` closing the pipe early is
+            # normal; point stdout at devnull so the interpreter's
+            # exit-time flush doesn't raise a second time
+            import os
+
+            os.dup2(os.open(os.devnull, os.O_WRONLY),
+                    sys.stdout.fileno())
+        return
+
     from tpu_als.obs import report
 
     try:
@@ -1496,7 +1515,8 @@ def cmd_observe(args):
             print(report.cmd_summarize(args.run_dir, as_json=args.as_json))
         else:
             print(report.cmd_tail(args.run_dir, n=args.lines,
-                                  event=args.event))
+                                  event=args.event, tenant=args.tenant,
+                                  trace=args.trace))
     except FileNotFoundError as err:
         raise SystemExit(str(err))
 
@@ -1934,6 +1954,12 @@ def main(argv=None):
     os2.add_argument("--event", default=None, metavar="TYPE",
                      help="only events of this type (e.g. flight_record, "
                           "scenario_assert) — the last N AFTER filtering")
+    os2.add_argument("--tenant", default=None, metavar="NAME",
+                     help="only events labeled tenant=NAME — the last N "
+                          "AFTER filtering")
+    os2.add_argument("--trace", default=None, metavar="ID",
+                     help="only events of one causal trace (trace_id "
+                          "match, or membership in an event's trace_ids)")
     os2.set_defaults(fn=cmd_observe)
     os3 = osub.add_parser(
         "roofline",
@@ -2017,8 +2043,32 @@ def main(argv=None):
     os5.add_argument("--strict", action="store_true",
                      help="historical nulls/unparseable rounds become "
                           "errors instead of warnings")
+    os5.add_argument("--trend", action="store_true",
+                     help="also fit the last --trend-window rounds of "
+                          "each series and fail on sustained drift in "
+                          "the worse direction beyond the noise band "
+                          "(catches a slow slide the latest-vs-best "
+                          "check misses)")
+    os5.add_argument("--trend-window", type=int, default=5,
+                     metavar="N",
+                     help="rounds in the trend fit (needs >= 3 "
+                          "effective points; default 5)")
     os5.add_argument("--json", dest="as_json", action="store_true")
     os5.set_defaults(fn=cmd_observe)
+    os6 = osub.add_parser(
+        "explain",
+        help="reconstruct a request/event's full causal tree (admit -> "
+             "queue -> round -> score / fold-in -> publish -> visible) "
+             "from the trail's trace_span events; --breach last starts "
+             "from the latest freshness/SLO breach")
+    os6.add_argument("run_dir",
+                     help="run dir / obs dir / events.jsonl path")
+    os6.add_argument("--trace", default=None, metavar="ID",
+                     help="render one trace's tree")
+    os6.add_argument("--breach", default=None, choices=("last",),
+                     help="start from the trail's last breach event and "
+                          "render the trace it names")
+    os6.set_defaults(fn=cmd_observe)
 
     pl = sub.add_parser(
         "plan",

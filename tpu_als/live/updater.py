@@ -43,12 +43,15 @@ import numpy as np
 
 from tpu_als import obs
 from tpu_als.core.ratings import invalid_rating_mask
+from tpu_als.obs import tracing
 from tpu_als.obs.trace import FlightRecorder
 from tpu_als.resilience import faults
 from tpu_als.serving.batcher import Overloaded
 
 # the per-batch span breakdown the updater's flight ring carries
-LIVE_SPAN_KEYS = ("queue_wait", "quarantine", "foldin", "publish")
+# (source of truth in the stdlib-only schema module, where the jax-free
+# static check pins it against the record's structural field names)
+LIVE_SPAN_KEYS = obs.schema.LIVE_SPAN_KEYS
 
 
 class LiveUpdater:
@@ -88,7 +91,8 @@ class LiveUpdater:
         self.slo_s = float(slo_s) if slo_s is not None else None
         self.fold_items = bool(fold_items)
         self.flight = FlightRecorder(flight_capacity,
-                                     span_keys=LIVE_SPAN_KEYS)
+                                     span_keys=LIVE_SPAN_KEYS,
+                                     labels=self._labels)
         self._queue = []
         self._cond = threading.Condition()
         self._closed = False
@@ -99,16 +103,23 @@ class LiveUpdater:
         """Admit one rating event (original user/item ids).  Raises
         :class:`Overloaded` when the queue is at capacity — the same
         typed shed the serving batcher raises, so producers share one
-        backpressure contract."""
+        backpressure contract.  Each admitted event is stamped with a
+        root causal-trace context (``obs.tracing``; None disarmed) the
+        loop carries through coalescing -> fold-in -> publish ->
+        visibility, so a freshness breach is explainable per event."""
         t_arrival = time.perf_counter()
         with self._cond:
             if self._closed:
                 raise RuntimeError("LiveUpdater is stopped")
             if len(self._queue) >= self.max_queue:
                 obs.counter("live.shed", **self._labels)
+                tracing.start_trace("live.admit", tenant=self.tenant,
+                                    status="shed")
                 raise Overloaded(
                     f"live update queue at capacity ({self.max_queue})")
-            self._queue.append((user, item, float(rating), t_arrival))
+            ctx = tracing.start_trace("live.admit", tenant=self.tenant)
+            self._queue.append((user, item, float(rating), t_arrival,
+                                ctx))
             self._cond.notify()
 
     @property
@@ -187,6 +198,11 @@ class LiveUpdater:
         items = np.asarray([e[1] for e in batch])
         ratings = np.asarray([e[2] for e in batch], dtype=np.float32)
         arrivals = np.asarray([e[3] for e in batch])
+        # chain the queue hop per event (its own wait, not the batch's)
+        ctxs = [tracing.record_span(e[4], "live.queue",
+                                    seconds=t0 - e[3])
+                if e[4] is not None else None
+                for e in batch]
         queue_wait = t0 - float(arrivals.min())
 
         # quarantine BEFORE the factors can see a poisoned value — the
@@ -201,15 +217,21 @@ class LiveUpdater:
                               "out_of_range": n_bad - nonfinite},
                      **self._labels)
             keep = ~bad
+            for c, dropped in zip(ctxs, bad):
+                # a poisoned event's trail ENDS at quarantine — status
+                # says so; the trace is complete, not dropped
+                if dropped and c is not None:
+                    tracing.record_span(c, "live.quarantine",
+                                        status="quarantined")
             users, items = users[keep], items[keep]
             ratings, arrivals = ratings[keep], arrivals[keep]
+            ctxs = [c for c, k in zip(ctxs, keep) if k]
         quarantine_s = time.perf_counter() - t0
         obs.histogram("live.batch_rows", len(ratings), **self._labels)
         if len(ratings) == 0:
             self.flight.record(
                 "quarantined",
-                {"queue_wait": queue_wait, "quarantine": quarantine_s},
-                **self._labels)
+                {"queue_wait": queue_wait, "quarantine": quarantine_s})
             return
 
         p = self.foldin.model._params
@@ -223,19 +245,31 @@ class LiveUpdater:
             touched_item_rows = self.foldin.model._item_map.to_dense(
                 np.asarray(t_items))
         foldin_s = time.perf_counter() - tf
+        ctxs = [tracing.record_span(c, "live.foldin", seconds=foldin_s)
+                if c is not None else None for c in ctxs]
 
         tp = time.perf_counter()
         m = self.foldin.model
         seq, mode = self.engine.publish_update(
-            m._U, m._V, touched_items=touched_item_rows)
+            m._U, m._V, touched_items=touched_item_rows, trace=ctxs)
         publish_s = time.perf_counter() - tp
+        ctxs = [tracing.record_span(c, "live.publish",
+                                    seconds=publish_s, seq=seq,
+                                    mode=mode)
+                if c is not None else None for c in ctxs]
 
         done = time.perf_counter()
-        worst = 0.0
-        for a in arrivals:
+        worst, worst_ctx = 0.0, None
+        for a, c in zip(arrivals, ctxs):
             fr = done - float(a)
             obs.histogram("live.freshness_seconds", fr, **self._labels)
-            worst = max(worst, fr)
+            # the terminal hop: this event's publish seq is now visible
+            # to the score path; its seconds ARE the freshness sample
+            if c is not None:
+                tracing.record_span(c, "live.visible", seconds=fr,
+                                    seq=seq)
+            if fr > worst:
+                worst, worst_ctx = fr, c
         touched = len(touched_users) + (
             len(touched_item_rows) if touched_item_rows is not None
             else 0)
@@ -245,9 +279,13 @@ class LiveUpdater:
             "ok",
             {"queue_wait": queue_wait, "quarantine": quarantine_s,
              "foldin": foldin_s, "publish": publish_s},
-            e2e_seconds=worst, seq=seq, mode=mode, **self._labels)
+            e2e_seconds=worst, seq=seq, mode=mode,
+            trace_ids=sorted({c.trace_id for c in ctxs
+                              if c is not None}) or None)
         if self.slo_s is not None and worst > self.slo_s:
             obs.emit("live_freshness_breach", seq=seq,
                      freshness_seconds=worst, slo_s=self.slo_s,
+                     trace_id=(worst_ctx.trace_id
+                               if worst_ctx is not None else None),
                      **self._labels)
             self.flight.dump("freshness_breach")

@@ -14,8 +14,11 @@ Exit codes (the max severity found wins):
 - 0  OK — warnings at most (historical nulls, unparseable rounds)
 - 1  REGRESSION — the latest effective value is worse than the best
      previous one beyond the noise band (direction from the unit:
-     ``iters/sec`` up is good, ``ms``/``s`` down is good), or the
-     latest multichip round is failing
+     ``iters/sec`` up is good, ``ms``/``s`` down is good), the
+     latest multichip round is failing, or (with ``trend=True``) the
+     least-squares fit over the last ``trend_window`` rounds drifts in
+     the worse direction beyond the band — the slow-slide case where
+     every individual round passes but the series is sinking
 - 2  NULL BANK — the LATEST round banked ``value: null`` with no
      same-round fallback, or a direct bank carries a null value
 - 3  PROVENANCE — a direct bank is missing a timezone-aware
@@ -75,7 +78,51 @@ def _tz_aware(stamp):
     return dt.tzinfo is not None
 
 
-def _check_bench_series(name, rounds, noise, strict, findings):
+def _trend_drift(window):
+    """Least-squares slope over the series window, normalized to a
+    fractional drift across it: ``slope * (npts - 1) / y-intercept``.
+    A -0.04 means the fitted line loses 4% of its starting value over
+    the window.  Fitting the LINE (not latest-vs-best) is the point:
+    a single lucky latest round can sit inside the noise band of the
+    best prior value while the fit still shows a sustained slide."""
+    n = len(window)
+    xbar = (n - 1) / 2.0
+    ybar = sum(window) / n
+    num = sum((i - xbar) * (y - ybar) for i, y in enumerate(window))
+    den = sum((i - xbar) ** 2 for i in range(n))
+    slope = num / den
+    y0 = ybar - slope * xbar
+    if y0 == 0:
+        return 0.0
+    return slope * (n - 1) / y0
+
+
+def _check_trend(name, points, noise, trend_window, findings):
+    """Direction-aware trend gate over the series tail.  Needs >= 3
+    effective points (a 2-point 'trend' is just latest-vs-prior, which
+    the plain gate already judges); drift toward the worse direction
+    beyond the noise band is a REGRESSION even when the latest value
+    alone survives the latest-vs-best check."""
+    if len(points) < 3:
+        return
+    unit = points[-1][3] or ""
+    lower_better = unit in _LOWER_BETTER
+    window = [v for _, v, _, _ in points[-min(trend_window, len(points)):]]
+    drift = _trend_drift(window)
+    worse = drift > 0 if lower_better else drift < 0
+    if worse and abs(drift) > noise:
+        latest_n = points[-1][0]
+        word = "rising" if lower_better else "falling"
+        findings.append(_finding(
+            "error", EXIT_REGRESSION, f"{name}_r{latest_n:02d}.json",
+            f"series {name}: trend over the last {len(window)} rounds is "
+            f"{word} {abs(drift):.1%} ({unit}), beyond the {noise:.0%} "
+            "noise band — sustained drift even though the latest round "
+            "alone may pass"))
+
+
+def _check_bench_series(name, rounds, noise, strict, findings,
+                        trend=False, trend_window=5):
     """``rounds``: sorted [(n, fname, doc)] of ``{n, rc, parsed}``
     wrappers.  Appends findings; returns nothing."""
     last_n = rounds[-1][0]
@@ -121,6 +168,8 @@ def _check_bench_series(name, rounds, noise, strict, findings):
             "error", EXIT_REGRESSION, f"{name}_r{latest_n:02d}.json",
             f"series {name}: latest {latest} {unit} is {direction} the "
             f"best prior {best} {unit} beyond the {noise:.0%} noise band"))
+    if trend:
+        _check_trend(name, points, noise, trend_window, findings)
 
 
 def _check_multichip_series(name, rounds, strict, findings):
@@ -158,11 +207,17 @@ def _check_direct_bank(fname, doc, findings):
             "not a timezone-aware ISO stamp"))
 
 
-def check(root=".", noise=0.10, strict=False, files=None):
+def check(root=".", noise=0.10, strict=False, files=None, trend=False,
+          trend_window=5):
     """Gate every bench artifact under ``root`` (or the explicit
     ``files`` list).  Returns ``{"findings", "exit_code", "series",
     "checked"}`` — exit_code is the max error code found (0 when only
-    warnings/info survive)."""
+    warnings/info survive).  ``trend=True`` additionally fits the last
+    ``trend_window`` effective points of each series and flags a
+    sustained drift in the worse direction beyond the noise band — the
+    gate that catches a slow decline the latest-vs-best check misses
+    when each individual round stays inside the band (needs >= 3
+    effective points; shorter series are plain-gated only)."""
     if files is None:
         files = sorted(glob.glob(os.path.join(root, "BENCH_*.json"))
                        + glob.glob(os.path.join(root, "MULTICHIP_*.json")))
@@ -195,7 +250,8 @@ def check(root=".", noise=0.10, strict=False, files=None):
     for name, rounds in sorted(series.items()):
         rounds.sort()
         if any("parsed" in doc for _, _, doc in rounds):
-            _check_bench_series(name, rounds, noise, strict, findings)
+            _check_bench_series(name, rounds, noise, strict, findings,
+                                trend=trend, trend_window=trend_window)
         else:
             _check_multichip_series(name, rounds, strict, findings)
 
@@ -210,6 +266,8 @@ def check(root=".", noise=0.10, strict=False, files=None):
         "checked": checked,
         "noise": float(noise),
         "strict": bool(strict),
+        "trend": bool(trend),
+        "trend_window": int(trend_window),
     }
 
 
@@ -217,7 +275,9 @@ def render(result):
     """Human-readable verdict for ``tpu_als observe regress``."""
     lines = [f"bench regression gate — {len(result['checked'])} "
              f"artifact(s), noise band {result['noise']:.0%}"
-             + (" [strict]" if result["strict"] else "")]
+             + (" [strict]" if result["strict"] else "")
+             + (f" [trend window {result['trend_window']}]"
+                if result.get("trend") else "")]
     if not result["checked"]:
         lines.append("  (no BENCH_*/MULTICHIP_* artifacts found)")
     for f in result["findings"]:

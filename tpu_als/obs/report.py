@@ -205,12 +205,22 @@ def cmd_summarize(target, as_json=False):
     return render_summary(summary, manifest)
 
 
-def cmd_tail(target, n=20, event=None):
-    """Last ``n`` raw events, optionally only those of one declared
-    type (``event=``) — filtered BEFORE the tail slice, so
-    ``--event flight_record -n 8`` is the last 8 flight records, not
-    whatever flight records happen to sit in the last 8 lines."""
+def cmd_tail(target, n=20, event=None, tenant=None, trace=None):
+    """Last ``n`` raw events, optionally filtered by declared type
+    (``event=``), by ``tenant=`` label, or by causal trace (``trace=``
+    matches an event's ``trace_id`` or membership in its ``trace_ids``
+    list, so publishes linked to the trace show up too).  All filters
+    apply BEFORE the tail slice, so ``--event flight_record -n 8`` is
+    the last 8 flight records, not whatever flight records happen to
+    sit in the last 8 lines — and ``--tenant b -n 8`` is tenant b's
+    last 8 events even if tenant a wrote the last thousand lines."""
     events = load_events(target)
     if event is not None:
         events = [ev for ev in events if ev.get("type") == event]
+    if tenant is not None:
+        events = [ev for ev in events if ev.get("tenant") == tenant]
+    if trace is not None:
+        events = [ev for ev in events
+                  if ev.get("trace_id") == trace
+                  or trace in (ev.get("trace_ids") or ())]
     return "\n".join(json.dumps(ev) for ev in events[-n:])

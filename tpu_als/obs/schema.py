@@ -202,6 +202,44 @@ LABELS = {
 TENANT_LABELED = tuple(sorted(
     n for n, keys in LABELS.items() if "tenant" in keys))
 
+# -- causal-trace vocabulary (tpu_als/obs/tracing.py) ------------------------
+#
+# Every hop a request or rating event takes is one named span; the name
+# is vocabulary exactly like a metric name — ``tracing.record_span`` and
+# ``tracing.start_trace`` validate against this table at call time, and
+# ``analysis/vocab.py`` validates every call-site literal statically.
+# ``tpu_als observe explain`` renders the tree these spans encode.
+TRACE_SPANS = (
+    "serve.admit",        # request admitted at the serving front door
+    "serve.queue",        # waited in the MicroBatcher admission queue
+    "tenancy.round",      # drained by one fair-share scheduler round
+    "serve.score",        # scored on device (path=int8|exact)
+    "serve.expired",      # deadline passed while queued
+    "live.admit",         # rating event admitted by the live updater
+    "live.queue",         # waited in the live admission queue
+    "live.quarantine",    # poisoned event dropped before the factors
+    "live.foldin",        # folded into the touched factor rows
+    "live.publish",       # rode an incremental publish_update
+    "live.visible",       # its publish seq became score-path visible
+)
+
+# per-span outcome vocabulary; "ok" is the happy path, everything else
+# names the typed refusal/failure the span ended in (sheds and breaches
+# are traced, never dropped)
+TRACE_STATUSES = ("ok", "shed", "expired", "failed", "quarantined")
+
+# the flight recorder's per-record span-key breakdowns (source of truth
+# here, stdlib-only, so analysis/vocab.py can assert — jax-free — that
+# they never collide with the record's structural fields or labels)
+SERVE_SPAN_KEYS = ("admission", "queue_wait", "score", "rescore",
+                   "respond")
+LIVE_SPAN_KEYS = ("queue_wait", "quarantine", "foldin", "publish")
+
+# field names every flight record (and its flight_record event) claims
+# structurally — span keys and label keys must stay disjoint from these
+FLIGHT_RESERVED = ("seq", "status", "spans", "e2e_seconds", "path",
+                   "trigger", "ts", "type")
+
 # event type -> (required fields beyond ts/type, help text).  Extra
 # fields are allowed (events are self-describing JSON); missing required
 # fields raise at emit time.
@@ -355,6 +393,15 @@ EVENTS = {
         ("tenant",),
         "a tenant was deregistered from the control plane; its engine "
         "was stopped and its device buffers released"),
+    "trace_span": (
+        ("trace_id", "span_id", "parent_id", "name", "status",
+         "seconds"),
+        "one causal-trace hop (tpu_als.obs.tracing): deterministic "
+        "trace/span/parent ids link admission -> queue -> scheduler "
+        "round -> score -> publish -> visible across serve/live/"
+        "tenancy; `tpu_als observe explain` rebuilds the tree from "
+        "these events alone (name in TRACE_SPANS, status in "
+        "TRACE_STATUSES; seconds may be null for instantaneous hops)"),
     "plan_cache_miss": (
         ("key", "component", "reason"),
         "a plan component was not servable from the cache (reason: "
@@ -392,6 +439,21 @@ def check_labels(name, labels):
             f"metric {name!r} does not declare label key(s) {unknown} "
             f"(declared: {list(allowed)}) — add them to "
             "tpu_als.obs.schema.LABELS before writing the series")
+
+
+def check_trace_span(name, status="ok"):
+    """Raise if a causal-trace span names an undeclared hop or ends in
+    an undeclared status — span names are vocabulary exactly like
+    metric names (``observe explain`` renders only declared hops)."""
+    if name not in TRACE_SPANS:
+        raise KeyError(
+            f"trace span {name!r} is not declared in tpu_als.obs."
+            "schema.TRACE_SPANS — declare it there (and in "
+            "docs/observability.md) before recording it")
+    if status not in TRACE_STATUSES:
+        raise ValueError(
+            f"trace span {name!r} carries undeclared status {status!r} "
+            f"(declared: {list(TRACE_STATUSES)})")
 
 
 def check_event(etype, fields):

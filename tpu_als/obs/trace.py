@@ -114,8 +114,10 @@ def stage(name, sink=None):
 
 
 # Per-request span breakdown every flight record carries.  rescore is
-# None on the exact path (no int8 shortlist to refine).
-SPAN_KEYS = ("admission", "queue_wait", "score", "rescore", "respond")
+# None on the exact path (no int8 shortlist to refine).  The tuple's
+# source of truth lives in the stdlib-only schema module so the jax-free
+# static check (analysis/vocab.py) can pin it against FLIGHT_RESERVED.
+SPAN_KEYS = obs.schema.SERVE_SPAN_KEYS
 
 
 class FlightRecorder:
@@ -130,12 +132,20 @@ class FlightRecorder:
     ``span_keys`` names the breakdown each record carries — the serving
     request spans by default; the live updater records its own
     (queue_wait/quarantine/foldin/publish) through the same ring.
+
+    ``labels`` is the recorder's STRUCTURAL attribution (e.g.
+    ``tenant=<name>`` on a tenant-built engine's ring): stamped into
+    every record at construction time rather than re-passed per call,
+    so a new record site cannot forget the tenant and strand a dump
+    event unattributable (the disjointness of label keys, span keys and
+    the record's own fields is pinned by ``check_tenant_vocabulary``).
     """
 
-    def __init__(self, capacity=64, span_keys=SPAN_KEYS):
+    def __init__(self, capacity=64, span_keys=SPAN_KEYS, labels=None):
         self._ring = collections.deque(maxlen=int(capacity))
         self._lock = threading.Lock()
         self._span_keys = tuple(span_keys)
+        self._labels = dict(labels) if labels else {}
         self._seq = 0
         self._dumped_seq = 0
 
@@ -149,6 +159,7 @@ class FlightRecorder:
             rec = {"seq": self._seq, "status": status,
                    "spans": {k: spans.get(k) for k in self._span_keys},
                    "e2e_seconds": e2e_seconds, "path": path}
+            rec.update(self._labels)
             rec.update(extra)
             self._ring.append(rec)
             return self._seq

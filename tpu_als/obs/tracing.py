@@ -1,0 +1,177 @@
+"""Causal trace-context propagation across serve -> live -> tenancy.
+
+Dapper-style request tracing adapted to a JAX/XLA stack: the flight
+recorder (PR 7) and the live updater's freshness spans each see one
+subsystem, so a breach whose root cause lives across a boundary — a
+rating stuck behind a slow fold-in, a request drained late by another
+tenant's scheduler round — is invisible to both.  This module threads
+ONE context through every hop instead:
+
+- :func:`start_trace` mints a root span at an admission point (a serve
+  request entering the engine, a rating event entering the live
+  updater) and returns a :class:`TraceContext`;
+- :func:`record_span` emits one child span and returns the NEW context,
+  so call sites chain hops with a single assignment::
+
+      t.trace = tracing.record_span(t.trace, "serve.queue",
+                                    seconds=queue_wait)
+
+- every span lands in the JSONL obs trail as a schema-registered
+  ``trace_span`` event (name validated against ``schema.TRACE_SPANS``
+  at call time AND statically by ``analysis/vocab.py``), so
+  ``tpu_als observe explain`` reconstructs the admission -> queue ->
+  scheduler round -> score -> publish -> visible tree purely from the
+  trail — no process state, the scenario harness's re-derivability
+  discipline.
+
+Determinism: trace/span ids come from a lock-protected process counter
+seeded by :func:`reset_trace_ids` — never wallclock or RNG (the TAL003
+rule; the linter bans ``time.time()``/``uuid`` here and a seeded replay
+must produce the same ids).  Device work is fence-timed by its callers
+(``serving.score_seconds`` et al.) and the measured seconds ride the
+span; this module never touches a device value.
+
+Arming: tracing is OFF unless explicitly enabled (:func:`enable_
+tracing`, the scoped :func:`traced` manager, or ``TPU_ALS_TRACE=1``).
+Disarmed, :func:`start_trace` returns ``None`` and every propagation
+site is a single ``is None`` check — nothing reaches the jitted paths,
+and the production step's jaxpr stays byte-identical (the
+``tracing_disarmed`` contract in ``analysis/contracts.py``, next to
+``guardrails_disarmed``).  This module is stdlib + obs only; it must
+stay importable without jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+from tpu_als import obs
+from tpu_als.obs import schema
+
+__all__ = [
+    "TraceContext", "enable_tracing", "disable_tracing",
+    "tracing_armed", "traced", "reset_trace_ids", "start_trace",
+    "record_span",
+]
+
+_ENV_FLAG = "TPU_ALS_TRACE"
+_armed = False
+
+_lock = threading.Lock()
+_seed = 0
+_next = 0
+
+
+def enable_tracing():
+    """Arm causal tracing for this process (scenario runs, tests, and
+    the observe tooling arm it; production serving opts in)."""
+    global _armed
+    _armed = True
+
+
+def disable_tracing():
+    global _armed
+    _armed = False
+
+
+def tracing_armed():
+    """True when tracing is on — explicitly or via the ``TPU_ALS_TRACE``
+    env knob (any value but ''/'0')."""
+    return _armed or os.environ.get(_ENV_FLAG, "0") not in ("", "0")
+
+
+@contextlib.contextmanager
+def traced():
+    """Scoped arming (tests, the scenario runner, the disarmed-jaxpr
+    contract)."""
+    was = _armed
+    enable_tracing()
+    try:
+        yield
+    finally:
+        if not was:
+            disable_tracing()
+
+
+def reset_trace_ids(seed=0):
+    """Restart the deterministic id counter (tests; a seeded replay of
+    the same admission order reproduces the same trace/span ids)."""
+    global _seed, _next
+    with _lock:
+        _seed = int(seed)
+        _next = 0
+
+
+def _new_id(prefix):
+    """One process-unique id: ``<prefix><seed:02x>-<counter:08x>``.
+    A counter, not a clock or RNG — ids are causal order, replayable."""
+    global _next
+    with _lock:
+        _next += 1
+        return f"{prefix}{_seed:02x}-{_next:08x}"
+
+
+class TraceContext:
+    """The propagated half of one span: enough to emit a child.
+
+    Immutable by convention; propagation replaces the whole context
+    (``t.trace = record_span(t.trace, ...)``) so concurrent readers
+    never see a half-updated hop.
+    """
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "tenant")
+
+    def __init__(self, trace_id, span_id, parent_id=None, tenant=None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.tenant = tenant
+
+    def __repr__(self):
+        return (f"TraceContext(trace_id={self.trace_id!r}, "
+                f"span_id={self.span_id!r}, "
+                f"parent_id={self.parent_id!r}, "
+                f"tenant={self.tenant!r})")
+
+
+def _emit(ctx, name, status, seconds, fields):
+    schema.check_trace_span(name, status)
+    extra = dict(fields)
+    if ctx.tenant is not None:
+        extra.setdefault("tenant", ctx.tenant)
+    obs.emit("trace_span", trace_id=ctx.trace_id, span_id=ctx.span_id,
+             parent_id=ctx.parent_id, name=name, status=status,
+             seconds=seconds, **extra)
+
+
+def start_trace(name, tenant=None, *, status="ok", seconds=None,
+                **fields):
+    """Mint a new trace at an admission point: emits the root span and
+    returns its :class:`TraceContext` (``None`` when disarmed — the
+    whole propagation chain no-ops off that None).
+
+    ``name`` must be a declared ``schema.TRACE_SPANS`` hop; ``status``
+    a declared ``TRACE_STATUSES`` outcome (a shed admission is a root
+    span with ``status="shed"`` — refusals are traced, not dropped).
+    """
+    if not tracing_armed():
+        return None
+    ctx = TraceContext(_new_id("t"), _new_id("s"), parent_id=None,
+                       tenant=tenant)
+    _emit(ctx, name, status, seconds, fields)
+    return ctx
+
+
+def record_span(ctx, name, *, status="ok", seconds=None, **fields):
+    """Emit one child span under ``ctx`` and return the NEW context
+    (the child becomes the parent of the next hop).  No-ops — returning
+    ``ctx`` unchanged — when ``ctx`` is None or tracing is disarmed, so
+    call sites chain unconditionally."""
+    if ctx is None or not tracing_armed():
+        return ctx
+    child = TraceContext(ctx.trace_id, _new_id("s"),
+                         parent_id=ctx.span_id, tenant=ctx.tenant)
+    _emit(child, name, status, seconds, fields)
+    return child

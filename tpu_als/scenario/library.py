@@ -1026,6 +1026,21 @@ def _cf_collect(ctx):
     idx = s["engine"].published_index
     ctx.facts["catalog_grew"] = bool(
         idx is not None and idx.n_items > s["base_items"])
+    # explainability is itself an assertion: at least one admitted
+    # rating event must have a COMPLETE causal trail in the obs events
+    # — admit -> queue -> foldin -> publish -> visible — the exact
+    # spans `observe explain` rebuilds a breach from (docs/
+    # observability.md).  Judged from reg._events, like everything else.
+    full_chain = {"live.admit", "live.queue", "live.foldin",
+                  "live.publish", "live.visible"}
+    names_by_trace = {}
+    for e in reg._events:
+        if e.get("type") == "trace_span" and e.get("trace_id"):
+            names_by_trace.setdefault(e["trace_id"], set()).add(
+                e.get("name"))
+    ctx.facts["explainable_traces"] = sum(
+        1 for names in names_by_trace.values()
+        if full_chain <= names)
 
 
 def _continuous_freshness():
@@ -1079,6 +1094,12 @@ def _continuous_freshness():
                       doc="new items appended via the delta segment"),
             Assertion("no_hard_failures", "fact", fact="hard_failures",
                       op="==", value=0),
+            Assertion("traces_explainable", "fact",
+                      fact="explainable_traces", op=">=", value=1,
+                      doc="at least one rating event's full causal "
+                          "trail (admit->queue->foldin->publish->"
+                          "visible) is reconstructible from the obs "
+                          "events alone"),
         ),
     )
 

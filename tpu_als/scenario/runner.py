@@ -16,7 +16,11 @@ events alone.
 Fault arming is scoped: the spec's ``fault_spec`` is installed before
 phase 1 and the environment's own ``TPU_ALS_FAULT_SPEC`` (or a clean
 disarm) is restored afterwards, failures included — a failing scenario
-must never leak chaos into the next one.
+must never leak chaos into the next one.  Causal tracing
+(``obs.tracing``) is armed over the same window with the same restore
+discipline, so every scenario's trail carries complete ``trace_span``
+trees (``observe explain`` on a scenario run dir) without flipping the
+process-wide default.
 
 ``bank_result`` writes ``BENCH_scenario_<name>.json`` with the same
 ``banked_at`` UTC-provenance contract bench.py and serve-bench use, so
@@ -28,6 +32,7 @@ from __future__ import annotations
 import shutil
 import tempfile
 
+from tpu_als.obs import tracing
 from tpu_als.resilience import faults
 from tpu_als.scenario.spec import (
     PhaseFailed,
@@ -81,7 +86,9 @@ def run_scenario(spec, config=None, registry=None, workdir=None,
                   phases=[p.name for p in spec.phases], config=cfg)
     t_start = now()
     phase_records = []
+    tracing_was = tracing.tracing_armed()
     try:
+        tracing.enable_tracing()
         if spec.fault_spec:
             faults.install(spec.fault_spec)
         for phase in spec.phases:
@@ -107,6 +114,10 @@ def run_scenario(spec, config=None, registry=None, workdir=None,
         for e in ctx.run_cleanups():
             registry.emit("warning", what="scenario.cleanup",
                           reason=f"{type(e).__name__}: {e}")
+        # disarm AFTER the drains so in-flight tickets finish their
+        # trees; restore-only (an operator-armed process stays armed)
+        if not tracing_was:
+            tracing.disable_tracing()
         if own_workdir:
             shutil.rmtree(workdir, ignore_errors=True)
 

@@ -31,6 +31,7 @@ import threading
 import time
 
 from tpu_als import obs
+from tpu_als.obs import tracing
 
 DEFAULT_BUCKETS = (8, 32, 128)
 
@@ -62,16 +63,20 @@ class Ticket:
     ``payload`` is either an int user index into the published user
     table or a rank-length float vector (a fold-in factor row for a
     user the table doesn't hold yet); ``k`` trims the engine-wide top-k
-    per request.
+    per request.  ``trace`` is the admitting causal-trace context
+    (``obs.tracing``, None when disarmed): the ticket carries it into
+    the batch, and each hop replaces it with the child context so the
+    chain admission -> queue -> round -> score is one linked trail.
     """
 
-    __slots__ = ("payload", "k", "deadline", "t_submit", "t_dequeue",
-                 "t_admit", "_event", "_result", "_error")
+    __slots__ = ("payload", "k", "deadline", "trace", "t_submit",
+                 "t_dequeue", "t_admit", "_event", "_result", "_error")
 
-    def __init__(self, payload, k, deadline):
+    def __init__(self, payload, k, deadline, trace=None):
         self.payload = payload
         self.k = k
         self.deadline = deadline        # absolute perf_counter time, or None
+        self.trace = trace              # TraceContext of the last hop, or None
         self.t_submit = time.perf_counter()
         self.t_dequeue = None
         self.t_admit = None    # admission DURATION (engine submit -> queued)
@@ -133,18 +138,20 @@ class MicroBatcher:
         with self._cond:
             return len(self._q)
 
-    def submit(self, payload, k=None, deadline_s=None):
+    def submit(self, payload, k=None, deadline_s=None, trace=None):
         """Admit one request; returns its :class:`Ticket`.
 
         Raises :class:`Overloaded` (and counts ``serving.shed``) when
         the queue is full — the caller gets the refusal in microseconds
-        instead of a deadline miss in milliseconds.
+        instead of a deadline miss in milliseconds.  ``trace`` is the
+        admitting trace context (created BEFORE enqueue so the consumer
+        thread never races an unset ``Ticket.trace``).
         """
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         deadline = (time.perf_counter() + deadline_s
                     if deadline_s is not None else None)
-        t = Ticket(payload, k, deadline)
+        t = Ticket(payload, k, deadline, trace=trace)
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
@@ -188,6 +195,11 @@ class MicroBatcher:
             t.t_dequeue = now
             obs.histogram("serving.enqueue_seconds", now - t.t_submit,
                           **self.labels)
+            # the queue owns the queue-wait hop: chain it here so the
+            # span's seconds are the histogram's sample, not a re-read
+            if t.trace is not None:
+                t.trace = tracing.record_span(
+                    t.trace, "serve.queue", seconds=now - t.t_submit)
         obs.gauge("serving.queue_depth", depth_after, **self.labels)
         return batch
 

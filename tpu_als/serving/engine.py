@@ -58,6 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_als import obs
+from tpu_als.obs import tracing
 from tpu_als.obs.trace import FlightRecorder
 from tpu_als.ops.topk import chunked_topk_scores
 from tpu_als.resilience import faults
@@ -136,7 +137,10 @@ class ServingEngine:
         self.slo_s = float(slo_s) if slo_s is not None else None
         self.tenant = str(tenant) if tenant is not None else None
         self._labels = {"tenant": self.tenant} if self.tenant else {}
-        self.flight = FlightRecorder(flight_capacity)
+        # tenant stamped structurally: every record this ring takes
+        # carries it, so no record site can strand a dump unattributed
+        self.flight = FlightRecorder(flight_capacity,
+                                     labels=self._labels)
         self.batcher = MicroBatcher(
             buckets=buckets, max_queue=max_queue, max_wait_s=max_wait_s,
             default_deadline_s=default_deadline_s, labels=self._labels)
@@ -196,9 +200,14 @@ class ServingEngine:
         return seq
 
     def publish_update(self, U, V, *, touched_items=None,
-                       item_valid=None):
+                       item_valid=None, trace=None):
         """Incremental publish after a fold-in: O(touched rows), not
         O(catalog).  Returns ``(seq, mode)``.
+
+        ``trace``: the causal-trace contexts (``obs.tracing``) of the
+        rating events this publish makes visible; their trace ids are
+        stamped onto the ``serving_publish`` event (``trace_ids``) so
+        the trail records which trace(s) produced each seq.
 
         ``touched_items``: logical catalog rows of ``V`` that changed
         since the live publish (item fold-in); rows beyond the previous
@@ -280,11 +289,14 @@ class ServingEngine:
         obs.histogram("serving.publish_seconds",
                       time.perf_counter() - t0, mode=mode,
                       **self._labels)
+        linked = ({"trace_ids": sorted({c.trace_id for c in trace
+                                        if c is not None})}
+                  if trace else {})
         obs.emit("serving_publish", seq=seq, items=Ni,
                  quantized=bool(index is not None), mode=mode,
                  delta_rows=(index.delta_count
                              if index is not None else 0),
-                 **self._labels)
+                 **linked, **self._labels)
         return seq, mode
 
     def _live_cadence(self):
@@ -389,13 +401,24 @@ class ServingEngine:
                 raise ValueError(
                     f"fold-in payload shape {payload.shape} != "
                     f"({m.rank},) (the published rank)")
+        # root span BEFORE enqueue: the consumer thread may dequeue the
+        # ticket the instant submit releases the lock, so the context
+        # must already ride it (None when tracing is disarmed — the
+        # whole chain no-ops off that None)
+        ctx = tracing.start_trace(
+            "serve.admit", tenant=self.tenant,
+            seconds=time.perf_counter() - t_enter)
         try:
-            t = self.batcher.submit(payload, k=k, deadline_s=deadline_s)
+            t = self.batcher.submit(payload, k=k, deadline_s=deadline_s,
+                                    trace=ctx)
         except Overloaded:
-            # a shed never queues: its trace is the admission span alone
+            # a shed never queues: its trace is the admission span plus
+            # a queue hop with status="shed" (refusals are traced)
+            tracing.record_span(ctx, "serve.queue", status="shed",
+                                seconds=0.0)
             self.flight.record(
                 "shed", {"admission": time.perf_counter() - t_enter},
-                **self._labels)
+                trace_id=(ctx.trace_id if ctx is not None else None))
             self.flight.dump("shed")
             raise
         t.t_admit = time.perf_counter() - t_enter
@@ -444,12 +467,18 @@ class ServingEngine:
                 for t in batch:
                     if not t.done():
                         t.fail(e)
+                        if t.trace is not None:
+                            t.trace = tracing.record_span(
+                                t.trace, "serve.score", status="failed",
+                                error=type(e).__name__)
                         self.flight.record(
                             "failed",
                             {"admission": t.t_admit,
                              "queue_wait": (t.t_dequeue - t.t_submit
                                             if t.t_dequeue else None)},
-                            error=type(e).__name__, **self._labels)
+                            error=type(e).__name__,
+                            trace_id=(t.trace.trace_id
+                                      if t.trace is not None else None))
                 if not isinstance(e, faults.InjectedFault):
                     obs.emit("warning", what="serving.batch",
                              reason=f"{type(e).__name__}: {e}")
@@ -465,12 +494,18 @@ class ServingEngine:
         for t in batch:
             if t.deadline is not None and now > t.deadline:
                 obs.counter("serving.expired", **self._labels)
+                if t.trace is not None:
+                    t.trace = tracing.record_span(
+                        t.trace, "serve.expired", status="expired",
+                        seconds=now - t.t_submit)
                 self.flight.record(
                     "expired",
                     {"admission": t.t_admit,
                      "queue_wait": (t.t_dequeue - t.t_submit
                                     if t.t_dequeue else None)},
-                    e2e_seconds=now - t.t_submit, **self._labels)
+                    e2e_seconds=now - t.t_submit,
+                    trace_id=(t.trace.trace_id
+                              if t.trace is not None else None))
                 t.fail(DeadlineExceeded(
                     "deadline passed while queued "
                     f"({now - t.t_submit:.4f}s since submit)"))
@@ -520,6 +555,9 @@ class ServingEngine:
             t.complete((s[j, :kk], ix[j, :kk]))
             e2e = done - t.t_submit
             obs.histogram("serving.e2e_seconds", e2e, **self._labels)
+            if t.trace is not None:
+                t.trace = tracing.record_span(
+                    t.trace, "serve.score", seconds=score_s, path=path)
             # rescore is fused into the int8 top-k executable (one
             # jitted call — serving/index.py), so it is not separable
             # from score without un-fusing the kernel; None records that
@@ -530,7 +568,9 @@ class ServingEngine:
                                 if t.t_dequeue else None),
                  "score": score_s,
                  "respond": time.perf_counter() - done},
-                e2e_seconds=e2e, path=path, **self._labels)
+                e2e_seconds=e2e, path=path,
+                trace_id=(t.trace.trace_id
+                          if t.trace is not None else None))
             if self.slo_s is not None and e2e > self.slo_s:
                 breached = True
         if breached:

@@ -38,6 +38,7 @@ from __future__ import annotations
 import threading
 
 from tpu_als import obs
+from tpu_als.obs import tracing
 from tpu_als.resilience import faults
 from tpu_als.serving.batcher import Overloaded
 from tpu_als.tenancy.registry import TenantRegistry, TenantSpec
@@ -111,6 +112,7 @@ class MultiTenantEngine:
             else TenantRegistry()
         self.scheduler = FairShareScheduler()
         self.idle_wait_s = float(idle_wait_s)
+        self._round = 0      # monotonic fair-share pick counter (traced)
         self._work = threading.Event()
         self._stopping = threading.Event()
         self._thread = None
@@ -239,22 +241,38 @@ class MultiTenantEngine:
             if not batch:
                 continue
             served_any = True
+            # link every ticket's trail to the fair-share pick that
+            # drained it: a request slow because ANOTHER tenant held
+            # the rounds is explainable from this hop alone
+            self._round += 1
+            for t in batch:
+                if t.trace is not None:
+                    t.trace = tracing.record_span(
+                        t.trace, "tenancy.round", round=self._round,
+                        batch_rows=len(batch))
             try:
                 tenant.engine.serve_batch(batch)
             except BaseException as e:  # noqa: BLE001 — isolate the tenant
                 # the single-engine _run contract, scoped to ONE
                 # tenant: its undone tickets fail, its error is
                 # counted against it, and the round moves on — a
-                # neighbor's batch never sees this exception
+                # neighbor's batch never sees this exception (the
+                # tenant label rides the engine ring structurally)
                 for t in batch:
                     if not t.done():
                         t.fail(e)
+                        if t.trace is not None:
+                            t.trace = tracing.record_span(
+                                t.trace, "serve.score", status="failed",
+                                error=type(e).__name__)
                         tenant.engine.flight.record(
                             "failed",
                             {"admission": t.t_admit,
                              "queue_wait": (t.t_dequeue - t.t_submit
                                             if t.t_dequeue else None)},
-                            error=type(e).__name__, tenant=tenant.name)
+                            error=type(e).__name__,
+                            trace_id=(t.trace.trace_id
+                                      if t.trace is not None else None))
                 obs.counter("tenancy.batch_errors", tenant=tenant.name)
                 if not isinstance(e, faults.InjectedFault):
                     obs.emit("warning", what="tenancy.batch",

@@ -258,6 +258,17 @@ _SWEEP_FLAGS = {
     # the in-process faster_than_einsum probe, which also revalidates
     # numerics on-device.
     "headline_gather": {"solve_backend": "gather_fused"},
+    # whole-iteration fusion (gather -> Gram -> in-VMEM Cholesky solve,
+    # ops/pallas_gather_ne.gather_solve): forced for the same reason —
+    # the sweep banks its number even where the in-process
+    # solve_faster_than_unfused probe would keep auto on the shallower
+    # path
+    "headline_gather_solve": {"solve_backend": "gather_fused_solve"},
+    # the queued bf16-before-gather A/B: the upcast-solve-downcast gate
+    # in ops/solve.py (PR 8) keeps the factorization at f32, so the only
+    # delta is the gathered-stream bytes — halved
+    "headline_gather_bf16": {"solve_backend": "gather_fused",
+                             "compute_dtype": "bfloat16"},
 }
 # quality gate for auto-selection: held-out RMSE (stars) the matching
 # rmse evidence must beat.  The known-good band is ~0.43 (BASELINE row
@@ -1552,11 +1563,13 @@ def main():
     ap.add_argument("--reg", type=float, default=0.02,
                     help="regParam for rmse mode (weighted-λ scheme)")
     ap.add_argument("--solve-backend", default="auto",
-                    choices=["auto", "fused", "unfused", "gather_fused"],
+                    choices=["auto", "unfused", "gather_fused",
+                             "gather_fused_solve"],
                     help="half-step solve path (AlsConfig.solve_backend); "
                          "'auto' probes the Pallas kernels on TPU; "
-                         "'gather_fused' forces the DMA-gather NE build "
-                         "(ops/pallas_gather_ne)")
+                         "'gather_fused' forces the DMA-gather NE build, "
+                         "'gather_fused_solve' the whole-iteration fused "
+                         "kernel (ops/pallas_gather_ne)")
     ap.add_argument("--compute-dtype", default="float32",
                     choices=["float32", "bfloat16"],
                     help="dtype for the gather/einsum stage")

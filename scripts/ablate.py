@@ -42,6 +42,24 @@ def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
 
         def f(args):
             c, v, m = args
+            if cfgd["solve_backend"] == "gather_fused_solve" and ab not in (
+                    "no-neq", "no-solve"):
+                from tpu_als.ops.pallas_gather_ne import (
+                    gather_fused_solve_explicit, gather_fused_solve_implicit)
+                from tpu_als.utils.platform import on_tpu
+
+                # whole-iteration fused kernel: the gather happens inside
+                # (DMA ring), so no-gather ablates by pinning the indices
+                interp = not on_tpu()
+                c_ab = c * 0 if ab == "no-gather" else c
+                if cfgd["implicit"]:
+                    return gather_fused_solve_implicit(
+                        V_comp, c_ab, v.astype(cdt), m.astype(cdt),
+                        cfgd["reg"], cfgd["alpha"],
+                        YtY.astype(jnp.float32), interpret=interp)
+                return gather_fused_solve_explicit(
+                    V_comp, c_ab, v.astype(cdt), m.astype(cdt),
+                    cfgd["reg"], interpret=interp)
             if ab == "no-gather":
                 # same gather op, all indices 0: measures the random-access
                 # penalty (cache-resident source row) without changing the
@@ -49,18 +67,6 @@ def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
                 Vg = V_comp[c * 0]
             else:
                 Vg = V_comp[c]
-            if cfgd["solve_backend"] == "fused" and ab not in (
-                    "no-neq", "no-solve"):
-                from tpu_als.ops.pallas_fused import fused_normal_solve
-
-                # the fused kernel is an f32 path (ablation-only):
-                # measure it at f32 regardless of --compute-dtype so its
-                # delta vs the unfused variants isn't a dtype swap
-                return fused_normal_solve(
-                    Vg.astype(jnp.float32), v, m,
-                    YtY if cfgd["implicit"] else None,
-                    reg=cfgd["reg"], implicit=cfgd["implicit"],
-                    alpha=cfgd["alpha"])
             if ab == "no-neq":
                 A = jnp.broadcast_to(
                     jnp.eye(rank) * 2.0, (chunk, rank, rank))
@@ -78,15 +84,16 @@ def half_step(V_full, buckets, num_rows, rank, chunk_elems, YtY, ab, cfgd):
             if ab == "no-solve":
                 return rhs
             sb = cfgd["solve_backend"]
-            if cfgd["cg_iters"] > 0 and sb != "fused":
+            if cfgd["cg_iters"] > 0 and sb != "gather_fused_solve":
                 # inexact-ALS solve: timing is warm-start-invariant (same
                 # fixed iteration count), so the ablation runs it cold
                 return solve_cg(A, rhs, cnt, iters=cfgd["cg_iters"])
-            # under --solve-backend fused the no-neq/no-solve variants fall
-            # back to the unfused path; use the XLA solver there so the
-            # stage delta isn't conflated with a solver swap
-            return solve_spd(A, rhs, cnt,
-                             backend="xla" if sb == "fused" else sb)
+            # under --solve-backend gather_fused_solve the no-neq/no-solve
+            # variants fall back to the unfused path; use the XLA solver
+            # there so the stage delta isn't conflated with a solver swap
+            return solve_spd(
+                A, rhs, cnt,
+                backend="xla" if sb == "gather_fused_solve" else sb)
 
         if nch == 1:
             xs = f((cols[0], vals[0], mask[0]))[None]
@@ -109,7 +116,8 @@ def main():
     ap.add_argument("--variants", nargs="*", default=[
         "full", "no-solve", "no-gather", "no-neq", "no-scatter"])
     ap.add_argument("--solve-backend", default="auto",
-                    choices=["auto", "xla", "pallas", "lanes", "fused"])
+                    choices=["auto", "xla", "pallas", "lanes",
+                             "gather_fused_solve"])
     ap.add_argument("--subproc", action="store_true",
                     help="run each variant in its own subprocess with a "
                          "timeout so one pathological compile cannot hang "
@@ -127,12 +135,13 @@ def main():
     args = ap.parse_args()
     from tpu_als.utils.platform import enable_persistent_compile_cache
     enable_persistent_compile_cache()
-    if args.cg_iters > 0 and args.solve_backend == "fused":
-        # fused takes precedence over cg (core/als.py doc) — refusing the
-        # combination beats printing fused timings under a CG label
+    if args.cg_iters > 0 and args.solve_backend == "gather_fused_solve":
+        # the forced fusion takes precedence over cg (core/als.py doc) —
+        # refusing the combination beats printing fused timings under a
+        # CG label
         ap.error("--cg-iters cannot be combined with --solve-backend "
-                 "fused (the fused kernel would run and the output would "
-                 "be mislabeled as a CG ablation)")
+                 "gather_fused_solve (the fused kernel would run and the "
+                 "output would be mislabeled as a CG ablation)")
     if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
 

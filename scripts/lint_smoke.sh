@@ -9,9 +9,9 @@
 #      container.  The checked-in baseline (lint_baseline.txt) is
 #      policy-EMPTY, so any finding is a failure.
 #   2. the jaxpr contract registry — the named byte pins (ne_audit,
-#      guardrails_disarmed, tracing_disarmed, plan_cache_off,
-#      comm_audit, live_delta_index) re-verified through the real CLI
-#      on an 8-device CPU backend.
+#      fused_solve_audit, guardrails_disarmed, tracing_disarmed,
+#      plan_cache_off, comm_audit, live_delta_index) re-verified
+#      through the real CLI on an 8-device CPU backend.
 #
 # Usage: scripts/lint_smoke.sh   (from the repo root; ~1 min on CPU)
 set -u

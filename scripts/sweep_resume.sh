@@ -76,7 +76,17 @@ DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
 #   unmeasured steps.  ml100k's timeout 300s -> 480s: the 08:3x windows
 #   showed data staging + compile alone can eat ~4 minutes, so 300s was
 #   timing out runs that were seconds from banking.
+#   Round-9 (whole-iteration fusion, PR 14): cg2_headline DELETED
+#   outright (ADVICE round 5) — its number is banked and a re-run buys
+#   nothing a short window should pay for.  New steps lead the queue:
+#   gather_solve_headline banks the fused gather->Gram->solve kernel
+#   (headline_gather_solve.out via --ab-dir), gather_bf16_headline the
+#   queued bf16-before-gather A/B (headline_gather_bf16.out), and
+#   solve_fused_lab the per-width kernel A/B.  Step names keep the
+#   canonical-bank-collision rule above (prefix, not headline_*).
 STEPS=(
+  "gather_solve_headline|700|python bench.py --no-auto-config --iters 5 --ab gather_solve --ab-dir sweep_logs --probe-attempts 1"
+  "gather_bf16_headline|700|python bench.py --no-auto-config --iters 5 --ab gather_bf16 --ab-dir sweep_logs --probe-attempts 1"
   "gather_headline|700|python bench.py --no-auto-config --iters 5 --ab gather --ab-dir sweep_logs --probe-attempts 1"
   "wg15_headline|700|python bench.py --no-auto-config --iters 5 --ab wg15 --ab-dir sweep_logs --probe-attempts 1"
   "ml100k|480|python bench.py --no-auto-config --mode ml100k --probe-attempts 1"
@@ -90,11 +100,11 @@ STEPS=(
   "foldin|580|python bench.py --no-auto-config --mode foldin --probe-attempts 1"
   "kernel_lab|580|python scripts/kernel_lab.py --panels 4 8 16"
   "ne_lab|580|python scripts/kernel_lab.py --ne --widths 64 256 1024"
+  "solve_fused_lab|580|python scripts/kernel_lab.py --solve-fused --widths 64 256 1024"
   "rank256_proxy|900|python scripts/rank256_proxy.py"
   "kernel_lab_r256|580|python scripts/kernel_lab.py --rank 256 --n 8192 --panels 4 8 16"
   "ablate_full_cg2|900|python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2"
   "twotower_20ep|1500|python bench.py --no-auto-config --mode twotower --probe-attempts 1"
-  "cg2_headline|700|python bench.py --no-auto-config --iters 5 --ab cg2 --ab-dir sweep_logs --probe-attempts 1"
 )
 
 step_ok() {  # decide DONE from the step's .out: bench JSON without error,

@@ -215,8 +215,9 @@ def test_contracts_resolvable_by_name():
     from tpu_als.analysis import contracts
 
     assert set(contracts.names()) == {
-        "ne_audit", "guardrails_disarmed", "tracing_disarmed",
-        "plan_cache_off", "comm_audit", "live_delta_index"}
+        "ne_audit", "fused_solve_audit", "guardrails_disarmed",
+        "tracing_disarmed", "plan_cache_off", "comm_audit",
+        "live_delta_index"}
     for name in contracts.names():
         c = contracts.get(name)
         assert c.name == name
@@ -288,12 +289,12 @@ def test_default_jitter_is_the_one_knob():
     from tpu_als.core import foldin
     from tpu_als.core.als import AlsConfig
     from tpu_als.ops import solve
-    from tpu_als.ops.pallas_fused import fused_normal_solve
+    from tpu_als.ops.pallas_gather_ne import gather_solve
 
     D = solve.DEFAULT_JITTER
     for fn in (solve.solve_spd, solve.solve_spd_checked, solve.solve_cg,
                solve.solve_cg_matfree, solve.solve_nnls,
-               foldin.fold_in, foldin._fold_in_jit, fused_normal_solve):
+               foldin.fold_in, foldin._fold_in_jit, gather_solve):
         assert inspect.signature(fn).parameters["jitter"].default == D, \
             getattr(fn, "__name__", fn)
     assert AlsConfig().jitter == D

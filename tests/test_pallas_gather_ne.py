@@ -1,6 +1,7 @@
 """Gather-fused NE build (ops.pallas_gather_ne) vs the unfused
 ``normal_eq_*(V[cols], …)`` reference, interpret mode on CPU (the same
-kernel compiles on TPU — ops/pallas_fused pattern).
+kernel compiles on TPU — interpret-mode parity is the portability
+contract for every Pallas kernel in this repo).
 
 The numerics contract under test (kernel module docstring): for widths
 that fit ONE width chunk (w8 <= 256 — every real bucket, entity_widths

@@ -49,6 +49,37 @@ def test_panel_widths_agree(rng, panel):
     np.testing.assert_allclose(x, np.asarray(ref), atol=1e-3, rtol=1e-2)
 
 
+@pytest.mark.parametrize("panel,r", [(8, 128), (16, 128), (32, 128),
+                                     (8, 24)])
+def test_mxu_trailing_update_agrees(rng, panel, r):
+    # the MXU rank-k trailing update (dot_general over the panel dim)
+    # must reproduce the VPU sweep's math — same factorization, the
+    # contraction moved to the matrix unit.  VPU-vs-XLA agreement per
+    # panel is test_panel_widths_agree's pin; here MXU goes against the
+    # XLA reference at every panel and against the VPU sweep once, on
+    # the cheap sub-128 case (each interpret-mode compile is ~10s of
+    # tier-1 budget, and the heavy VPU reruns re-prove a pinned fact)
+    N = LANES + 8
+    A, b = _spd_problem(rng, N, r, scale=1.0 / np.sqrt(r))
+    x_mxu = np.asarray(spd_solve_lanes(A, b, panel=panel, mxu=True,
+                                       interpret=True))
+    ref = solve_spd(A, b, jnp.ones(N), backend="xla")
+    np.testing.assert_allclose(x_mxu, np.asarray(ref), atol=1e-3,
+                               rtol=1e-2)
+    if r < 128:
+        x_vpu = np.asarray(spd_solve_lanes(A, b, panel=panel, mxu=False,
+                                           interpret=True))
+        np.testing.assert_allclose(x_mxu, x_vpu, atol=1e-3, rtol=1e-2)
+
+
+def test_selected_mxu_defaults_conservative():
+    # no probe has validated the MXU variant off-TPU: dispatch must get
+    # False (the VPU sweep), never an unvalidated kernel
+    from tpu_als.ops.pallas_lanes import selected_mxu
+
+    assert selected_mxu(128) is False
+
+
 def test_panel_rounds_to_divisor(rng):
     # rank 24 pads to 24; DEFAULT_PANEL=8 divides it, but panel=16 must
     # round down to a divisor instead of tracing a ragged loop
@@ -95,7 +126,7 @@ def test_solve_spd_lanes_backend_dispatch(rng, monkeypatch):
     count = jnp.ones((N,), jnp.float32)
     hits = []
 
-    def fake(Ax, bx, panel=None, interpret=False):
+    def fake(Ax, bx, panel=None, mxu=False, interpret=False):
         hits.append((Ax.shape, panel))
         return jnp.linalg.solve(Ax, bx[..., None])[..., 0]
 
@@ -120,23 +151,43 @@ class TestAvailableProbe:
         monkeypatch.setattr(platform, "on_tpu", lambda: True)
         monkeypatch.setattr(pallas_lanes, "_AVAILABLE", {})
         monkeypatch.setattr(pallas_lanes, "_PANEL", {})
+        monkeypatch.setattr(pallas_lanes, "_MXU", {})
         monkeypatch.setattr(pallas_lanes, "spd_solve_lanes", fake_kernel)
         return pallas_lanes.available(32)
 
     def test_rejects_wrong_but_finite_kernel(self, monkeypatch):
         assert self._probe(
-            monkeypatch, lambda A, b, panel=None, interpret=False: b
+            monkeypatch,
+            lambda A, b, panel=None, mxu=False, interpret=False: b,
         ) is False
 
     def test_rejects_crashing_kernel(self, monkeypatch):
-        def boom(A, b, panel=None, interpret=False):
+        def boom(A, b, panel=None, mxu=False, interpret=False):
             raise RuntimeError("mosaic compile failure")
 
         assert self._probe(monkeypatch, boom) is False
 
     def test_accepts_correct_kernel(self, monkeypatch):
+        from tpu_als.ops import pallas_lanes
+
         assert self._probe(
             monkeypatch,
-            lambda A, b, panel=None, interpret=False: jnp.linalg.solve(
-                A, b[..., None])[..., 0],
+            lambda A, b, panel=None, mxu=False, interpret=False:
+            jnp.linalg.solve(A, b[..., None])[..., 0],
         ) is True
+        # the probe ladder tries the MXU variant first; a kernel that
+        # validates under it records the MXU selection for dispatch
+        assert pallas_lanes.selected_mxu(32) is True
+
+    def test_mxu_crash_falls_back_to_vpu(self, monkeypatch):
+        # an MXU-only Mosaic failure must not disable the kernel: the
+        # ladder degrades to the VPU sweep and records mxu=False
+        from tpu_als.ops import pallas_lanes
+
+        def picky(A, b, panel=None, mxu=False, interpret=False):
+            if mxu:
+                raise RuntimeError("mosaic compile failure")
+            return jnp.linalg.solve(A, b[..., None])[..., 0]
+
+        assert self._probe(monkeypatch, picky) is True
+        assert pallas_lanes.selected_mxu(32) is False

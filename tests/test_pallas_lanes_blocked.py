@@ -54,6 +54,25 @@ def test_solve_matches_dense(rng, N, r):
     assert np.abs(x - ref).max() / denom < 1e-3
 
 
+def test_mxu_fused_outer_agrees(rng):
+    # the MXU trailing-update variant (rank-k dot_general over the
+    # streamed panels) must reproduce the VPU sweep's factorization at
+    # a multi-block rank, and selection must stay conservative off-TPU
+    from tpu_als.ops.pallas_lanes_blocked import selected_mxu
+
+    A, b = _spd_problem(rng, 4, 256)
+    x_vpu = np.asarray(spd_solve_lanes_blocked(A, b, mxu=False,
+                                               interpret=True))
+    x_mxu = np.asarray(spd_solve_lanes_blocked(A, b, mxu=True,
+                                               interpret=True))
+    ref = np.linalg.solve(np.asarray(A, np.float64),
+                          np.asarray(b, np.float64)[..., None])[..., 0]
+    denom = max(1.0, np.abs(ref).max())
+    assert np.abs(x_mxu - ref).max() / denom < 1e-3
+    np.testing.assert_allclose(x_mxu, x_vpu, atol=1e-3, rtol=1e-2)
+    assert selected_mxu(256) is False  # no probe has validated it here
+
+
 def test_panel_width_agrees(rng):
     # panel=4 must reproduce the default panel=8 math (same blocked
     # factorization, different streaming granularity)

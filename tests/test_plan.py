@@ -417,17 +417,21 @@ def test_probe_registry_enumerable_and_clearable_in_place():
 
 
 def test_all_pallas_modules_share_the_registry():
-    from tpu_als.ops import (pallas_fused, pallas_gather_ne, pallas_lanes,
+    from tpu_als.ops import (pallas_gather_ne, pallas_lanes,
                              pallas_lanes_blocked, pallas_solve,
                              pallas_topk)
 
-    for mod in (pallas_fused, pallas_gather_ne, pallas_lanes,
+    for mod in (pallas_gather_ne, pallas_lanes,
                 pallas_lanes_blocked, pallas_solve, pallas_topk):
         cache = mod._AVAILABLE
         assert isinstance(cache, platform.ProbeCache)
         assert platform.probe_cache(cache.name) is cache
     assert platform.probe_cache("pallas_gather_ne_speed") \
         is pallas_gather_ne._FASTER
+    assert platform.probe_cache("pallas_gather_solve") \
+        is pallas_gather_ne._SOLVE_AVAILABLE
+    assert platform.probe_cache("pallas_gather_solve_speed") \
+        is pallas_gather_ne._SOLVE_FASTER
 
 
 def test_probe_kernel_contract_unchanged_for_plain_dicts():

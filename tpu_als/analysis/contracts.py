@@ -8,6 +8,11 @@ against the claimant's own inputs:
   ``Vg = V[cols]`` gather; the gather-fused build traces NO HBM gather;
   the fused kernel's embedded CostEstimate equals the roofline's
   ``fused_ne_kernel_bytes`` at the kernel's padded shapes.
+- ``fused_solve_audit``   — the whole-iteration fused kernel
+  (``gather_solve``: gather → Gram → Cholesky → x) traces NO HBM gather,
+  stamps a CostEstimate equal to the roofline's
+  ``fused_solve_kernel_bytes``, and that stamp sits strictly below the
+  gather-fused NE build plus the A/b HBM handoff it deletes.
 - ``guardrails_disarmed`` — arming the divergence sentinels must not
   perturb the production step's traced graph (``str(jax.make_jaxpr)``
   byte-identity, armed vs disarmed).
@@ -188,6 +193,77 @@ def _pin_ne_audit(a):
              f"{a['model_bytes']} B at padded shapes")
     return (f"einsum gather == Vg ({a['vg_bytes']} B), fused gather-free, "
             f"CostEstimate == model ({a['model_bytes']} B)")
+
+
+# -- fused_solve_audit ------------------------------------------------------
+
+def _build_fused_solve_audit():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from tpu_als.ops.pallas_gather_ne import (
+        _tiles,
+        _tiles_solve,
+        gather_fused_solve_explicit,
+        gather_normal_eq_explicit,
+    )
+    from tpu_als.perf.ne_audit import gather_out_bytes, pallas_cost_bytes
+    from tpu_als.perf.roofline import fused_solve_kernel_bytes
+
+    n, w, r, N = 48, 40, 24, 300           # the ne_audit contract's shapes
+    rng = np.random.default_rng(7)
+    V = jnp.asarray(rng.normal(size=(N, r)).astype(np.float32))
+    cols = jnp.asarray(rng.integers(0, N, size=(n, w)).astype(np.int32))
+    vals = jnp.asarray(rng.normal(size=(n, w)).astype(np.float32))
+    mask = jnp.asarray((rng.random((n, w)) < 0.8).astype(np.float32))
+
+    fsolve = lambda V, c, v, m: gather_fused_solve_explicit(
+        V, c, v, m, 0.1, interpret=True)
+    ne = lambda V, c, v, m: gather_normal_eq_explicit(
+        V, c, v, m, 0.1, interpret=True)
+
+    r_pad = max(128, -(-r // 128) * 128)
+    w8 = -(-w // 8) * 8
+    tn, _, w_pad = _tiles_solve(r_pad, w8)
+    n_pad = -(-n // tn) * tn
+    tn_ne, _, _ = _tiles(r_pad, w8)
+    n_pad_ne = -(-n // tn_ne) * tn_ne
+    # what the unfused gather_fused path moves ON TOP of its NE kernel:
+    # A [n, r, r] + b [n, r] written to HBM, then read back by the
+    # solver (the x write appears in both paths, so it cancels out of
+    # the comparison)
+    handoff = 2 * n_pad_ne * (r_pad * r_pad + r_pad) * 4
+    return {
+        "solve_gather": gather_out_bytes(fsolve, V, cols, vals, mask),
+        "solve_cost": pallas_cost_bytes(fsolve, V, cols, vals, mask),
+        "model_bytes": fused_solve_kernel_bytes(
+            n_pad * w_pad, n_pad, r_pad, 4),
+        "ne_cost": pallas_cost_bytes(ne, V, cols, vals, mask),
+        "handoff": handoff,
+    }
+
+
+def _pin_fused_solve_audit(a):
+    _require(a["solve_gather"] == (0, 0),
+             f"whole-iteration fused path traced an HBM gather: "
+             f"{a['solve_gather']} — Vg is being materialized")
+    ctotal, ccount = a["solve_cost"]
+    _require(ccount == 1 and ctotal == a["model_bytes"],
+             f"fused-solve CostEstimate {ctotal} B != "
+             f"fused_solve_kernel_bytes {a['model_bytes']} B at padded "
+             f"shapes")
+    ntotal, ncount = a["ne_cost"]
+    _require(ncount == 1,
+             f"NE comparator traced {ncount} pallas_call(s), expected 1")
+    unfused = ntotal + a["handoff"]
+    _require(ctotal < unfused,
+             f"fused-solve bytes {ctotal} B not below the NE-build + "
+             f"A/b handoff total {unfused} B — the fusion stopped "
+             f"deleting traffic")
+    drop = 100.0 * (1.0 - ctotal / unfused)
+    return (f"gather-free, CostEstimate == model ({ctotal} B), "
+            f"{drop:.0f}% below NE build + A/b handoff ({unfused} B)")
 
 
 # -- guardrails_disarmed ----------------------------------------------------
@@ -400,6 +476,9 @@ _REGISTRY = {
     c.name: c for c in (
         Contract("ne_audit", _build_ne_audit, _pin_ne_audit,
                  "tests/test_ne_audit.py, PR 6"),
+        Contract("fused_solve_audit", _build_fused_solve_audit,
+                 _pin_fused_solve_audit,
+                 "tests/test_gather_solve.py, PR 14"),
         Contract("guardrails_disarmed", _build_guardrails_disarmed,
                  _pin_guardrails_disarmed,
                  "tests/test_guardrails.py::"

@@ -1437,9 +1437,10 @@ def cmd_observe(args):
                         solve_backend=args.solve_backend)
         measured = measure_attributed(ucsr, icsr, cfg, iters=args.iters,
                                       warmup=args.warmup)
-        ne_path = ("gather_fused"
-                   if measured["resolved_solve_path"].startswith(
-                       "gatherfused") else "einsum")
+        path = measured["resolved_solve_path"]
+        ne_path = ("gather_fused_solve" if path == "gatherfused_solve"
+                   else "gather_fused" if path.startswith("gatherfused")
+                   else "einsum")
         rl = roofline(nU, nI, len(r), args.rank, dtype=args.dtype,
                       implicit=not args.explicit, ne_path=ne_path,
                       user_counts=ucsr.counts, item_counts=icsr.counts)
@@ -1989,7 +1990,8 @@ def main(argv=None):
                      help="row-tile count (ring/chunked strategies "
                           "re-stream the opposite factors per tile)")
     os3.add_argument("--ne-path", default="einsum",
-                     choices=["einsum", "gather_fused"],
+                     choices=["einsum", "gather_fused",
+                              "gather_fused_solve"],
                      help="normal-equation build to price: the unfused "
                           "gather+einsum round-trip, or the DMA-gather "
                           "fused kernel (ops/pallas_gather_ne — factor "
@@ -2020,9 +2022,10 @@ def main(argv=None):
     os4.add_argument("--reg", type=float, default=0.1)
     os4.add_argument("--alpha", type=float, default=1.0)
     os4.add_argument("--solve-backend", default="auto",
-                     choices=["auto", "unfused", "gather_fused"],
-                     help="exact paths only (the CG/fused-kernel "
-                          "ablations have no decomposed twin)")
+                     choices=["auto", "unfused", "gather_fused",
+                              "gather_fused_solve"],
+                     help="exact paths only (the CG ablations have no "
+                          "decomposed twin)")
     os4.add_argument("--obs-dir", default=None, metavar="DIR",
                      help="also write the stage histograms + "
                           "attribution event as a run dir")
@@ -2090,7 +2093,8 @@ def main(argv=None):
     plw.add_argument("--dtype", default="float32",
                      choices=["float32", "bfloat16"])
     plw.add_argument("--solve-backend", default="auto",
-                     choices=["auto", "fused", "unfused", "gather_fused"])
+                     choices=["auto", "unfused", "gather_fused",
+                              "gather_fused_solve"])
     plw.add_argument("--cg-iters", type=int, default=0)
     plw.add_argument("--k", type=int, default=10,
                      help="serving top-k (the pallas_topk probe keys "

@@ -54,22 +54,24 @@ class AlsConfig:
     # the XLA cholesky lowering.  On the NE-build side, 'auto'
     # additionally upgrades the gather+einsum build to the DMA-gather
     # fused kernel (tpu_als.ops.pallas_gather_ne — factor rows stream
-    # HBM→VMEM once, Vg never materialized) when BOTH its
-    # compile-and-validate probe AND its timing probe beat the einsum
-    # path on this chip (available ≠ faster: the fused_pallas lesson).
-    # 'gather_fused' forces that kernel (interpret-mode off-TPU, so CPU
-    # tests exercise it); 'fused' forces the round-2 fused
-    # normal-eq+SOLVE kernel (tpu_als.ops.pallas_fused — measured 34x
-    # SLOWER than the einsum+pallas path on v5e at ML-25M/25 rank 128,
-    # kept for ablation); 'unfused' forces the plain einsum path (NNLS
-    # always uses unfused)
+    # HBM→VMEM once, Vg never materialized), and beyond that to the
+    # WHOLE-ITERATION fused kernel (gather → Gram → ridge/YtY tail →
+    # in-VMEM Cholesky solve; A never exists in HBM), each step only
+    # when BOTH its compile-and-validate probe AND its timing probe beat
+    # the shallower path on this chip (available ≠ faster: the
+    # pallas_fused lesson — its HBM-streamed Vg + per-column VPU solve
+    # measured 34x slower than einsum+lanes on v5e, and it is retired).
+    # 'gather_fused' forces the DMA-gather NE kernel,
+    # 'gather_fused_solve' forces the whole-iteration kernel (both run
+    # interpret-mode off-TPU, so CPU tests exercise them); 'unfused'
+    # forces the plain einsum path (NNLS always uses unfused)
     solve_backend: str = "auto"
     # > 0: replace the exact per-row factorization with that many
     # warm-started Jacobi-CG steps (ops.solve) — inexact ALS.
     # The solve cost drops from r³/3 serial-recurrence work to cg_iters
     # batched MXU matvecs; the warm start is the previous ALS iterate, so
     # the outer fixed-point loop converges to the same solution.
-    # Precedence: nonnegative (NNLS) > solve_backend='fused' > cg_iters.
+    # Precedence: nonnegative (NNLS) > forced fused backends > cg_iters.
     cg_iters: int = 0
     # 'matfree' (default): apply A through the gathered factor rows —
     # A·p = YtY·p + Vgᵀ((c−1) ⊙ (Vg·p)) + λn·p — so the [n, r, r]
@@ -124,7 +126,9 @@ def _resolve_solve_path_walk(cfg: AlsConfig, rank, matfree_capable=True):
     #3: record *resolved* backends, not requested ones).
 
     Returns a dict with ``resolved_solve_path`` ∈ {'einsum+nnls',
-    'fused_pallas', 'matfree_cg{n}_warmstart' (inexact ALS, no NE einsum;
+    'gatherfused_solve' (the whole-iteration fused kernel — no '+'
+    solver suffix because the solve happens in-kernel),
+    'matfree_cg{n}_warmstart' (inexact ALS, no NE einsum;
     n = cfg.cg_iters), 'einsum+cg{n}_warmstart' (inexact ALS on the
     einsum-built A), 'einsum+pallas_lanes',
     'einsum+pallas_lanes_blocked' (out-of-core lanes, ranks > 128),
@@ -133,7 +137,9 @@ def _resolve_solve_path_walk(cfg: AlsConfig, rank, matfree_capable=True):
     (e.g. 'gatherfused+pallas_lanes') when solve_backend='gather_fused'
     forces the DMA-gather kernel, or — under 'auto' — when its
     compile-and-validate probe AND its beats-the-einsum timing probe
-    both pass (tpu_als.ops.pallas_gather_ne).
+    both pass (tpu_als.ops.pallas_gather_ne); 'auto' further upgrades
+    to 'gatherfused_solve' when the whole-iteration kernel's own
+    validate + timing probes beat the best unfused composition.
 
     ``matfree_capable=False``: the caller's half-step cannot apply A
     matrix-free (the ring strategy — its A is accumulated across
@@ -146,18 +152,15 @@ def _resolve_solve_path_walk(cfg: AlsConfig, rank, matfree_capable=True):
 
     tpu = on_tpu()
     # probe lazily: only the branches that consume a probe outcome run it
-    # (each probe compiles+executes a kernel on TPU); None = not probed.
-    # 'auto' deliberately never picks the fused kernel: measured on v5e
-    # (round 2 ablation, ML-25M/25 rank 128) fused = 3.93 s/iter vs
-    # einsum+pallas_cholesky = 0.114 s/iter — the VMEM-resident solve on
-    # the einsum-built A wins; 'fused' stays available explicitly.
-    fused_ok = solve_ok = lanes_ok = blocked_ok = gather_ok = None
+    # (each probe compiles+executes a kernel on TPU); None = not probed
+    solve_ok = lanes_ok = blocked_ok = gather_ok = gsolve_ok = None
     if cfg.nonnegative:
         path = "einsum+nnls"
-    elif cfg.solve_backend == "fused":
-        # forced: no probe — dispatch would ignore its outcome, and the
-        # probe costs a Mosaic compile+execute on every resolve
-        path = "fused_pallas"
+    elif cfg.solve_backend == "gather_fused_solve":
+        # forced whole-iteration fusion: no probe — dispatch would ignore
+        # its outcome, and the probe costs a Mosaic compile+execute on
+        # every resolve.  Off-TPU the kernel runs in interpret mode.
+        path = "gatherfused_solve"
     elif cfg.solve_backend == "gather_fused":
         # forced DMA-gather NE build; the solve still walks the probe
         # order (the kernel writes A/b, the solve stays on lanes/xla).
@@ -206,10 +209,22 @@ def _resolve_solve_path_walk(cfg: AlsConfig, rank, matfree_capable=True):
                     rank, cfg.compute_dtype))
             if gather_ok:
                 path = "gatherfused" + path[len("einsum"):]
+            # deepest fusion last: the whole-iteration kernel replaces
+            # NE build AND solve only when it validates AND measures
+            # faster than the best unfused composition (which the speed
+            # probe itself picks via faster_than_einsum)
+            gsolve_ok = bool(
+                tpu
+                and pallas_gather_ne.solve_available(rank,
+                                                     cfg.compute_dtype)
+                and pallas_gather_ne.solve_faster_than_unfused(
+                    rank, cfg.compute_dtype))
+            if gsolve_ok:
+                path = "gatherfused_solve"
     return {
         "solve_backend_requested": cfg.solve_backend,
-        "fused_kernel_probe": fused_ok,
         "gather_ne_probe": gather_ok,
+        "gather_solve_probe": gsolve_ok,
         "pallas_lanes_probe": lanes_ok,
         "pallas_lanes_blocked_probe": blocked_ok,
         "pallas_solve_probe": solve_ok,
@@ -245,8 +260,9 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     the single-device step passes it dynamically so configs differing
     only in regParam share one compiled executable (a CrossValidator
     regParam grid then compiles once per rank instead of once per cell).
-    The fused-kernel branch keeps the static ``cfg.reg_param`` (its
-    Pallas lowering requires a static reg; it is ablation-only).
+    The whole-iteration fused branch ('gatherfused_solve') keeps the
+    static ``cfg.reg_param``/``cfg.alpha`` (its Pallas tail bakes them
+    into the kernel; make_step keeps them in the jit cache key there).
     """
     if reg is None:
         reg = cfg.reg_param
@@ -261,20 +277,25 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     V_comp = V_full.astype(cdt)
     out = jnp.zeros((num_rows, r), dtype=jnp.float32)
 
-    if cfg.solve_backend not in ("auto", "fused", "unfused", "gather_fused"):
+    if cfg.solve_backend not in ("auto", "unfused", "gather_fused",
+                                 "gather_fused_solve"):
         raise ValueError(
-            f"unknown solve_backend {cfg.solve_backend!r} "
-            "(expected 'auto', 'fused', 'unfused' or 'gather_fused')")
+            f"unknown solve_backend {cfg.solve_backend!r} (expected "
+            "'auto', 'unfused', 'gather_fused' or 'gather_fused_solve')")
     resolved = resolve_solve_path(cfg, r)
-    fused = resolved["resolved_solve_path"] == "fused_pallas"
     # DMA-gather fused NE build (ops.pallas_gather_ne): the factor rows
     # stream HBM→VMEM inside the kernel, so the Vg = V_comp[c] gather
     # below never runs and the [chunk, w, r] intermediate never exists —
     # trainer_chunk drops it from the memory model (fused_gather=True).
-    # Off-TPU the kernel runs in interpret mode (CPU tier-1 exercises it).
-    gather = resolved["resolved_solve_path"].startswith("gatherfused")
+    # 'gatherfused_solve' goes further: the ridge/YtY tail and the
+    # Cholesky solve also run in-kernel, so A/b never exist in HBM.
+    # Off-TPU the kernels run in interpret mode (CPU tier-1 exercises
+    # them).
+    gsolve = resolved["resolved_solve_path"] == "gatherfused_solve"
+    gather = resolved["resolved_solve_path"].startswith("gatherfused+")
     gather_interpret = not resolved["on_tpu"]
-    cg = cfg.cg_iters > 0 and not cfg.nonnegative and not (fused or gather)
+    cg = (cfg.cg_iters > 0 and not cfg.nonnegative
+          and not (gather or gsolve))
     if cfg.cg_mode not in ("matfree", "dense"):
         raise ValueError(f"unknown cg_mode {cfg.cg_mode!r} "
                          "(expected 'matfree' or 'dense')")
@@ -282,7 +303,8 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
 
     for b in buckets:
         nb, w = b.cols.shape
-        chunk = trainer_chunk(nb, w, r, chunk_elems, fused_gather=gather)
+        chunk = trainer_chunk(nb, w, r, chunk_elems,
+                              fused_gather=gather or gsolve)
         nchunks = nb // chunk
         cols = b.cols.reshape(nchunks, chunk, w)
         vals = b.vals.reshape(nchunks, chunk, w)
@@ -291,6 +313,29 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
 
         def solve_chunk(args):
             c, v, m, rw = args
+            if gsolve:
+                from tpu_als.ops.pallas_gather_ne import (
+                    gather_fused_solve_explicit,
+                    gather_fused_solve_implicit,
+                )
+
+                # whole-iteration fusion: gather, Gram, ridge/YtY tail
+                # AND the blocked Cholesky solve in one kernel — only x
+                # comes back; A/b/Vg never exist in HBM.  reg/alpha/
+                # jitter are STATIC here (the Pallas tail bakes them in;
+                # make_step keeps them in the cache key for this path).
+                with jax.named_scope("gather_fused_solve"):
+                    if cfg.implicit_prefs:
+                        return gather_fused_solve_implicit(
+                            V_comp, c, v.astype(cdt), m.astype(cdt),
+                            cfg.reg_param, cfg.alpha,
+                            YtY.astype(jnp.float32),
+                            jitter=cfg.jitter,
+                            interpret=gather_interpret)
+                    return gather_fused_solve_explicit(
+                        V_comp, c, v.astype(cdt), m.astype(cdt),
+                        cfg.reg_param, jitter=cfg.jitter,
+                        interpret=gather_interpret)
             if gather:
                 from tpu_als.ops.pallas_gather_ne import (
                     gather_normal_eq_explicit,
@@ -336,19 +381,6 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
                         implicit=cfg.implicit_prefs, alpha=alpha,
                         YtY=YtY, x0=x0, iters=cfg.cg_iters,
                         jitter=cfg.jitter)
-            if fused:
-                from tpu_als.ops.pallas_fused import fused_normal_solve
-
-                # the fused kernel is an f32 path (never auto-selected);
-                # a bfloat16 compute_dtype must not leak into it
-                with jax.named_scope("fused_normal_solve"):
-                    return fused_normal_solve(
-                        Vg.astype(jnp.float32), v, m,
-                        YtY.astype(jnp.float32) if cfg.implicit_prefs
-                        else None,
-                        reg=cfg.reg_param,
-                        implicit=cfg.implicit_prefs, alpha=cfg.alpha,
-                    )
             with jax.named_scope("normal_eq"):
                 if cfg.implicit_prefs:
                     A, rhs, count = normal_eq_implicit(
@@ -425,14 +457,17 @@ def make_step(user_buckets, item_buckets, num_users, num_items, cfg: AlsConfig,
     the step body never reads), so a tuning grid over regParam/alpha at
     fixed rank/data compiles ONCE instead of once per grid cell — the
     recompile tax on a CrossValidator was ~30s × cells on a v5e.  The
-    fused-kernel config keeps both static (its Pallas lowering requires
-    them; ablation-only).
+    whole-iteration fused config ('gatherfused_solve') keeps both static
+    (its Pallas tail bakes them into the kernel).
     """
     # probe the solve kernels EAGERLY: a probe firing inside the jit trace
     # below cannot run (and the jit cache would pin the fallback path for
     # the step's lifetime) — see ops.solve.prewarm_solve
     resolved = resolve_solve_path(cfg, cfg.rank)
-    if resolved["resolved_solve_path"] == "fused_pallas":
+    if resolved["resolved_solve_path"] == "gatherfused_solve":
+        # the whole-iteration kernel bakes reg/alpha into its Pallas tail
+        # (static lowering) — keep them in the cache key so two regParams
+        # compile two steps instead of sharing a wrong executable
         cfg_key = _dc_replace(cfg, max_iter=0, seed=0)
     else:
         cfg_key = _dc_replace(cfg, reg_param=0.0, alpha=0.0,

@@ -16,12 +16,23 @@ HBM-resident factor table** into a VMEM tile (double-buffered
 runs on the VMEM tile as the rows stream through — ``Vg`` never exists in
 HBM.  Each padded entry's factor row moves HBM→VMEM exactly once.
 
-Scope — deliberately narrow (the round-2 lesson): the kernel fuses ONLY
-gather + Gram build and writes ``A [n, r, r]`` / ``b [n, r]`` back to HBM;
-the ridge/YtY tail, the count, the empty-row guard and the SPD solve all
-stay on the proven XLA / ``pallas_lanes`` paths (``tpu_als.ops.solve``).
-The in-kernel VPU solve is what made ``fused_pallas`` 34× slower than
-einsum+lanes on v5e — this kernel never touches the VPU-serial recurrence.
+Two fusion depths share the DMA-gather front end:
+
+* :func:`gather_gram` (``gather_normal_eq_*``) fuses ONLY gather + Gram
+  build and writes ``A [n, r, r]`` / ``b [n, r]`` back to HBM; the
+  ridge/YtY tail, the count, the empty-row guard and the SPD solve stay
+  on the proven XLA / ``pallas_lanes`` paths (``tpu_als.ops.solve``).
+* :func:`gather_solve` (``gather_fused_solve_*``) keeps going: the ridge/
+  YtY/empty-guard tail and the blocked Cholesky + substitution from
+  ``tpu_als.ops.pallas_solve`` run on the VMEM accumulator at the last
+  width chunk, so ``A`` **never exists in HBM at all** — only ``x [n, r]``
+  comes back.  This retires the old ``ops.pallas_fused`` attempt, which
+  fused the same tail but still streamed an HBM-materialized ``Vg`` in
+  (and whose per-column VPU recurrence made it 34× slower than
+  einsum+lanes on v5e; the pallas_solve panel factorization used here
+  does its trailing updates as batched MXU GEMMs).  Both depths are
+  probe-gated independently — availability AND speed — so the planner
+  picks the deepest fusion that actually wins on the local chip.
 
 Numerics contract: :func:`gather_normal_eq_explicit` /
 :func:`gather_normal_eq_implicit` are drop-in replacements for
@@ -39,8 +50,8 @@ the einsum only to rounding (the property tests assert tight allclose
 there, exact equality on single-chunk widths).
 
 Grid: ``(row_tiles, width_chunks)``, width innermost; the ``[TN, r, r]``
-accumulator persists across the width chunks of one row tile (the same
-revisiting pattern as tpu_als.ops.pallas_fused).
+accumulator persists across the width chunks of one row tile (the
+standard Pallas revisiting pattern).
 """
 
 from __future__ import annotations
@@ -52,7 +63,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpu_als.ops.solve import implicit_weights
+from tpu_als.ops.solve import DEFAULT_JITTER, implicit_weights
 
 # outstanding-DMA ring depth: row copies are small (r·db bytes, 512 B at
 # rank 128 f32), so several must be in flight to hide per-descriptor
@@ -270,6 +281,228 @@ def gather_normal_eq_implicit(V, cols, vals, mask, reg, alpha, YtY, *,
     return A, b, count
 
 
+# --------------------------------------------------------------------------
+# whole-iteration fusion: gather -> Gram -> ridge/YtY tail -> Cholesky solve
+# --------------------------------------------------------------------------
+
+def _gather_solve_kernel(cols_ref, aw_ref, bw_ref, cw_ref, YtY_ref, V_hbm,
+                         x_ref, Vg, S, LT, bacc, cnt, sem, *, n_wc,
+                         two_sided, panel, reg, jitter):
+    """One (row-tile, width-chunk) grid step of the fully fused half-step.
+
+    Same DMA-gather + Gram front end as :func:`_gather_gram_kernel`, plus
+    ``cw_ref [TN, WC]`` — the per-entry COUNT weights (explicit: the mask;
+    implicit: ``pref·mask``), accumulated lane-uniform into ``cnt`` so the
+    weighted ridge and the empty-row guard can apply in-kernel.  At the
+    last width chunk the ridge/YtY/jitter tail (the ``solve_spd``
+    pre-regularization, verbatim) is applied to the VMEM accumulator and
+    the blocked Cholesky + substitution from ``tpu_als.ops.pallas_solve``
+    produce ``x_ref [TN, r]`` — ``A`` is never written to HBM.
+    """
+    j = pl.program_id(1)
+    tn, wc = cols_ref.shape
+    r = S.shape[-1]
+    n_e = tn * wc
+
+    @pl.when(j == 0)
+    def _init():
+        S[:] = jnp.zeros_like(S)
+        bacc[:] = jnp.zeros_like(bacc)
+        cnt[:] = jnp.zeros_like(cnt)
+
+    def _copy(e, slot):
+        t = e // wc
+        k = e % wc
+        return pltpu.make_async_copy(
+            V_hbm.at[cols_ref[t, k]], Vg.at[t, k], sem.at[slot])
+
+    depth = min(_DMA_SLOTS, n_e)
+    for s in range(depth):
+        _copy(s, s).start()
+
+    def _pump(e, carry):
+        _copy(e, e % depth).wait()
+
+        @pl.when(e + depth < n_e)
+        def _next():
+            _copy(e + depth, e % depth).start()
+
+        return carry
+
+    jax.lax.fori_loop(0, n_e, _pump, 0)
+
+    Vg_t = Vg[:]
+    aw = aw_ref[:]
+    Vw = Vg_t * aw[..., None]
+    S[:] = S[:] + jax.lax.dot_general(
+        Vw, Vw if two_sided else Vg_t,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    bacc[:] = bacc[:] + jax.lax.dot_general(
+        bw_ref[:], Vg_t,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    cnt[:] = cnt[:] + jnp.sum(
+        cw_ref[:], axis=1).astype(jnp.float32)[:, None]  # lane-uniform
+
+    @pl.when(j == n_wc - 1)
+    def _solve():
+        from tpu_als.ops.pallas_solve import factorize, substitute
+
+        ii = jax.lax.broadcasted_iota(jnp.int32, (tn, r, r), 1)
+        kk = jax.lax.broadcasted_iota(jnp.int32, (tn, r, r), 2)
+        diag = ii == kk
+        c3 = cnt[:][:, None, :]                       # [TN, 1, r] broadcast
+        # the reference builders compute ``reg * count`` in the weight
+        # dtype, so a bf16 run's ridge is bf16-rounded; ``.astype`` pairs
+        # get elided inside a jitted kernel (XLA excess precision), so the
+        # rounding must be the explicit reduce_precision op — identity at
+        # f32 (nmant=23), bf16-RN otherwise.  Without it the fused diagonal
+        # sits ~0.4% of λ·n off the unfused path's at bf16.
+        fi = jnp.finfo(cw_ref.dtype)
+        reg_w = jnp.asarray(reg, cw_ref.dtype).astype(jnp.float32)
+        ridge = jax.lax.reduce_precision(
+            jax.lax.reduce_precision(c3, fi.nexp, fi.nmant) * reg_w,
+            fi.nexp, fi.nmant)
+        A = S[:] + YtY_ref[:][None].astype(jnp.float32)
+        A = jnp.where(diag, A + ridge + jitter, A)
+        # empty rows (count == 0): A := I so the factorization stays
+        # finite; b is already 0 there so x = 0 — the solve_spd contract
+        A = jnp.where(c3 <= 0.0, jnp.where(diag, 1.0 + jitter, 0.0), A)
+        S[:] = A
+        factorize(S, LT, tn=tn, r=r, panel=panel)
+        x_ref[:] = substitute(LT, bacc[:], tn=tn, r=r, panel=panel)
+
+
+def _tiles_solve(r_pad, w8, panel=16, max_wc=256):
+    """(TN, WC, W_PAD) for the fused-solve kernel: the gather kernel's
+    tiling, shrunk further for the second [TN, r, r] scratch (LT) and
+    capped so the factorization's scoped-VMEM stack (the ~20 live
+    [TN, panel, r] temporaries at its deepest point — the pallas_fused
+    round's measured overflow at rank 32 / TN 256) stays under the 16 MiB
+    limit.  TN stays a sublane (8) multiple."""
+    tn, wc, w_pad = _tiles(r_pad, w8, max_wc)
+    while tn > 8 and tn * (2 * r_pad * r_pad + 3 * wc * r_pad) > (1 << 21):
+        tn //= 2
+    tn = min(tn, max(8, (1 << 17) // (max(panel, 32) * r_pad)))
+    tn = max(8, (tn // 8) * 8)
+    return tn, wc, w_pad
+
+
+@functools.partial(jax.jit, static_argnames=("two_sided", "reg", "jitter",
+                                             "panel", "interpret"))
+def gather_solve(V, cols, aw, bw, cw, YtY=None, *, two_sided, reg,
+                 jitter=DEFAULT_JITTER, panel=16, interpret=False):
+    """Whole-iteration fused half-step core: DMA-gather ``V[cols]`` rows
+    straight into VMEM, accumulate the weighted Gram, apply the ridge/YtY/
+    empty-guard tail and solve — returns ``x [n, r]`` f32 only.  Neither
+    the gathered rows nor the normal-equation matrices ever touch HBM.
+
+    V [N, r] (any float dtype — bf16 halves the dominant HBM stream);
+    cols [n, w] int32; aw/bw/cw [n, w] (A-side, b-side and count weights —
+    the wrappers compute them with the reference builders' exact
+    expressions).  ``reg``/``jitter`` are static floats baked into the
+    kernel tail (the ``solve_spd`` pre-regularization, applied in VMEM).
+    """
+    N, r = V.shape
+    n, w = cols.shape
+    r_pad = max(128, -(-r // 128) * 128)
+    if r_pad % panel:
+        raise ValueError(f"panel {panel} must divide padded rank {r_pad}")
+    tn, wc, w_pad = _tiles_solve(r_pad, -(-w // 8) * 8, panel=panel)
+    assert wc == w_pad or (wc % 128 == 0 and w_pad % wc == 0), (wc, w_pad)
+    n_pad = -(-n // tn) * tn
+    V_p = jnp.pad(V, ((0, 0), (0, r_pad - r)))
+    # padding slots index row 0 with zero weight — contributes nothing;
+    # padded batch rows have count 0 and hit the empty-row guard (x = 0)
+    cols_p = jnp.pad(cols.astype(jnp.int32),
+                     ((0, n_pad - n), (0, w_pad - w)))
+    aw_p = jnp.pad(aw, ((0, n_pad - n), (0, w_pad - w)))
+    bw_p = jnp.pad(bw, ((0, n_pad - n), (0, w_pad - w)))
+    cw_p = jnp.pad(cw, ((0, n_pad - n), (0, w_pad - w)))
+    YtY_p = (jnp.zeros((r_pad, r_pad), jnp.float32) if YtY is None
+             else jnp.pad(YtY.astype(jnp.float32),
+                          ((0, r_pad - r), (0, r_pad - r))))
+    n_wc = w_pad // wc
+
+    from tpu_als.perf.roofline import fused_solve_kernel_bytes
+
+    db = jnp.dtype(V.dtype).itemsize
+    kernel = functools.partial(
+        _gather_solve_kernel, n_wc=n_wc, two_sided=two_sided, panel=panel,
+        reg=float(reg), jitter=float(jitter))
+    x = pl.pallas_call(
+        kernel,
+        grid=(n_pad // tn, n_wc),
+        in_specs=[
+            pl.BlockSpec((tn, wc), lambda i, j: (i, j),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((tn, wc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, wc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, wc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((r_pad, r_pad), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((tn, r_pad), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pad, r_pad), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((tn, wc, r_pad), V.dtype),
+            pltpu.VMEM((tn, r_pad, r_pad), jnp.float32),
+            pltpu.VMEM((tn, r_pad, r_pad), jnp.float32),
+            pltpu.VMEM((tn, r_pad), jnp.float32),
+            pltpu.VMEM((tn, r_pad), jnp.float32),
+            pltpu.SemaphoreType.DMA((min(_DMA_SLOTS, tn * wc),)),
+        ],
+        # bytes = THE roofline fused-solve model (perf.roofline) at the
+        # kernel's padded shapes — the fused_solve_audit contract
+        # (analysis/contracts.py) extracts this from the traced jaxpr and
+        # pins it to the closed form, the test_ne_audit.py pattern
+        cost_estimate=pl.CostEstimate(
+            flops=int(2.0 * n_pad * w_pad * r_pad * (r_pad + 1)
+                      + n_pad * (r_pad ** 3 / 3 + 2 * r_pad ** 2)),
+            bytes_accessed=fused_solve_kernel_bytes(
+                n_pad * w_pad, n_pad, r_pad, db),
+            transcendentals=n_pad * r_pad,
+        ),
+        interpret=interpret,
+    )(cols_p, aw_p, bw_p, cw_p, YtY_p, V_p)
+    return x[:n, :r]
+
+
+def gather_fused_solve_explicit(V, cols, vals, mask, reg, *,
+                                jitter=DEFAULT_JITTER, interpret=False):
+    """Fused-gather drop-in for ``normal_eq_explicit(V[cols], …)`` +
+    ``solve_spd`` — returns ``x`` only; A/b/Vg never exist in HBM.  The
+    weights are the reference builder's exact expressions; the ridge/
+    empty-guard tail runs in-kernel with the same arithmetic."""
+    aw = mask
+    bw = vals * mask
+    cw = mask
+    return gather_solve(V, cols, aw, bw, cw, two_sided=True,
+                        reg=float(reg), jitter=jitter, interpret=interpret)
+
+
+def gather_fused_solve_implicit(V, cols, vals, mask, reg, alpha, YtY, *,
+                                jitter=DEFAULT_JITTER, interpret=False):
+    """Fused-gather drop-in for ``normal_eq_implicit(V[cols], …)`` +
+    ``solve_spd`` — returns ``x`` only.  Confidence/preference come from
+    the shared :func:`implicit_weights`; the YtY + weighted-λ tail applies
+    in-kernel to the VMEM accumulator."""
+    conf_m1, pref = implicit_weights(vals, mask, alpha)
+    aw = conf_m1
+    bw = (1.0 + conf_m1) * pref * mask
+    cw = pref * mask
+    return gather_solve(V, cols, aw, bw, cw, YtY, two_sided=False,
+                        reg=float(reg), jitter=jitter, interpret=interpret)
+
+
 from tpu_als.utils.platform import probe_cache as _probe_cache
 
 _AVAILABLE = _probe_cache("pallas_gather_ne")
@@ -382,3 +615,119 @@ def faster_than_einsum(rank=128, compute_dtype="float32", n=2048, w=256,
         return best(fused) < best(einsum)
 
     return probe_kernel(_FASTER, ("speed", r_pad, cdt, n, w), probe)
+
+
+_SOLVE_AVAILABLE = _probe_cache("pallas_gather_solve")
+_SOLVE_FASTER = _probe_cache("pallas_gather_solve_speed")
+
+
+def solve_available(rank=128, compute_dtype="float32"):
+    """Compile-and-validate probe for the whole-iteration fused kernel,
+    cached per (padded rank, dtype) — same contract as :func:`available`.
+    Validates BOTH variants (explicit and implicit compile different
+    bodies) against the unfused builders + ``solve_spd`` on a
+    multi-row-tile, multi-width-chunk instance."""
+    from tpu_als.utils.platform import probe_kernel
+
+    r_pad = max(128, -(-rank // 128) * 128)
+    cdt = str(compute_dtype)
+
+    def probe():
+        import numpy as np
+
+        from tpu_als.ops.solve import (normal_eq_explicit,
+                                       normal_eq_implicit, solve_spd)
+
+        dt = jnp.dtype(cdt)
+        w = 256
+        while True:
+            tn, wc, w_pad = _tiles_solve(r_pad, w)
+            if w_pad // wc >= 2:
+                break
+            w *= 2
+        n, N = 2 * tn, 3 * tn
+        rng = np.random.default_rng(0)
+        V = jnp.asarray(rng.normal(size=(N, rank)).astype(np.float32)
+                        / np.sqrt(rank)).astype(dt)
+        cols = jnp.asarray(rng.integers(0, N, size=(n, w)).astype(np.int32))
+        vals = jnp.asarray(rng.normal(size=(n, w)).astype(np.float32))
+        mask = jnp.asarray((rng.random((n, w)) < 0.8).astype(np.float32))
+        tol = dict(atol=1e-3, rtol=1e-2)
+        x = gather_fused_solve_explicit(
+            V, cols, vals.astype(dt), mask.astype(dt), 0.1)
+        A, b, c = normal_eq_explicit(
+            V[cols], vals.astype(dt), mask.astype(dt), 0.1)
+        ref = solve_spd(A, b, c, backend="xla")
+        x.block_until_ready()
+        if not np.allclose(np.asarray(x), np.asarray(ref), **tol):
+            return False
+        YtY = jnp.asarray(rng.normal(size=(rank, rank)).astype(np.float32))
+        YtY = YtY @ YtY.T / rank
+        xi = gather_fused_solve_implicit(
+            V, cols, vals.astype(dt), mask.astype(dt), 0.1, 4.0, YtY)
+        Ai, bi, ci = normal_eq_implicit(
+            V[cols], vals.astype(dt), mask.astype(dt), 0.1, 4.0, YtY)
+        refi = solve_spd(Ai, bi, ci, backend="xla")
+        xi.block_until_ready()
+        return bool(np.allclose(np.asarray(xi), np.asarray(refi), **tol))
+
+    return probe_kernel(_SOLVE_AVAILABLE, (r_pad, cdt), probe)
+
+
+def solve_faster_than_unfused(rank=128, compute_dtype="float32", n=2048,
+                              w=256, reps=3):
+    """Timing probe: True only when the whole-iteration fused kernel
+    BEATS the current best unfused composition (the gather-Gram kernel
+    when IT probes faster, else the XLA gather+einsum, followed by
+    ``solve_spd(backend='auto')``) on a representative bucket — the
+    fused_pallas lesson (available ≠ faster) applied to the deeper
+    fusion.  Cached per process via probe_kernel (off-TPU → False)."""
+    from tpu_als.utils.platform import fence, probe_kernel
+
+    r_pad = max(128, -(-rank // 128) * 128)
+    cdt = str(compute_dtype)
+
+    def probe():
+        import time
+
+        import numpy as np
+
+        from tpu_als.ops.solve import normal_eq_explicit, solve_spd
+
+        if not solve_available(rank, cdt):
+            return False
+        dt = jnp.dtype(cdt)
+        rng = np.random.default_rng(0)
+        N = 4 * n
+        V = jnp.asarray(rng.normal(size=(N, rank)).astype(np.float32)
+                        / np.sqrt(rank)).astype(dt)
+        cols = jnp.asarray(rng.integers(0, N, size=(n, w)).astype(np.int32))
+        vals = jnp.asarray(rng.normal(size=(n, w)).astype(dt))
+        mask = jnp.asarray((rng.random((n, w)) < 0.9).astype(dt))
+        use_gather_ne = faster_than_einsum(rank, cdt, n=n, w=w, reps=reps)
+
+        @jax.jit
+        def fused(V, cols, vals, mask):
+            return gather_fused_solve_explicit(V, cols, vals, mask, 0.1)
+
+        @jax.jit
+        def unfused(V, cols, vals, mask):
+            if use_gather_ne:
+                A, b, c = gather_normal_eq_explicit(V, cols, vals, mask,
+                                                    0.1)
+            else:
+                A, b, c = normal_eq_explicit(V[cols], vals, mask, 0.1)
+            return solve_spd(A, b, c)
+
+        def best(f):
+            fence(f(V, cols, vals, mask))  # compile + warm
+            t = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fence(f(V, cols, vals, mask))
+                t.append(time.perf_counter() - t0)
+            return min(t)
+
+        return best(fused) < best(unfused)
+
+    return probe_kernel(_SOLVE_FASTER, ("speed", r_pad, cdt, n, w), probe)

@@ -14,12 +14,25 @@ and every per-column step becomes a *static sublane slice*:
   * the rank-1 trailing update is one broadcast multiply-subtract over
     ``[r, r, 128]`` — no one-hot selector matmuls.
 
-The trade: the MXU cannot batch over lanes, so the trailing update runs on
-the VPU at r³ (vs the blocked scheme's r³/3 + MXU panels).  What that buys
-is the removal of every cross-lane reduction and selector dot from the
-serial chain — which is what actually bounds the first-generation kernel
-(measured: its runtime is invariant to the batch-tile size, so it is
-latency-, not throughput-, bound).
+The trade: a plain MXU matmul cannot batch over lanes, so the original
+trailing update ran on the VPU at r³ (vs the blocked scheme's r³/3 + MXU
+panels).  What the layout buys is the removal of every cross-lane
+reduction and selector dot from the serial chain — which is what actually
+bounds the first-generation kernel (measured: its runtime is invariant to
+the batch-tile size, so it is latency-, not throughput-, bound).
+
+Third-generation refinement (``mxu=True``): the serial chain keeps the
+lanes layout, but the rank-``panel`` trailing update — the only O(r²·P)
+dense block, and the part that swept all of S per panel on the VPU — is
+re-expressed as ONE lane-batched ``dot_general`` (batch dim = lanes,
+contraction over the panel axis): per lane, an honest [r, P]·[P, r] GEMM
+the MXU runs as a systolic pass.  The cost is two in-register layout
+rotations around the GEMM (batch-leading in, lane-trailing out); whether
+that trade wins on the local Mosaic is exactly what the ``available()``
+probe ladder decides — the MXU panel is tried first and the VPU panel /
+rank-1 recurrences remain the validated fallbacks, so a Mosaic that
+rejects (or mis-lowers) minormost-batch contractions degrades instead of
+crashing.
 
 Substitution uses the same layout: y and x live as [r, 128] panels and
 each forward/backward step is a [128]-wide vector operation.
@@ -41,8 +54,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
 
+# MXU contractions inside the factorization run at HIGHEST precision: the
+# default f32 path is a single bf16 pass whose ~4e-3 relative error
+# COMPOUNDS through the Cholesky recurrence (the pallas_solve round-1
+# lesson) — HIGHEST restores ~1e-6 and the GEMM is a small fraction of
+# kernel time next to the serial column chain.
+_PREC = jax.lax.Precision.HIGHEST
 
-def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, Pn, sem, *, r, panel):
+
+def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, Pn, sem, *, r, panel, mxu):
     """One lane-group: factorize 128 matrices and solve.
 
     A_ref [G, r, r, LANES] stays in HBM (``memory_space=ANY``) with
@@ -62,6 +82,14 @@ def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, Pn, sem, *, r, panel):
     this kernel (it sweeps all of S per column), so its VMEM traffic —
     and the kernel's runtime — drops by ~``panel``×.  panel=1 is the
     original rank-1 recurrence.
+
+    ``mxu=True`` additionally moves that trailing update off the VPU: the
+    rank-``panel`` correction ``upd[a, b, t] = Σ_k Pn[k, a, t]·Pn[k, b, t]``
+    is one ``dot_general`` with the LANE axis as the batch dimension —
+    per lane a [r, panel]·[panel, r] GEMM, i.e. 128 MXU passes per panel
+    instead of an O(r²·panel·LANES) VPU broadcast sweep.  The serial
+    panel factorization (the latency-bound part the lanes layout exists
+    for) is unchanged.
     """
     g = pl.program_id(0)
     cp = pltpu.make_async_copy(A_ref.at[g], S, sem)
@@ -101,10 +129,22 @@ def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, Pn, sem, *, r, panel):
         # one fused rank-`panel` trailing update.  Columns a < base are
         # untouched (factor columns are zero above their pivot row); the
         # panel's own columns ARE hit...
-        upd = Pn[0][:, None, :] * Pn[0][None, :, :]
-        for kk in range(1, panel):
-            upd = upd + Pn[kk][:, None, :] * Pn[kk][None, :, :]
-        S[:] = S[:] - upd
+        if mxu:
+            # lane-batched GEMM: upd[t, a, b] = Σ_k Pn[k,a,t]·Pn[k,b,t]
+            # — per lane an [r, panel]·[panel, r] MXU contraction; the
+            # transpose back to the [a, b, t] working layout is the
+            # price of admission the probe ladder adjudicates
+            upd = jax.lax.dot_general(
+                Pn[:], Pn[:],
+                dimension_numbers=(((0,), (0,)), ((2,), (2,))),
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )  # [LANES, r, r]
+            S[:] = S[:] - jnp.transpose(upd, (1, 2, 0))
+        else:
+            upd = Pn[0][:, None, :] * Pn[0][None, :, :]
+            for kk in range(1, panel):
+                upd = upd + Pn[kk][:, None, :] * Pn[kk][None, :, :]
+            S[:] = S[:] - upd
         # ...and restored, same trick as the rank-1 recurrence above
         for jj in range(panel):
             S[base + jj] = Pn[jj]
@@ -141,25 +181,35 @@ def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, Pn, sem, *, r, panel):
     x_ref[0] = jax.lax.fori_loop(0, r, bwd, y, unroll=False)
 
 
-# default trailing-update panel width; chosen on v5e (scripts/kernel_lab.py
-# sweep at the headline shape) — see available() which validates the
-# configured width on the local Mosaic before the kernel engages
+# default trailing-update panel width for the VPU update; chosen on v5e
+# (scripts/kernel_lab.py sweep at the headline shape) — see available()
+# which validates the configured width on the local Mosaic before the
+# kernel engages
 DEFAULT_PANEL = 8
+# default panel width for the MXU (lane-batched GEMM) trailing update:
+# wider panels amortize the two layout rotations around the GEMM and keep
+# the [r, panel] operand a full systolic pass; 32 balances that against
+# the left-looking panel factorization's O(panel²) serial work
+DEFAULT_MXU_PANEL = 32
 
 
-@functools.partial(jax.jit, static_argnames=("panel", "interpret"))
-def spd_solve_lanes(A, b, panel=None, interpret=False):
+@functools.partial(jax.jit, static_argnames=("panel", "mxu", "interpret"))
+def spd_solve_lanes(A, b, panel=None, mxu=False, interpret=False):
     """Batched SPD solve x = A⁻¹ b.  A [N, r, r] f32, b [N, r] f32.
 
     Drop-in for ``spd_solve_pallas``; transposes to the lanes layout on
     device (one XLA transpose each way, fused into neighbours where
     possible).  ``panel``: trailing-update panel width (must divide the
-    padded rank; None = DEFAULT_PANEL, capped to the padded rank).
+    padded rank; None = the variant default, capped to the padded rank).
+    ``mxu``: run the trailing update as a lane-batched MXU GEMM instead
+    of the VPU broadcast sweep — pass ``selected_mxu(rank)`` so only a
+    probe-validated variant engages (the auto dispatch in
+    tpu_als.ops.solve does).
     """
     N, r = b.shape
     r_pad = -(-r // 8) * 8
     if panel is None:
-        panel = DEFAULT_PANEL
+        panel = DEFAULT_MXU_PANEL if mxu else DEFAULT_PANEL
     panel = min(panel, r_pad)
     while r_pad % panel:
         panel -= 1
@@ -180,7 +230,8 @@ def spd_solve_lanes(A, b, panel=None, interpret=False):
         Ap.reshape(G, LANES, r_pad, r_pad), (0, 3, 2, 1))
     bt = jnp.transpose(bp.reshape(G, LANES, r_pad), (0, 2, 1))
 
-    kernel = functools.partial(_chol_lanes_kernel, r=r_pad, panel=panel)
+    kernel = functools.partial(_chol_lanes_kernel, r=r_pad, panel=panel,
+                               mxu=mxu)
     xt = pl.pallas_call(
         kernel,
         grid=(G,),
@@ -211,6 +262,7 @@ from tpu_als.utils.platform import probe_cache as _probe_cache
 
 _AVAILABLE = _probe_cache("pallas_lanes")  # r_pad -> bool, once per process
 _PANEL = {}      # r_pad -> panel width that validated on this Mosaic
+_MXU = {}        # r_pad -> True when the MXU trailing update validated
 
 
 def selected_panel(rank):
@@ -218,6 +270,15 @@ def selected_panel(rank):
     until a probe has run)."""
     r_pad = -(-rank // 8) * 8
     return _PANEL.get(r_pad, DEFAULT_PANEL)
+
+
+def selected_mxu(rank):
+    """True when ``available()`` validated the MXU (lane-batched GEMM)
+    trailing update for this rank on the local Mosaic; False until a
+    probe has run — an unvalidated MXU update never engages (the same
+    discipline as selected_panel)."""
+    r_pad = -(-rank // 8) * 8
+    return _MXU.get(r_pad, False)
 
 
 def supported_rank(rank):
@@ -254,12 +315,15 @@ def available(rank=128):
             + 0.5 * np.eye(r, dtype=np.float32)[None])
         b = jnp.asarray(rng.normal(size=(n, r)).astype(np.float32))
         ref = solve_spd(A, b, jnp.ones((n,), jnp.float32), backend="xla")
-        # panelized first; rank-1 as the fallback if the panel kernel's
-        # fused update trips this Mosaic version
-        for p in (DEFAULT_PANEL, 1):
+        # MXU panel GEMM first (the rank-k trailing update on the
+        # systolic array), then the VPU panel sweep, then rank-1 — each
+        # rung a strictly simpler lowering, so whatever this Mosaic
+        # version rejects degrades one rung instead of losing the kernel
+        for p, mx in ((DEFAULT_MXU_PANEL, True), (DEFAULT_PANEL, False),
+                      (1, False)):
             try:
                 x = spd_solve_lanes(A + DEFAULT_JITTER * jnp.eye(r), b,
-                                    panel=p)
+                                    panel=p, mxu=mx)
                 x.block_until_ready()
                 ok = np.allclose(np.asarray(x), np.asarray(ref), atol=1e-3,
                                  rtol=1e-2)
@@ -273,7 +337,8 @@ def available(rank=128):
                     raise
                 ok = False
             if ok:
-                _PANEL[r_pad] = p
+                _PANEL[r_pad] = min(p, r_pad)
+                _MXU[r_pad] = mx
                 return True
         return False
 

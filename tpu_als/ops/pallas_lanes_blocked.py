@@ -54,8 +54,12 @@ LANES = 128
 BLOCK = 128
 PANEL = 8
 
+# see pallas_lanes._PREC — bf16 single-pass MXU error compounds through
+# the Cholesky recurrence; HIGHEST keeps the GEMM rungs at f32 fidelity
+_PREC = jax.lax.Precision.HIGHEST
 
-def _chol_blocked_kernel(A_ref, out_ref, W, Bs, Cs, sem, *, nb, panel):
+
+def _chol_blocked_kernel(A_ref, out_ref, W, Bs, Cs, sem, *, nb, panel, mxu):
     """Factor one lane-group of ``nb·128``-rank matrices, blockwise.
 
     A_ref/out_ref [G, r_pad, r_pad, LANES] in HBM, ALIASED (the factor
@@ -79,7 +83,21 @@ def _chol_blocked_kernel(A_ref, out_ref, W, Bs, Cs, sem, *, nb, panel):
         return ref.at[g, cb * B:(cb + 1) * B, rb * B:(rb + 1) * B]
 
     def fused_outer(S1, S2):
-        """Σ_cc S1[cc] ⊗ S2[cc] over the panel axis -> [B, B, LANES]."""
+        """Σ_cc S1[cc] ⊗ S2[cc] over the panel axis -> [B, B, LANES].
+
+        ``mxu=True`` runs it as ONE lane-batched rank-``panel`` GEMM
+        (per lane a [B, panel]·[panel, B] MXU contraction — the Schur
+        corrections are where the blocked algorithm's r³/3 FLOPs live,
+        so this is the whole-kernel lever); False is the VPU broadcast
+        sweep the probe ladder falls back to.
+        """
+        if mxu:
+            upd = jax.lax.dot_general(
+                S1[:], S2[:],
+                dimension_numbers=(((0,), (0,)), ((2,), (2,))),
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )  # [LANES, B, B]
+            return jnp.transpose(upd, (1, 2, 0))
         upd = S1[0][:, None, :] * S2[0][None, :, :]
         for cc in range(1, panel):
             upd = upd + S1[cc][:, None, :] * S2[cc][None, :, :]
@@ -157,15 +175,18 @@ def _chol_blocked_kernel(A_ref, out_ref, W, Bs, Cs, sem, *, nb, panel):
             dma(W, blk(out_ref, k, i))
 
 
-@functools.partial(jax.jit, static_argnames=("panel", "interpret"))
-def chol_lanes_blocked(A, panel=None, interpret=False):
+@functools.partial(jax.jit, static_argnames=("panel", "mxu", "interpret"))
+def chol_lanes_blocked(A, panel=None, mxu=False, interpret=False):
     """Batched lower-Cholesky factor L of SPD ``A`` [N, r, r] f32, via the
     blocked out-of-core lanes kernel.  Caller pre-regularizes A (jitter +
     identity for empty rows), same contract as the flat kernel.
 
     ``panel``: factor/stream panel width (must divide BLOCK=128; None =
     PANEL).  Exposed so scripts/kernel_lab.py can tune it on chip the
-    same way the flat kernel's DEFAULT_PANEL was tuned."""
+    same way the flat kernel's DEFAULT_PANEL was tuned.  ``mxu``: run the
+    streamed Schur corrections as lane-batched MXU GEMMs (fused_outer) —
+    pass ``selected_mxu(rank)`` so only a probe-validated variant
+    engages."""
     if panel is None:
         panel = PANEL
     if BLOCK % panel:
@@ -185,7 +206,8 @@ def chol_lanes_blocked(A, panel=None, interpret=False):
 
     G = n_pad // LANES
     At = jnp.transpose(Ap.reshape(G, LANES, r_pad, r_pad), (0, 3, 2, 1))
-    kernel = functools.partial(_chol_blocked_kernel, nb=nb, panel=panel)
+    kernel = functools.partial(_chol_blocked_kernel, nb=nb, panel=panel,
+                               mxu=mxu)
     Lt = pl.pallas_call(
         kernel,
         grid=(G,),
@@ -213,12 +235,12 @@ def chol_lanes_blocked(A, panel=None, interpret=False):
     return jnp.tril(L[:N, :r, :r])
 
 
-@functools.partial(jax.jit, static_argnames=("panel", "interpret"))
-def spd_solve_lanes_blocked(A, b, panel=None, interpret=False):
+@functools.partial(jax.jit, static_argnames=("panel", "mxu", "interpret"))
+def spd_solve_lanes_blocked(A, b, panel=None, mxu=False, interpret=False):
     """Batched SPD solve x = A⁻¹b for ranks > 128: blocked lanes
     factorization + XLA batched triangular substitutions (r² work the
     MXU handles; only the r³ factorization needed a kernel)."""
-    L = chol_lanes_blocked(A, panel=panel, interpret=interpret)
+    L = chol_lanes_blocked(A, panel=panel, mxu=mxu, interpret=interpret)
     y = jax.scipy.linalg.solve_triangular(L, b[..., None], lower=True)
     return jax.scipy.linalg.solve_triangular(L, y, lower=True,
                                              trans=1)[..., 0]
@@ -227,6 +249,15 @@ def spd_solve_lanes_blocked(A, b, panel=None, interpret=False):
 from tpu_als.utils.platform import probe_cache as _probe_cache
 
 _AVAILABLE = _probe_cache("pallas_lanes_blocked")  # r_pad -> bool
+_MXU = {}  # r_pad -> bool: MXU fused_outer variant validated by probe
+
+
+def selected_mxu(rank):
+    """Whether the probe validated the MXU trailing-update variant at
+    this rank (False until ``available`` has run; the VPU sweep is the
+    conservative default)."""
+    r_pad = -(-rank // BLOCK) * BLOCK
+    return _MXU.get(r_pad, False)
 
 
 def supported_rank(rank):
@@ -259,17 +290,23 @@ def available(rank=256):
             + 0.5 * np.eye(r, dtype=np.float32)[None])
         b = jnp.asarray(rng.normal(size=(n, r)).astype(np.float32))
         ref = solve_spd(A, b, jnp.ones((n,), jnp.float32), backend="xla")
-        try:
-            x = spd_solve_lanes_blocked(A + DEFAULT_JITTER * jnp.eye(r),
-                                        b)
-            x.block_until_ready()
-            return np.allclose(np.asarray(x), np.asarray(ref),
-                               atol=1e-3, rtol=1e-2)
-        except Exception as e:
-            from tpu_als.utils.platform import classify_probe_error
+        # Ladder: the MXU fused_outer first (lane-batched GEMM Schur
+        # corrections), then the VPU sweep.  A Mosaic that rejects the
+        # minormost-batch dot_general falls to the proven rung.
+        for mx in (True, False):
+            try:
+                x = spd_solve_lanes_blocked(
+                    A + DEFAULT_JITTER * jnp.eye(r), b, mxu=mx)
+                x.block_until_ready()
+                if np.allclose(np.asarray(x), np.asarray(ref),
+                               atol=1e-3, rtol=1e-2):
+                    _MXU[r_pad] = mx
+                    return True
+            except Exception as e:
+                from tpu_als.utils.platform import classify_probe_error
 
-            if classify_probe_error(e) != "kernel":
-                raise
-            return False
+                if classify_probe_error(e) != "kernel":
+                    raise
+        return False
 
     return probe_kernel(_AVAILABLE, r_pad, probe)

@@ -268,7 +268,7 @@ def available(rank=128, panel=16):
         # lowering, through the same solve_spd() entry production uses —
         # a Mosaic miscompile producing finite-but-wrong values fails here
         # (identity-only probes do not exercise the factorization
-        # arithmetic; same standard as pallas_fused.available)
+        # arithmetic; same standard as pallas_gather_ne.solve_available)
         import numpy as np
 
         from tpu_als.ops.solve import DEFAULT_JITTER, solve_spd

@@ -214,13 +214,19 @@ def _dispatch_spd(A, b, backend):
         # probe-inside-trace degrade), run the rank-1 recurrence (panel=1)
         # — never an unvalidated fused update.
         r = A.shape[-1]
-        panel = (pallas_lanes.selected_panel(r)
-                 if pallas_lanes.available(r) else 1)
-        return pallas_lanes.spd_solve_lanes(A, b, panel=panel)
+        ok = pallas_lanes.available(r)
+        panel = pallas_lanes.selected_panel(r) if ok else 1
+        mxu = pallas_lanes.selected_mxu(r) if ok else False
+        return pallas_lanes.spd_solve_lanes(A, b, panel=panel, mxu=mxu)
     if backend == "lanes_blocked":
-        from tpu_als.ops.pallas_lanes_blocked import spd_solve_lanes_blocked
+        from tpu_als.ops import pallas_lanes_blocked
 
-        return spd_solve_lanes_blocked(A, b)
+        # same discipline as lanes: the MXU trailing update engages only
+        # after the probe validated it on this Mosaic
+        r = A.shape[-1]
+        mxu = (pallas_lanes_blocked.selected_mxu(r)
+               if pallas_lanes_blocked.available(r) else False)
+        return pallas_lanes_blocked.spd_solve_lanes_blocked(A, b, mxu=mxu)
     if backend == "pallas":
         from tpu_als.ops.pallas_solve import spd_solve_pallas
 

@@ -281,11 +281,14 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
             if cfg.nonnegative:
                 x = solve_nnls(A, bb, cnt, sweeps=cfg.nnls_sweeps,
                                jitter=cfg.jitter)
-            elif cfg.cg_iters > 0 and cfg.solve_backend != "fused":
+            elif (cfg.cg_iters > 0
+                  and cfg.solve_backend != "gather_fused_solve"):
                 # same precedence as local_half_step (AlsConfig doc:
-                # nonnegative > 'fused' > cg) so one config means one
-                # solver across every gatherStrategy; ring has no fused
-                # kernel, so 'fused' degrades to the exact solve here
+                # nonnegative > forced fused backends > cg) so one config
+                # means one solver across every gatherStrategy; ring has
+                # no fused kernel (its A is accumulated across streamed
+                # shards), so the forced fusion degrades to the exact
+                # solve here
                 x0 = (prev[jnp.clip(rows, 0, num_rows - 1)]
                       if prev is not None else None)
                 x = solve_cg(A, bb, cnt, x0=x0, iters=cfg.cg_iters,
@@ -436,7 +439,8 @@ def chunked_gather_half_step(V_shard, buckets, num_rows, n_shards, cfg,
             if cfg.nonnegative:
                 x = solve_nnls(A, bb, cnt, sweeps=cfg.nnls_sweeps,
                                jitter=cfg.jitter)
-            elif cfg.cg_iters > 0 and cfg.solve_backend != "fused":
+            elif (cfg.cg_iters > 0
+                  and cfg.solve_backend != "gather_fused_solve"):
                 x0 = (prev[jnp.clip(rows, 0, num_rows - 1)]
                       if prev is not None else None)
                 x = solve_cg(A, bb, cnt, x0=x0, iters=cfg.cg_iters,

@@ -15,8 +15,12 @@ into one jitted call per stage, each wrapped in an
 iteration-invariant prep (chunk reshapes, dtype casts of the rating
 stream) hoisted to build time so the fences bracket real per-iteration
 work.  Stage names match the roofline's exactly (``gather_stream``,
-``normal_eq`` / ``gather_fused_ne``, ``solve``, ``scatter``, ``yty``),
-so the join is by name.
+``normal_eq`` / ``gather_fused_ne`` / ``gather_fused_solve``,
+``solve``, ``scatter``, ``yty``), so the join is by name.  On the
+whole-iteration fused path the NE build and the solve are ONE kernel,
+so they are fenced as the single ``gather_fused_solve`` stage — the
+roofline models that stage the same way, so the gap column stays
+meaningful.
 
 The decomposed twin loses cross-stage fusion, so its wall clock is an
 upper bound on the fused step's — ``measure_attributed`` times the real
@@ -53,8 +57,8 @@ from tpu_als.ops.solve import (
 
 
 class AttributionUnsupported(ValueError):
-    """The resolved solve path has no decomposed twin (CG / fused-kernel
-    ablation configs) — attribution covers the production exact paths."""
+    """The resolved solve path has no decomposed twin (CG configs) —
+    attribution covers the production exact paths."""
 
 
 _gather = jax.jit(lambda V_comp, c: V_comp[c])
@@ -103,8 +107,9 @@ def make_attributed_step(user_buckets, item_buckets, num_users, num_items,
     """
     resolved = resolve_solve_path(cfg, cfg.rank)
     path = resolved["resolved_solve_path"]
-    gather = path.startswith("gatherfused")
-    if cfg.cg_iters > 0 or path == "fused_pallas":
+    gsolve = path == "gatherfused_solve"
+    gather = path.startswith("gatherfused+")
+    if cfg.cg_iters > 0:
         raise AttributionUnsupported(
             f"no decomposed twin for resolved solve path {path!r} "
             "(attribution covers the exact einsum / gather-fused paths)")
@@ -126,8 +131,27 @@ def make_attributed_step(user_buckets, item_buckets, num_users, num_items,
         solve_fn = jax.jit(
             functools.partial(solve_spd, jitter=cfg.jitter))
 
-    item_plan = _bucket_plan(item_buckets, r, cfg, item_chunk_elems, gather)
-    user_plan = _bucket_plan(user_buckets, r, cfg, user_chunk_elems, gather)
+    item_plan = _bucket_plan(item_buckets, r, cfg, item_chunk_elems,
+                             gather or gsolve)
+    user_plan = _bucket_plan(user_buckets, r, cfg, user_chunk_elems,
+                             gather or gsolve)
+
+    def solve_fused(V_comp, c, v, m, YtY):
+        from tpu_als.ops.pallas_gather_ne import (
+            gather_fused_solve_explicit,
+            gather_fused_solve_implicit,
+        )
+
+        # reg/alpha are STATIC on this path (the Pallas tail bakes them
+        # in) — same as the production dispatch in local_half_step
+        if cfg.implicit_prefs:
+            return gather_fused_solve_implicit(
+                V_comp, c, v, m, cfg.reg_param, cfg.alpha,
+                YtY.astype(jnp.float32), jitter=cfg.jitter,
+                interpret=gather_interpret)
+        return gather_fused_solve_explicit(
+            V_comp, c, v, m, cfg.reg_param, jitter=cfg.jitter,
+            interpret=gather_interpret)
 
     def ne_fused(V_comp, c, v, m, YtY):
         from tpu_als.ops.pallas_gather_ne import (
@@ -150,6 +174,13 @@ def make_attributed_step(user_buckets, item_buckets, num_users, num_items,
         for b in plan:
             xs = []
             for c, v, m in b["chunks"]:
+                if gsolve:
+                    # NE build + solve are one kernel here: one fence,
+                    # one stage, joined to the roofline's
+                    # gather_fused_solve stage by name
+                    with trace.stage("gather_fused_solve", sink) as keep:
+                        xs.append(keep(solve_fused(V_comp, c, v, m, YtY)))
+                    continue
                 if gather:
                     with trace.stage("gather_fused_ne", sink) as keep:
                         A, rhs, count = keep(ne_fused(V_comp, c, v, m, YtY))

@@ -79,6 +79,22 @@ def fused_ne_kernel_bytes(P, n, r, db):
     return int(P * r * db + P * (4 + 2 * db) + n * r * r * 4 + n * r * 4)
 
 
+def fused_solve_kernel_bytes(P, n, r, db):
+    """HBM bytes the whole-iteration fused kernel
+    (tpu_als.ops.pallas_gather_ne.gather_solve) moves for one half-step:
+    each entry's factor row read ONCE straight into VMEM, the cols (int32)
+    + aw/bw/cw weight streams, and the solved ``x [n, r]`` output — the
+    ``[n, r, r]`` normal-equation tensor never touches HBM (neither
+    written NOR read back by a solver), which is this model's whole
+    difference from :func:`fused_ne_kernel_bytes` + the solve stage.
+
+    THE single source of truth shared by the roofline's fused-solve stage,
+    the kernel's ``pl.CostEstimate``, and the fused_solve_audit contract
+    (analysis/contracts.py) that pins the traced estimate to this formula.
+    """
+    return int(P * r * db + P * (4 + 3 * db) + n * r * 4)
+
+
 def einsum_ne_build_bytes(P, n, r, db, restream=1.0):
     """Modeled NE-build bytes of the UNFUSED path (gather_stream +
     normal_eq stages below, summed): the gather reads one factor row per
@@ -172,7 +188,11 @@ def roofline(n_users, n_items, nnz, rank, *, dtype="float32",
     ``ne_path``: 'einsum' prices the unfused build (gather_stream +
     normal_eq stages); 'gather_fused' prices the DMA-gather kernel
     (tpu_als.ops.pallas_gather_ne) — one fused stage reading each factor
-    row ONCE and writing A/b, the :func:`fused_ne_kernel_bytes` model.
+    row ONCE and writing A/b, the :func:`fused_ne_kernel_bytes` model;
+    'gather_fused_solve' prices the whole-iteration fusion — gather, Gram,
+    ridge/YtY tail AND the Cholesky solve in one kernel writing only x,
+    the :func:`fused_solve_kernel_bytes` model (the standalone solve
+    stage folds into it).
 
     ``padding_waste``: explicit override; when None it is DERIVED from
     the per-entity degree arrays ``user_counts``/``item_counts`` via
@@ -196,9 +216,10 @@ def roofline(n_users, n_items, nnz, rank, *, dtype="float32",
     peak = V5E_F32_PEAK_FLOPS if db == 4 else V5E_BF16_PEAK_FLOPS
     hbm = hbm_gbps * 1e9
     ici = ici_gbps * 1e9
-    if ne_path not in ("einsum", "gather_fused"):
-        raise ValueError(f"unknown ne_path {ne_path!r} "
-                         "(expected 'einsum' or 'gather_fused')")
+    if ne_path not in ("einsum", "gather_fused", "gather_fused_solve"):
+        raise ValueError(f"unknown ne_path {ne_path!r} (expected "
+                         "'einsum', 'gather_fused' or "
+                         "'gather_fused_solve')")
     padding_waste_source = "explicit"
     if padding_waste is None:
         if user_counts is not None or item_counts is not None:
@@ -222,7 +243,21 @@ def roofline(n_users, n_items, nnz, rank, *, dtype="float32",
     if strategy in ("ring", "ring_overlap", "all_gather_chunked"):
         restream = (float(tiles_user) + float(tiles_item)) / 2.0
 
-    if ne_path == "gather_fused":
+    if ne_path == "gather_fused_solve":
+        # the solve is fused INTO this stage (its flops ride along, its
+        # A/b read-back bytes vanish) — no standalone solve stage below
+        ne_stages = [Stage(
+            "gather_fused_solve",
+            bytes=(fused_solve_kernel_bytes(P, n, r, db)
+                   + (restream - 1.0) * P * r * db),
+            flops=(2.0 * P * r * r + 2.0 * P * r
+                   + n * (2.0 * r ** 3 / 3.0 + 4.0 * r * r)),
+            bw=hbm, peak=peak,
+            note="whole-iteration fused kernel: factor rows read ONCE "
+                 "into VMEM, Gram + ridge/YtY tail + Cholesky solve in "
+                 "VMEM, only x written — A never in HBM "
+                 "(ops/pallas_gather_ne.gather_solve)")]
+    elif ne_path == "gather_fused":
         ne_stages = [Stage(
             "gather_fused_ne",
             bytes=(fused_ne_kernel_bytes(P, n, r, db)
@@ -245,17 +280,19 @@ def roofline(n_users, n_items, nnz, rank, *, dtype="float32",
                   bw=hbm, peak=peak,
                   note="einsum re-reads gathered rows, writes [n,r,r] A"),
         ]
-    stages = ne_stages + [
-        Stage("solve",
-              bytes=n * (r * r + 2.0 * r) * 4.0,
-              flops=n * (2.0 * r ** 3 / 3.0 + 4.0 * r * r),
-              bw=hbm, peak=peak,
-              note="reads A+b, writes x; VPU-serial Cholesky in "
-                   "practice — see docs/roofline.md"),
-        Stage("scatter",
-              bytes=n * r * 4.0, flops=0.0, bw=hbm, peak=peak,
-              note="solved rows written back"),
-    ]
+    stages = list(ne_stages)
+    if ne_path != "gather_fused_solve":
+        stages.append(Stage(
+            "solve",
+            bytes=n * (r * r + 2.0 * r) * 4.0,
+            flops=n * (2.0 * r ** 3 / 3.0 + 4.0 * r * r),
+            bw=hbm, peak=peak,
+            note="reads A+b, writes x; VPU-serial Cholesky in "
+                 "practice — see docs/roofline.md"))
+    stages.append(Stage(
+        "scatter",
+        bytes=n * r * 4.0, flops=0.0, bw=hbm, peak=peak,
+        note="solved rows written back"))
     if implicit:
         stages.append(Stage(
             "yty",

@@ -103,7 +103,13 @@ def _load_plan_cache_module():
     return mod
 
 
-DEFAULT_PROBE_BUDGET_S = 600
+# Fail-fast cap: BENCH_r05 burned 6x120 s of hung probes (12 min) before
+# falling back to last_builder_measured even though the fallback evidence
+# was already on disk.  Two attempts / 240 s is enough to ride out one
+# tunnel hiccup; anything longer and the right move is to bank the sweep
+# fallback immediately (error_json does, with the bench_probe_exhausted
+# trail event as the terminal verdict) and let the next capture retry.
+DEFAULT_PROBE_BUDGET_S = 240
 
 
 def resolve_probe_budget(requested):
@@ -111,7 +117,7 @@ def resolve_probe_budget(requested):
     ``--probe-budget`` always wins; the default asks the plan cache
     (``suggested_probe_budget``) — warm entries for this jax version
     mean the winning paths compile immediately, so the TPU-ready
-    envelope drops from 600 s to ~120 s.  Returns ``(budget_s, why)``.
+    envelope drops from 240 s to ~120 s.  Returns ``(budget_s, why)``.
     """
     if requested is not None:
         return max(0, requested), "explicit --probe-budget"
@@ -130,7 +136,7 @@ class ProbeBudgetExhausted(RuntimeError):
     so the capture can fall back to banked sweep evidence."""
 
 
-def tpu_ready(attempts=6, wait_s=90, probe_timeout_s=120, budget_s=0):
+def tpu_ready(attempts=2, wait_s=90, probe_timeout_s=120, budget_s=0):
     """Probe backend init in a subprocess (a hung tunnel cannot wedge us).
 
     Returns ``(ok, error_string, events)``.  Retries ``attempts`` times,
@@ -269,6 +275,16 @@ _SWEEP_FLAGS = {
     # delta is the gathered-stream bytes — halved
     "headline_gather_bf16": {"solve_backend": "gather_fused",
                              "compute_dtype": "bfloat16"},
+    # fused-COMM ring (PR 15): the shard rotation rides the kernel's own
+    # remote-DMA ring (solve_backend='gather_fused_ring') instead of an
+    # XLA-level ppermute around it.  Measured through the sharded ring
+    # step over all visible devices, like ringdb; on one chip this
+    # prices the restructured kernel, on a pod the true in-kernel
+    # overlap.  Not auto-selectable (same bar as ringdb/gather: the ring
+    # accumulates shard Grams in rotation order — a different f32
+    # association than the exact reference path).
+    "headline_ring_fused": {"gather_strategy": "ring",
+                            "solve_backend": "gather_fused_ring"},
 }
 # quality gate for auto-selection: held-out RMSE (stars) the matching
 # rmse evidence must beat.  The known-good band is ~0.43 (BASELINE row
@@ -1103,6 +1119,167 @@ def run_serve(args):
     }
 
 
+def run_multichip(args):
+    """Pod-scale recipe measurement (ROADMAP item 2; BASELINE config 3
+    on-ramp): ingest -> shard -> fused-comm ring
+    (solve_backend='gather_fused_ring') over EVERY visible device, the
+    whole iteration in ONE kernel per half-step with the inter-chip
+    factor rotation riding the kernel's own remote-DMA ring.
+
+    Two platforms, one schedule: on a TPU slice the kernel compiles with
+    the hardware race-control arms and the result banks to
+    ``--multichip-json`` (MULTICHIP_*.json, banked_at provenance); on CPU
+    (``--platform cpu``) the identical grid/ring schedule runs
+    interpret-mode on the 8 forced host devices at a reduced
+    schedule-validation scale — the tier-1-testable path
+    scripts/pod_recipe.sh --dry-run and scripts/multichip_smoke.sh drive.
+    """
+    import numpy as np
+
+    import jax
+
+    from tpu_als.core.als import AlsConfig, resolve_solve_path
+    from tpu_als.io.movielens import ML25M_SHAPE
+    from tpu_als.utils.platform import fence, on_tpu
+
+    nU, nI, nnz = ML25M_SHAPE
+    if args.small:
+        # interpret-mode emulation prices the SCHEDULE, not the chip:
+        # small multichip is a schedule-validation scale (every device
+        # gets multiple row tiles and several buckets), not 1/25 ML-25M
+        nU, nI, nnz = 1200, 900, 40000
+
+    devs = call_with_timeout(jax.devices, 180,
+                             "jax.devices() hung after successful probe")
+    D = len(devs)
+    log(f"devices: {D} x {devs[0].device_kind}")
+    if D < 2:
+        raise RuntimeError(
+            "multichip mode needs a multi-device backend; on CPU start "
+            "with XLA_FLAGS=--xla_force_host_platform_device_count=8")
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_als.parallel.comm import shard_csr_grid
+    from tpu_als.parallel.data import partition_balanced
+    from tpu_als.parallel.mesh import AXIS, make_mesh
+    from tpu_als.parallel.trainer import (
+        _slot_init,
+        comm_bytes_per_iter,
+        make_ring_step,
+        stacked_counts,
+    )
+
+    # -- ingest: synthesize + shard + stage (timed as one phase) --------
+    t0 = time.time()
+    u, i, r = synthetic_cached(nU, nI, nnz, seed=0)
+    mesh = make_mesh(D)
+    leading = NamedSharding(mesh, P(AXIS))
+    upart = partition_balanced(np.bincount(u, minlength=nU), D)
+    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
+    ush = shard_csr_grid(upart, ipart, u, i, r)
+    ish = shard_csr_grid(ipart, upart, i, u, r)
+    ub = jax.device_put(ush.device_buckets(), leading)
+    ib = jax.device_put(ish.device_buckets(), leading)
+    counts = (
+        jax.device_put(stacked_counts(upart, u, r, positive_only=True),
+                       leading),
+        jax.device_put(stacked_counts(ipart, i, r, positive_only=True),
+                       leading))
+    ingest_s = time.time() - t0
+    log(f"ingest (synthesize+shard+stage): {ingest_s:.1f}s "
+        f"({nnz:,} ratings over {D} devices)")
+
+    # -- ring: the fused-comm step at the production rank ---------------
+    cfg = AlsConfig(rank=args.rank, max_iter=1, reg_param=0.01,
+                    implicit_prefs=True, alpha=40.0, seed=0,
+                    solve_backend="gather_fused_ring",
+                    compute_dtype=args.compute_dtype)
+    step = make_ring_step(mesh, ush, ish, cfg)
+    backends = resolve_solve_path(cfg, cfg.rank, matfree_capable=False)
+    log(f"resolved backends: {backends}")
+    key = jax.random.PRNGKey(0)
+    ku, kv = jax.random.split(key)
+    U = jax.device_put(_slot_init(ku, upart, cfg.rank), leading)
+    V = jax.device_put(_slot_init(kv, ipart, cfg.rank), leading)
+
+    t0 = time.time()
+    U, V = step(U, V, ub, ib, *counts)
+    U.block_until_ready()
+    fence(U)
+    log(f"warmup (compile + 1 iter): {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    for _ in range(args.iters):
+        U, V = step(U, V, ub, ib, *counts)
+    U.block_until_ready()
+    checksum = fence(U)
+    dt = time.time() - t0
+    iters_per_sec = args.iters / dt
+    log(f"{args.iters} iters in {dt:.2f}s -> {iters_per_sec:.3f} "
+        f"iters/sec (checksum {checksum:.4g})")
+
+    flops = analytic_flops_per_iter(nnz, nU, nI, cfg.rank, implicit=True)
+    achieved = flops * iters_per_sec
+    ring_bytes = comm_bytes_per_iter(
+        "gather_fused_ring", upart, ipart, cfg.rank,
+        user_container=ush, item_container=ish, implicit=True,
+        compute_dtype=cfg.compute_dtype)
+    result = {
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/sec",
+        "vs_baseline": None,
+        "baseline_note": "no Spark pod proxy — whole-mesh iters/sec; the "
+                         "per-device roofline is docs/roofline.md's "
+                         "multi-chip section",
+        "config": {
+            "users": nU, "items": nI, "ratings": nnz, "rank": args.rank,
+            "implicit": True, "alpha": 40.0,
+            "device": str(devs[0]), "devices": D,
+            "platform": "tpu" if on_tpu() else "cpu_interpret",
+            "seconds_per_iter": round(dt / args.iters, 3),
+            "ingest_seconds": round(ingest_s, 1),
+            "compute_dtype": str(cfg.compute_dtype),
+            "gather_strategy": "ring",
+            "solve_backend": "gather_fused_ring",
+            "comm_bytes_per_iter": ring_bytes,
+            "tflops_per_iter_analytic": round(flops / 1e12, 3),
+            "achieved_tflops": round(achieved / 1e12, 3),
+            "mfu_pct_vs_v5e_bf16_peak": round(
+                100.0 * achieved / (D * V5E_BF16_PEAK_FLOPS), 2),
+            **backends,
+        },
+    }
+    _bank_multichip(result, args)
+    return result
+
+
+def _bank_multichip(result, args):
+    """MULTICHIP_*.json banking: one file per (device count, platform),
+    overwritten by the freshest measurement, ``banked_at`` stamped at
+    bank time — same provenance rule as the sweep's banked lines
+    (_bank_variant): later rounds transport the record verbatim, so the
+    timestamp must be absolute and written HERE, not derived from file
+    mtime downstream."""
+    import os
+
+    path = args.multichip_json
+    if not path:
+        cfgd = result["config"]
+        path = (f"MULTICHIP_{cfgd['devices']}dev_"
+                f"{cfgd['platform']}.json")
+    doc = dict(result)
+    doc["metric"] = "als_iters_per_sec_multichip"
+    doc["banked_at"] = _dt.datetime.now(
+        _dt.timezone.utc).isoformat(timespec="seconds")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    log(f"banked multichip evidence -> {path}")
+
+
 def _resolve(cfg):
     from tpu_als.core.als import resolve_solve_path
 
@@ -1552,7 +1729,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="headline",
                     choices=["headline", "rmse", "ml100k", "foldin",
-                             "twotower", "serve"])
+                             "twotower", "serve", "multichip"])
     ap.add_argument("--small", action="store_true",
                     help="1/25 scale for quick checks")
     ap.add_argument("--iters", type=int, default=3,
@@ -1564,12 +1741,15 @@ def main():
                     help="regParam for rmse mode (weighted-λ scheme)")
     ap.add_argument("--solve-backend", default="auto",
                     choices=["auto", "unfused", "gather_fused",
-                             "gather_fused_solve"],
+                             "gather_fused_solve", "gather_fused_ring"],
                     help="half-step solve path (AlsConfig.solve_backend); "
                          "'auto' probes the Pallas kernels on TPU; "
                          "'gather_fused' forces the DMA-gather NE build, "
                          "'gather_fused_solve' the whole-iteration fused "
-                         "kernel (ops/pallas_gather_ne)")
+                         "kernel (ops/pallas_gather_ne), "
+                         "'gather_fused_ring' the fused-COMM variant "
+                         "(ring strategies only: the shard rotation runs "
+                         "as in-kernel remote DMAs)")
     ap.add_argument("--compute-dtype", default="float32",
                     choices=["float32", "bfloat16"],
                     help="dtype for the gather/einsum stage")
@@ -1611,11 +1791,17 @@ def main():
                     help="disable sweep-evidence auto-selection (the "
                          "sweep itself must pass this so its steps "
                          "measure the configs they claim to)")
-    ap.add_argument("--probe-attempts", type=int, default=6,
-                    help="backend-liveness tries before giving up; the "
-                         "envelope is sized so a driver-time capture "
-                         "survives a brief tunnel outage (~20 min total)")
+    ap.add_argument("--probe-attempts", type=int, default=2,
+                    help="backend-liveness tries before giving up.  "
+                         "Fail-fast on purpose (was 6, ~20 min of hung "
+                         "probes in BENCH_r05): exhaustion banks the "
+                         "strongest sweep evidence immediately instead "
+                         "of burning the capture window")
     ap.add_argument("--probe-wait", type=int, default=90)
+    ap.add_argument("--multichip-json", default="",
+                    help="multichip mode: bank the measurement (plus "
+                         "banked_at) to this path; default "
+                         "MULTICHIP_<devices>dev_<platform>.json")
     ap.add_argument("--probe-timeout", type=int, default=120)
     ap.add_argument("--probe-budget", type=int, default=None,
                     help="TOTAL wall-clock cap across all probe attempts "
@@ -1624,7 +1810,7 @@ def main():
                          "null; on exhaustion the capture banks the "
                          "strongest builder-measured sweep value instead "
                          "(source: sweep_fallback).  Default: the "
-                         "execution planner's suggestion — 600, or ~120 "
+                         "execution planner's suggestion — 240, or ~120 "
                          "when the plan cache holds warm entries for "
                          "this jax version (docs/planner.md)")
     args = ap.parse_args()
@@ -1660,6 +1846,7 @@ def main():
         "foldin": ("foldin_p50_latency", "seconds_p50"),
         "twotower": ("two_tower_recall_at_10", "recall_at_10"),
         "serve": ("serve_topk_users_per_sec_ml25m_rank128", "users/sec"),
+        "multichip": ("als_iters_per_sec_multichip", "iters/sec"),
     }[args.mode]
     if args.small:
         metric += "_small"
@@ -1689,7 +1876,7 @@ def main():
         run = {"headline": run_headline, "rmse": run_rmse,
                "ml100k": run_rmse,
                "foldin": run_foldin, "twotower": run_twotower,
-               "serve": run_serve}[args.mode]
+               "serve": run_serve, "multichip": run_multichip}[args.mode]
         result = run(args)
         result["metric"] = metric
     except Exception as e:  # tunnel can die mid-run; JSON contract holds

@@ -1,0 +1,65 @@
+#!/bin/bash
+# Multi-chip smoke: the fused-comm ring's CI gate, CPU-only (interpret
+# mode on a forced 8-device host mesh — the identical grid/ring
+# schedule the TPU path compiles, minus the hardware race arms, which
+# are sync-gated off in interpret mode by design).  Four stages,
+# fail-fast, wired like the other *_smoke.sh suites:
+#
+#   1. the fused-comm test tier: the ring_substrate equivalence pins
+#      (substrate == frozen hand-rolled twins, no private DMA call
+#      sites) and the extended comm_audit (traced in-kernel remote-DMA
+#      bytes == comm_bytes_per_iter closed form, no XLA gather
+#      collectives in the fused step).
+#   2. static checks: obs schema + the analysis gate
+#      (scripts/lint_smoke.sh = `tpu_als lint` under poisoned jax,
+#      then the full jaxpr contract registry — ring_substrate and
+#      comm_audit re-verify there by name too).
+#   3. the pod recipe end to end: `pod_recipe.sh --dry-run` runs
+#      ingest -> fused ring -> rank-256 solve and banks a
+#      MULTICHIP_*.json whose provenance fields the recipe itself
+#      verifies.  Banked into a scratch dir — the smoke never touches
+#      the committed series.
+#   4. `tpu_als observe regress --trend` over the committed BENCH_*/
+#      MULTICHIP_* series: the smoke fails if the multi-chip lane (or
+#      any other banked series) has regressed or lost provenance.
+#
+# Usage: scripts/multichip_smoke.sh   (repo root; ~4-5 min on CPU —
+# stage 3's rank-256 interpret compile is the budget, ~2.5 min)
+set -u
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+fail=0
+
+echo "== multichip smoke 1/4: fused-comm test tier =="
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_ring_substrate.py tests/test_comm_audit.py \
+    -q -m 'not slow' -p no:cacheprovider || fail=1
+
+echo "== multichip smoke 2/4: static checks (obs schema + analysis gate) =="
+python scripts/check_obs_schema.py || fail=1
+scripts/lint_smoke.sh || fail=1
+
+echo "== multichip smoke 3/4: pod recipe dry-run (8-device interpret) =="
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+if ! bash scripts/pod_recipe.sh --dry-run --out="$work/MULTICHIP_dryrun.json" \
+        >"$work/recipe.out" 2>"$work/recipe.log"; then
+    echo "FAIL: pod_recipe.sh --dry-run exited nonzero" >&2
+    tail -5 "$work/recipe.log" >&2
+    fail=1
+else
+    grep "pod_recipe: OK" "$work/recipe.out" || {
+        echo "FAIL: recipe ran but never printed its OK line" >&2
+        fail=1
+    }
+fi
+
+echo "== multichip smoke 4/4: bench-series regression gate (trend) =="
+python -m tpu_als.cli observe regress --trend . || fail=1
+
+if [ "$fail" -ne 0 ]; then
+    echo "multichip smoke: FAIL" >&2
+    exit 1
+fi
+echo "multichip smoke: OK"

@@ -84,7 +84,19 @@ DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
 #   queued bf16-before-gather A/B (headline_gather_bf16.out), and
 #   solve_fused_lab the per-width kernel A/B.  Step names keep the
 #   canonical-bank-collision rule above (prefix, not headline_*).
+#   Round-10 (fused-comm ring, PR 15): the re-anchor queue.  0.8449
+#   iters/sec is sweep-validated ONLY (no window since PR 14 landed the
+#   MXU Cholesky + whole-iteration fusion), so the flagship and its two
+#   strongest challengers lead: gather_solve_headline / gather_bf16 A/Bs
+#   re-anchor the single-chip number on the CURRENT kernels, and
+#   ring_fused_headline banks the new in-kernel remote-DMA ring
+#   (headline_ring_fused.out — on one chip it prices the restructured
+#   kernel; the overlap claim needs the multichip step).  multichip_ring
+#   banks MULTICHIP_*.json (whole-mesh iters/sec at rank 256, banked_at
+#   provenance) the moment a slice is reachable.
 STEPS=(
+  "ring_fused_headline|700|python bench.py --no-auto-config --iters 5 --ab ring_fused --ab-dir sweep_logs --probe-attempts 1"
+  "multichip_ring|900|python bench.py --no-auto-config --mode multichip --rank 256 --iters 3 --probe-attempts 1"
   "gather_solve_headline|700|python bench.py --no-auto-config --iters 5 --ab gather_solve --ab-dir sweep_logs --probe-attempts 1"
   "gather_bf16_headline|700|python bench.py --no-auto-config --iters 5 --ab gather_bf16 --ab-dir sweep_logs --probe-attempts 1"
   "gather_headline|700|python bench.py --no-auto-config --iters 5 --ab gather --ab-dir sweep_logs --probe-attempts 1"

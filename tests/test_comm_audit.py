@@ -162,6 +162,49 @@ def test_chunked_gather_model_matches_traced_bytes(rng):
     assert traced == model, (traced, model, breakdown)
 
 
+def test_fused_ring_remote_dma_matches_model(rng):
+    """solve_backend='gather_fused_ring': the inter-chip bytes are
+    in-kernel remote DMAs — collective_bytes cannot see them (and must
+    see NO ppermute/all_gather left in the step), remote_dma_bytes must
+    count exactly comm_bytes_per_iter's gather_fused_ring closed form
+    (perf.roofline.ring_remote_bytes per half-step)."""
+    from tpu_als.parallel.comm import shard_csr_grid
+    from tpu_als.parallel.comm_audit import remote_dma_bytes
+
+    u, i, r, upart, ipart = _problem(rng)
+    rank = 128  # real lane width — the payload model is r_pad-exact
+    cfg = AlsConfig(rank=rank, max_iter=1, reg_param=0.1,
+                    implicit_prefs=True, alpha=4.0, seed=0,
+                    solve_backend="gather_fused_ring")
+    ugrid = shard_csr_grid(upart, ipart, u, i, r, min_width=4)
+    igrid = shard_csr_grid(ipart, upart, i, u, r, min_width=4)
+    mesh = make_mesh(D)
+    U, V, leading = _factors(mesh, upart, ipart, rank)
+    ub = jax.device_put(ugrid.device_buckets(), leading)
+    ib = jax.device_put(igrid.device_buckets(), leading)
+    uc = jax.device_put(
+        jnp.asarray(stacked_counts(upart, u, r, positive_only=True)),
+        leading)
+    ic = jax.device_put(
+        jnp.asarray(stacked_counts(ipart, i, r, positive_only=True)),
+        leading)
+    step = make_ring_step(mesh, ugrid, igrid, cfg)
+    traced, per_call = remote_dma_bytes(step, U, V, ub, ib, uc, ic)
+    model = comm_bytes_per_iter("gather_fused_ring", upart, ipart, rank,
+                                user_container=ugrid, item_container=igrid,
+                                implicit=False)
+    assert traced == model, (traced, model, per_call)
+    # the rotation moved in-kernel: no XLA gather collectives remain,
+    # only the replicated-YtY psum (implicit mode's base Gram term)
+    _, breakdown = collective_bytes(step, U, V, ub, ib, uc, ic,
+                                    axis_size=D)
+    assert "ppermute" not in breakdown and "all_gather" not in breakdown
+    psum_model = comm_bytes_per_iter(
+        "gather_fused_ring", upart, ipart, rank, user_container=ugrid,
+        item_container=igrid, implicit=True) - model
+    assert breakdown.get("psum", 0) == psum_model, (breakdown, psum_model)
+
+
 def test_a2a_model_matches_traced_bytes():
     from tpu_als.parallel.a2a import build_a2a
 

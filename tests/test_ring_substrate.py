@@ -1,0 +1,254 @@
+"""The shared double-buffer ring substrate (ops.ring_buffer) and the
+fused-comm ring kernel (``solve_backend='gather_fused_ring'``) built on it.
+
+Two families of pins:
+
+1. **Substrate extraction** — routing ``pallas_gather_ne`` and
+   ``pallas_topk`` through :func:`ring_buffer.pump` /
+   :func:`ring_buffer.grid_pump` emits a byte-identical jaxpr (modulo
+   source locations) to the pre-extraction hand-rolled loops, and no
+   private ``make_async_copy`` call sites survive outside the substrate
+   module.  Owned here; re-verifiable via
+   ``contracts.verify('ring_substrate')``.
+
+2. **Fused-comm ring kernel** — the in-kernel ``make_async_remote_copy``
+   rotation under ``shard_map`` matches the single-device fused solve on
+   the concatenated global column space: degenerate ring (n_shards=1,
+   bitwise), full 8-device ring, non-power-of-two submesh rings, ragged
+   row/width tiles, and a 3-iteration end-to-end ``train_sharded`` run
+   against the single-device reference (both feedback modes).
+
+All on the 8-device forced-host CPU backend in interpret mode — schedule
+and numerics are fully exercised; the hardware-only race-control arms
+(ack backpressure, pass barrier) are compile-gated and documented in the
+kernel docstring.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_als.ops.pallas_gather_ne import (
+    gather_fused_ring_explicit,
+    gather_fused_ring_implicit,
+    gather_fused_solve_explicit,
+    gather_fused_solve_implicit,
+)
+
+from conftest import make_ratings
+
+RANK = 128  # one real lane tile: exercises the exact hardware layout
+
+
+# -- 1. the substrate extraction pin ---------------------------------------
+
+def test_ring_substrate_contract():
+    """Substrate pump == frozen pre-extraction twin, byte-for-byte after
+    source-location normalization, for gather_gram, gather_solve AND
+    topk_scores_pallas; no async-DMA call sites outside ops/ring_buffer."""
+    from tpu_als.analysis import contracts
+
+    res = contracts.verify("ring_substrate")
+    assert res.ok, res.detail
+    assert "no async-DMA call sites outside ops/ring_buffer.py" in res.detail
+
+
+def test_substrate_is_the_only_dma_descriptor_owner():
+    """Standalone restatement of the source scan (fails with the offender
+    list even if the jaxpr half of the contract breaks first)."""
+    import re
+    from pathlib import Path
+
+    import tpu_als
+
+    root = Path(tpu_als.__file__).resolve().parent
+    call = re.compile(r"make_async(?:_remote)?_copy\s*\(")
+    offenders = sorted(
+        str(p.relative_to(root))
+        for p in root.rglob("*.py")
+        if p.name != "ring_buffer.py" and call.search(p.read_text())
+    )
+    assert not offenders, offenders
+
+
+# -- 2. the fused-comm ring kernel -----------------------------------------
+
+def _ring_problem(rng, S, per, n, w, r=RANK):
+    """Per-device ring buckets: cols[d, s, n, w] are LOCAL ids into the
+    shard held at ring step s (the wrapper's pre-rotation maps step to
+    source shard), plus the concatenated global-column reference inputs."""
+    Vfull = (rng.normal(size=(S * per, r)) / np.sqrt(r)).astype(np.float32)
+    cols = rng.integers(0, per, size=(S, S, n, w)).astype(np.int32)
+    vals = rng.normal(size=(S, S, n, w)).astype(np.float32)
+    mask = (rng.random(size=(S, S, n, w)) < 0.7).astype(np.float32)
+    return Vfull, cols, vals, mask
+
+
+def _global_ref(d, per, S, cols, vals, mask):
+    gcols = np.concatenate([cols[d, s] + s * per for s in range(S)], axis=1)
+    gvals = np.concatenate([vals[d, s] for s in range(S)], axis=1)
+    gmask = np.concatenate([mask[d, s] for s in range(S)], axis=1)
+    return gcols, gvals, gmask
+
+
+def test_nshards1_ring_is_bitwise_gather_fused_solve(rng):
+    """The degenerate ring (S=1, no rotation, no remote DMA) IS the PR 14
+    fused-solve kernel — bitwise, not approximately: same tiling, same
+    accumulation order, the ring arms compile out entirely."""
+    per, n, w = 64, 48, 24
+    V = (rng.normal(size=(per, RANK)) / np.sqrt(RANK)).astype(np.float32)
+    cols = rng.integers(0, per, size=(1, n, w)).astype(np.int32)
+    vals = rng.normal(size=(1, n, w)).astype(np.float32)
+    mask = (rng.random(size=(1, n, w)) < 0.8).astype(np.float32)
+
+    x_ring = gather_fused_ring_explicit(
+        jnp.asarray(V), jnp.asarray(cols), jnp.asarray(vals),
+        jnp.asarray(mask), 0.05, interpret=True)
+    x_ref = gather_fused_solve_explicit(
+        jnp.asarray(V), jnp.asarray(cols[0]), jnp.asarray(vals[0]),
+        jnp.asarray(mask[0]), 0.05, interpret=True)
+    assert np.array_equal(np.asarray(x_ring), np.asarray(x_ref))
+
+
+@pytest.mark.parametrize("S", [8, 5, 3])
+def test_ring_matches_global_fused_solve_explicit(rng, S):
+    """Ring under shard_map == single-device fused solve on concatenated
+    global columns, per device.  S=5 and S=3 are the non-power-of-two
+    rings: the schedule is (S-1) rotations of a logical ring, nothing in
+    it assumes S is a power of two — this is where that's pinned."""
+    AXIS = "d"
+    mesh = Mesh(np.array(jax.devices()[:S]), (AXIS,))
+    per, n, w = 40, 56, 16
+    Vfull, cols, vals, mask = _ring_problem(rng, S, per, n, w)
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+                       out_specs=P(AXIS), check_rep=False)
+    def run(V_shard, c, v, m):
+        return gather_fused_ring_explicit(
+            V_shard, c[0], v[0], m[0], 0.05, axis_name=AXIS,
+            interpret=True)[None]
+
+    x = np.asarray(run(jnp.asarray(Vfull), jnp.asarray(cols),
+                       jnp.asarray(vals), jnp.asarray(mask)))
+    for d in range(S):
+        gcols, gvals, gmask = _global_ref(d, per, S, cols, vals, mask)
+        xr = np.asarray(gather_fused_solve_explicit(
+            jnp.asarray(Vfull), jnp.asarray(gcols), jnp.asarray(gvals),
+            jnp.asarray(gmask), 0.05, interpret=True))
+        np.testing.assert_allclose(x[d], xr, atol=2e-5, rtol=1e-5)
+
+
+def test_ring_matches_global_fused_solve_implicit(rng):
+    """Implicit mode: the YtY base term is replicated (psum'd outside the
+    kernel), only (conf-1)-weighted corrections ride the ring."""
+    AXIS = "d"
+    S = 8
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    per, n, w = 40, 56, 16
+    Vfull, cols, vals, mask = _ring_problem(rng, S, per, n, w)
+    vals = np.abs(vals) * 4 + 0.1
+    YtY = (Vfull.T @ Vfull).astype(np.float32)
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
+                       out_specs=P(AXIS), check_rep=False)
+    def run(V_shard, c, v, m, yty):
+        return gather_fused_ring_implicit(
+            V_shard, c[0], v[0], m[0], 0.05, 40.0, yty, axis_name=AXIS,
+            interpret=True)[None]
+
+    x = np.asarray(run(jnp.asarray(Vfull), jnp.asarray(cols),
+                       jnp.asarray(vals), jnp.asarray(mask),
+                       jnp.asarray(YtY)))
+    for d in range(S):
+        gcols, gvals, gmask = _global_ref(d, per, S, cols, vals, mask)
+        xr = np.asarray(gather_fused_solve_implicit(
+            jnp.asarray(Vfull), jnp.asarray(gcols), jnp.asarray(gvals),
+            jnp.asarray(gmask), 0.05, 40.0, jnp.asarray(YtY),
+            interpret=True))
+        # ring accumulates shard Grams in rotation order, the reference
+        # in concatenation order — fp association noise only
+        np.testing.assert_allclose(x[d], xr, atol=1e-4, rtol=1e-4)
+
+
+def test_ring_ragged_rows_and_width(rng):
+    """Rows not a multiple of the row tile and width not a multiple of
+    the lane chunk: the padding rows/columns must not contaminate the
+    gathered tiles of LATER ring steps (a padded row gathers shard row 0
+    via clamped ids but carries zero weight)."""
+    AXIS = "d"
+    S = 4
+    mesh = Mesh(np.array(jax.devices()[:S]), (AXIS,))
+    per, n, w = 24, 13, 5  # n, w both ragged vs any power-of-two tiling
+    Vfull, cols, vals, mask = _ring_problem(rng, S, per, n, w)
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+                       out_specs=P(AXIS), check_rep=False)
+    def run(V_shard, c, v, m):
+        return gather_fused_ring_explicit(
+            V_shard, c[0], v[0], m[0], 0.05, axis_name=AXIS,
+            interpret=True)[None]
+
+    x = np.asarray(run(jnp.asarray(Vfull), jnp.asarray(cols),
+                       jnp.asarray(vals), jnp.asarray(mask)))
+    for d in range(S):
+        gcols, gvals, gmask = _global_ref(d, per, S, cols, vals, mask)
+        xr = np.asarray(gather_fused_solve_explicit(
+            jnp.asarray(Vfull), jnp.asarray(gcols), jnp.asarray(gvals),
+            jnp.asarray(gmask), 0.05, interpret=True))
+        np.testing.assert_allclose(x[d], xr, atol=2e-5, rtol=1e-5)
+
+
+# -- 3. end-to-end: train_sharded with the fused ring ----------------------
+
+@pytest.mark.parametrize("implicit", [False, True])
+def test_fused_ring_train_matches_single_device(implicit):
+    """3 iterations of strategy='ring' + solve_backend='gather_fused_ring'
+    == the single-device reference, both feedback modes.  The whole
+    wiring stack is on the line here: resolve_solve_path, make_ring_step's
+    fused dispatch, ring_fused_half_step's bucket loop + scatter, the
+    kernel, and the psum(YtY) path for implicit."""
+    from tpu_als.core.als import AlsConfig, train
+    from tpu_als.core.ratings import build_csr_buckets
+    from tpu_als.parallel.comm import shard_csr_grid
+    from tpu_als.parallel.data import partition_balanced
+    from tpu_als.parallel.mesh import make_mesh
+    from tpu_als.parallel.trainer import stacked_counts, train_sharded
+
+    gen = np.random.default_rng(2)
+    u, i, r, _, _ = make_ratings(gen, 60, 45, rank=3, density=0.4)
+    if implicit:
+        r = np.abs(r) * 4 + 0.1
+    cfg = AlsConfig(rank=4, max_iter=3, reg_param=0.05,
+                    implicit_prefs=implicit, alpha=6.0, seed=9,
+                    solve_backend="gather_fused_ring")
+    n_dev = 8
+    mesh = make_mesh(n_dev)
+    upart = partition_balanced(np.bincount(u, minlength=60), n_dev)
+    ipart = partition_balanced(np.bincount(i, minlength=45), n_dev)
+    ush = shard_csr_grid(upart, ipart, u, i, r, min_width=4)
+    ish = shard_csr_grid(ipart, upart, i, u, r, min_width=4)
+    counts = (stacked_counts(upart, u, r, positive_only=implicit),
+              stacked_counts(ipart, i, r, positive_only=implicit))
+    U, V = train_sharded(mesh, upart, ipart, ush, ish, cfg,
+                         strategy="ring", ring_counts=counts)
+    Ur, Vr = np.asarray(U)[upart.slot], np.asarray(V)[ipart.slot]
+
+    cfg1 = AlsConfig(rank=4, max_iter=3, reg_param=0.05,
+                     implicit_prefs=implicit, alpha=6.0, seed=9)
+    ub = build_csr_buckets(u, i, r, 60, min_width=4)
+    ib = build_csr_buckets(i, u, r, 45, min_width=4)
+    U1, V1 = train(ub, ib, cfg1)
+    np.testing.assert_allclose(Ur, np.asarray(U1), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(Vr, np.asarray(V1), rtol=2e-3, atol=2e-3)

@@ -366,12 +366,18 @@ def _build_comm_audit():
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from tpu_als.core.als import AlsConfig
-    from tpu_als.parallel.comm_audit import collective_bytes
+    from tpu_als.parallel.comm import shard_csr_grid
+    from tpu_als.parallel.comm_audit import (
+        collective_bytes,
+        remote_dma_bytes,
+    )
     from tpu_als.parallel.data import partition_balanced, shard_csr
     from tpu_als.parallel.mesh import AXIS, make_mesh
     from tpu_als.parallel.trainer import (
         comm_bytes_per_iter,
+        make_ring_step,
         make_sharded_step,
+        stacked_counts,
     )
 
     D = len(jax.devices())
@@ -404,8 +410,49 @@ def _build_comm_audit():
     model = comm_bytes_per_iter("all_gather", upart, ipart, rank,
                                 user_container=ush, item_container=ish,
                                 implicit=True)
+
+    # fused-comm ring (solve_backend='gather_fused_ring'): the inter-chip
+    # bytes move as in-kernel remote DMAs — invisible to
+    # collective_bytes, counted by remote_dma_bytes — and must equal the
+    # model's gather_fused_ring closed form (perf.roofline
+    # ring_remote_bytes per half-step), with NO ppermute left in the
+    # traced step (the rotation migrated into the kernel)
+    rank_ring = 128  # real lane width: the payload model is r_pad-exact
+    ug = shard_csr_grid(upart, ipart, u, i, r, min_width=4)
+    ig = shard_csr_grid(ipart, upart, i, u, r, min_width=4)
+    cfg_ring = AlsConfig(rank=rank_ring, max_iter=1, reg_param=0.1,
+                         implicit_prefs=True, alpha=4.0, seed=0,
+                         solve_backend="gather_fused_ring")
+    ring_step = make_ring_step(mesh, ug, ig, cfg_ring)
+    Ur = jax.device_put(
+        jnp.zeros((upart.padded_rows, rank_ring), jnp.float32), leading)
+    Vr = jax.device_put(
+        jnp.zeros((ipart.padded_rows, rank_ring), jnp.float32), leading)
+    ubg = jax.device_put(ug.device_buckets(), leading)
+    ibg = jax.device_put(ig.device_buckets(), leading)
+    uc = jax.device_put(stacked_counts(upart, u, r, positive_only=True),
+                        leading)
+    ic = jax.device_put(stacked_counts(ipart, i, r, positive_only=True),
+                        leading)
+    ring_args = (Ur, Vr, ubg, ibg, uc, ic)
+    ring_traced, _ = remote_dma_bytes(ring_step, *ring_args)
+    _, ring_breakdown = collective_bytes(ring_step, *ring_args,
+                                         axis_size=D)
+    # the implicit=False form is the pure ring term; the implicit=True
+    # delta is the psum(YtY) adder — pinned separately because they are
+    # counted by different auditors (remote_dma_bytes vs collective_bytes)
+    ring_model = comm_bytes_per_iter(
+        "gather_fused_ring", upart, ipart, rank_ring,
+        user_container=ug, item_container=ig, implicit=False)
+    ring_model_psum = comm_bytes_per_iter(
+        "gather_fused_ring", upart, ipart, rank_ring,
+        user_container=ug, item_container=ig, implicit=True) - ring_model
     return {"traced": traced, "model": model, "breakdown": breakdown,
-            "devices": D}
+            "devices": D, "ring_traced": ring_traced,
+            "ring_model": ring_model,
+            "ring_psum_traced": ring_breakdown.get("psum", 0),
+            "ring_psum_model": ring_model_psum,
+            "ring_breakdown": ring_breakdown}
 
 
 def _pin_comm_audit(a):
@@ -417,8 +464,143 @@ def _pin_comm_audit(a):
              f"traced collective bytes {a['traced']} != "
              f"comm_bytes_per_iter model {a['model']} "
              f"(breakdown {a['breakdown']})")
+    _require(a["ring_traced"] == a["ring_model"],
+             f"traced in-kernel remote-DMA bytes {a['ring_traced']} != "
+             f"comm_bytes_per_iter('gather_fused_ring') ring term "
+             f"{a['ring_model']}")
+    _require("ppermute" not in a["ring_breakdown"]
+             and "all_gather" not in a["ring_breakdown"],
+             "fused-comm ring step still traces XLA gather collectives "
+             f"({sorted(a['ring_breakdown'])}) — the rotation did not "
+             "move in-kernel")
+    _require(a["ring_psum_traced"] == a["ring_psum_model"],
+             f"fused-ring psum(YtY) bytes {a['ring_psum_traced']} != "
+             f"model {a['ring_psum_model']}")
     return (f"traced == modeled collective bytes ({a['traced']} B/device "
-            f"across {a['devices']} devices)")
+            f"across {a['devices']} devices; fused-ring remote-DMA "
+            f"{a['ring_traced']} B/device == closed form, no XLA gather "
+            "collectives)")
+
+
+# -- ring_substrate ---------------------------------------------------------
+
+def _build_ring_substrate():
+    import re
+    from pathlib import Path
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from tpu_als.ops import pallas_gather_ne as pg
+    from tpu_als.ops import pallas_topk as pt
+    from tpu_als.ops import ring_buffer as rb
+
+    # frozen twins of the PRE-extraction hand-rolled schedules (PR 14's
+    # in-kernel loop in pallas_gather_ne; pallas_topk's per-grid-step
+    # variant).  These are deliberate verbatim copies: the substrate
+    # extraction claimed "byte-identical jaxpr modulo source locations",
+    # and this contract is where that claim is load-bearing.
+    def _frozen_pump(n_entries, make_copy, depth=None):
+        if depth is None:
+            depth = min(8, n_entries)  # inlined DMA_SLOTS=8, pre-extraction
+        for s in range(depth):
+            make_copy(s, s).start()
+
+        def _body(e, carry):
+            make_copy(e, e % depth).wait()
+
+            @pl.when(e + depth < n_entries)
+            def _next():
+                make_copy(e + depth, e % depth).start()
+
+            return carry
+
+        jax.lax.fori_loop(0, n_entries, _body, 0)
+
+    def _frozen_grid_pump(step, n_steps, make_copy, depth=2):
+        @pl.when(step == 0)
+        def _prime():
+            make_copy(0, 0).start()
+
+        make_copy(step, jax.lax.rem(step, depth)).wait()
+
+        @pl.when(step + 1 < n_steps)
+        def _next():
+            make_copy(step + 1, jax.lax.rem(step + 1, depth)).start()
+
+    def _norm(jaxpr):
+        # source locations are the ONE documented difference between the
+        # twin (defined here) and the substrate (defined in ring_buffer)
+        return re.sub(r" at /[^,\s)]*", "", str(jaxpr))
+
+    rng = np.random.default_rng(0)
+    n, w, r = 24, 12, 8
+    V = jnp.asarray(rng.normal(size=(n, r)).astype(np.float32))
+    cols = jnp.asarray(rng.integers(0, n, size=(5, w)).astype(np.int32))
+    aw = jnp.ones((5, w), jnp.float32)
+    bw = jnp.asarray(rng.normal(size=(5, w)).astype(np.float32))
+    cw = jnp.ones((5, w), jnp.float32)
+    U = jnp.asarray(rng.normal(size=(256, 16)).astype(np.float32))
+    Vt = jnp.asarray(rng.normal(size=(1024, 16)).astype(np.float32))
+    valid = jnp.ones(1024, bool)
+
+    # trace the UNJITTED entry points (__wrapped__): pjit caches inner
+    # jaxprs across calls, so the monkeypatched twin would be invisible
+    # through the jit wrapper
+    def traces():
+        out = {
+            "gather_gram": jax.make_jaxpr(
+                lambda: pg.gather_gram.__wrapped__(
+                    V, cols, aw, bw, two_sided=True, interpret=True))(),
+            "gather_solve": jax.make_jaxpr(
+                lambda: pg.gather_solve.__wrapped__(
+                    V, cols, aw, bw, cw, two_sided=True, reg=0.1,
+                    interpret=True))(),
+            "topk": jax.make_jaxpr(
+                lambda: pt.topk_scores_pallas.__wrapped__(
+                    U, Vt, valid, 10, interpret=True))(),
+        }
+        return {k: _norm(v) for k, v in out.items()}
+
+    current = traces()
+    orig = rb.pump, rb.grid_pump
+    rb.pump, rb.grid_pump = _frozen_pump, _frozen_grid_pump
+    try:
+        frozen = traces()
+    finally:
+        rb.pump, rb.grid_pump = orig
+
+    # source scan: the substrate owns EVERY async-DMA descriptor.  A
+    # private make_async_copy / make_async_remote_copy call site outside
+    # ops/ring_buffer.py is a fourth hand-rolled double-buffer waiting to
+    # drift.  Call syntax only — prose mentions in docstrings are fine.
+    root = Path(pg.__file__).resolve().parents[1]
+    call = re.compile(r"make_async(?:_remote)?_copy\s*\(")
+    offenders = sorted(
+        str(p.relative_to(root))
+        for p in root.rglob("*.py")
+        if p.name != "ring_buffer.py" and call.search(p.read_text())
+    )
+    return {"current": current, "frozen": frozen, "offenders": offenders}
+
+
+def _pin_ring_substrate(a):
+    for k, cur in a["current"].items():
+        froz = a["frozen"][k]
+        _require(cur == froz,
+                 f"{k}: substrate-routed jaxpr differs from the frozen "
+                 f"pre-extraction twin ({len(cur)} vs {len(froz)} chars "
+                 "after source-location normalization) — the extraction "
+                 "changed the emitted schedule")
+    _require(not a["offenders"],
+             "private async-DMA call sites outside ops/ring_buffer.py: "
+             f"{a['offenders']}")
+    sizes = ", ".join(f"{k} {len(v)}c" for k, v in a["current"].items())
+    return (f"substrate pump == frozen hand-rolled twin ({sizes}); no "
+            "async-DMA call sites outside ops/ring_buffer.py")
 
 
 # -- live_delta_index -------------------------------------------------------
@@ -495,6 +677,9 @@ _REGISTRY = {
                  "PR 9"),
         Contract("comm_audit", _build_comm_audit, _pin_comm_audit,
                  "tests/test_comm_audit.py, PR 6"),
+        Contract("ring_substrate", _build_ring_substrate,
+                 _pin_ring_substrate,
+                 "tests/test_ring_substrate.py, PR 15"),
         Contract("live_delta_index", _build_live_delta, _pin_live_delta,
                  "tests/test_live.py, PR 11"),
     )

@@ -177,13 +177,10 @@ class ALS(_ALSParams, Estimator):
     Runtime-only (non-Param) knobs: ``mesh`` — a ``jax.sharding.Mesh`` to
     train sharded over devices (None = single device; ``numUserBlocks`` /
     ``numItemBlocks`` are then API-parity hints only); ``gatherStrategy`` —
-    how sharded half-steps move the opposite factors: ``'all_gather'``
-    (default), ``'all_gather_chunked'`` (gathered in column blocks per
-    row tile — the full opposite table never materializes),
-    ``'ring'`` (ppermute streaming — opposite factors never
-    materialize in full), ``'ring_overlap'`` (ring with the
-    double-buffered ppermute-under-einsum schedule), or ``'all_to_all'``
-    (ragged exchange of only the referenced rows); ``checkpointDir`` —
+    how sharded half-steps move the opposite factors: any row of
+    ``tpu_als.parallel.trainer.GATHER_STRATEGIES`` (the one
+    authoritative strategy table — this docstring deliberately does not
+    restate it); default ``'all_gather'``; ``checkpointDir`` —
     where ``checkpointInterval`` writes resumable factor snapshots;
     ``resumeFrom`` — a checkpoint directory to warm-start from: ``fit``
     loads its factors + iteration counter and runs only the remaining
@@ -243,15 +240,13 @@ class ALS(_ALSParams, Estimator):
                              "'matfree' or 'dense')")
         self.cgIters = int(cgIters)
         self.cgMode = cgMode
-        if gatherStrategy not in ("auto", "all_gather",
-                                  "all_gather_chunked", "ring",
-                                  "ring_overlap", "all_to_all"):
+        # validate against THE strategy table (parallel.trainer owns it)
+        from tpu_als.parallel.trainer import GATHER_STRATEGIES, strategy_help
+
+        if gatherStrategy not in GATHER_STRATEGIES:
             raise ValueError(
                 f"unknown gatherStrategy {gatherStrategy!r} (expected "
-                "'auto', 'all_gather', 'all_gather_chunked', 'ring', "
-                "'ring_overlap' or 'all_to_all'; 'auto' lets the "
-                "execution planner pick by modeled collective bytes — "
-                "tpu_als.plan)")
+                f"one of {tuple(GATHER_STRATEGIES)}; {strategy_help()})")
         if dataMode not in ("replicated", "per_host"):
             raise ValueError(f"unknown dataMode {dataMode!r} (expected "
                              "'replicated' or 'per_host')")

@@ -1666,6 +1666,12 @@ def cmd_lint(args):
 
 
 def main(argv=None):
+    # choices + help for every strategy flag come from THE table in
+    # parallel.trainer (running `python -m tpu_als.cli` already paid the
+    # package import, so this is free here)
+    from tpu_als.parallel.trainer import (EXECUTABLE_STRATEGIES,
+                                          GATHER_STRATEGIES, strategy_help)
+
     ap = argparse.ArgumentParser(prog="tpu_als")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -1699,14 +1705,10 @@ def main(argv=None):
                    help="train sharded over N devices (0 = all visible; "
                         "1 = single device, the default)")
     t.add_argument("--gather-strategy", default="all_gather",
-                   choices=["auto", "all_gather", "all_gather_chunked",
-                            "ring", "ring_overlap", "all_to_all"],
+                   choices=list(GATHER_STRATEGIES),
                    help="how sharded half-steps move the opposite factors "
-                        "(ring_overlap = double-buffered ring; "
-                        "all_gather_chunked = column-block gathers, the "
-                        "full opposite table never materializes; auto = "
-                        "the execution planner's comm-model pick, "
-                        "single-process mesh fits only)")
+                        "(authoritative table: parallel.trainer."
+                        f"GATHER_STRATEGIES — {strategy_help()})")
     t.add_argument("--per-host-data", action="store_true",
                    help="multi-process only: each process loads its OWN "
                         "--data split ('{proc}' in the spec expands to "
@@ -1983,9 +1985,9 @@ def main(argv=None):
     os3.add_argument("--devices", type=int,
                      default=_RL_HEADLINE["devices"])
     os3.add_argument("--strategy", default=None,
-                     choices=["all_gather", "all_gather_chunked", "ring",
-                              "ring_overlap", "all_to_all"],
-                     help="price the collective stage too (sharded)")
+                     choices=list(EXECUTABLE_STRATEGIES),
+                     help="price the collective stage too (sharded; "
+                          "table: parallel.trainer.GATHER_STRATEGIES)")
     os3.add_argument("--tiles", type=int, default=1,
                      help="row-tile count (ring/chunked strategies "
                           "re-stream the opposite factors per tile)")

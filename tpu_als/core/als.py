@@ -64,7 +64,15 @@ class AlsConfig:
     # 'gather_fused' forces the DMA-gather NE kernel,
     # 'gather_fused_solve' forces the whole-iteration kernel (both run
     # interpret-mode off-TPU, so CPU tests exercise them); 'unfused'
-    # forces the plain einsum path (NNLS always uses unfused)
+    # forces the plain einsum path (NNLS always uses unfused).
+    # 'gather_fused_ring' forces the fused-COMM kernel under the ring
+    # strategies: the inter-chip factor rotation runs as a
+    # make_async_remote_copy ring INSIDE the whole-iteration kernel
+    # (ops.pallas_gather_ne.gather_solve_ring) — explicit knob + an
+    # availability probe on the live mesh, never a banked verdict (the
+    # multi-host safety rule: banked outcomes must not steer
+    # collectives).  On the local/all_gather paths it degrades to
+    # 'gather_fused_solve' (an S=1 ring IS that kernel, bitwise)
     solve_backend: str = "auto"
     # > 0: replace the exact per-row factorization with that many
     # warm-started Jacobi-CG steps (ops.solve) — inexact ALS.
@@ -161,6 +169,15 @@ def _resolve_solve_path_walk(cfg: AlsConfig, rank, matfree_capable=True):
         # its outcome, and the probe costs a Mosaic compile+execute on
         # every resolve.  Off-TPU the kernel runs in interpret mode.
         path = "gatherfused_solve"
+    elif cfg.solve_backend == "gather_fused_ring":
+        # forced fused-comm ring: the ring strategies move the rotation
+        # in-kernel (comm.ring_fused_half_step); the local/all_gather
+        # paths treat this as gather_fused_solve (the S=1 degenerate
+        # ring, bitwise the same kernel body).  The on-mesh availability
+        # probe (pallas_gather_ne.ring_available) gates the SHARDED
+        # dispatch at step-build time, not here — resolve runs per
+        # process and must not execute collectives.
+        path = "gatherfused_ring"
     elif cfg.solve_backend == "gather_fused":
         # forced DMA-gather NE build; the solve still walks the probe
         # order (the kernel writes A/b, the solve stays on lanes/xla).
@@ -278,10 +295,12 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     out = jnp.zeros((num_rows, r), dtype=jnp.float32)
 
     if cfg.solve_backend not in ("auto", "unfused", "gather_fused",
-                                 "gather_fused_solve"):
+                                 "gather_fused_solve",
+                                 "gather_fused_ring"):
         raise ValueError(
             f"unknown solve_backend {cfg.solve_backend!r} (expected "
-            "'auto', 'unfused', 'gather_fused' or 'gather_fused_solve')")
+            "'auto', 'unfused', 'gather_fused', 'gather_fused_solve' or "
+            "'gather_fused_ring')")
     resolved = resolve_solve_path(cfg, r)
     # DMA-gather fused NE build (ops.pallas_gather_ne): the factor rows
     # stream HBM→VMEM inside the kernel, so the Vg = V_comp[c] gather
@@ -291,7 +310,12 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
     # Cholesky solve also run in-kernel, so A/b never exist in HBM.
     # Off-TPU the kernels run in interpret mode (CPU tier-1 exercises
     # them).
-    gsolve = resolved["resolved_solve_path"] == "gatherfused_solve"
+    # 'gatherfused_ring' on this LOCAL path is the S=1 degenerate ring —
+    # the same whole-iteration kernel body, bitwise — so it shares the
+    # gsolve dispatch (the in-kernel rotation only exists under the ring
+    # strategies' shard_map; comm.ring_fused_half_step owns that case)
+    gsolve = resolved["resolved_solve_path"] in ("gatherfused_solve",
+                                                 "gatherfused_ring")
     gather = resolved["resolved_solve_path"].startswith("gatherfused+")
     gather_interpret = not resolved["on_tpu"]
     cg = (cfg.cg_iters > 0 and not cfg.nonnegative

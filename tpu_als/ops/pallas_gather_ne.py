@@ -63,12 +63,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_als.ops import ring_buffer as rb
 from tpu_als.ops.solve import DEFAULT_JITTER, implicit_weights
 
-# outstanding-DMA ring depth: row copies are small (r·db bytes, 512 B at
-# rank 128 f32), so several must be in flight to hide per-descriptor
-# latency; 8 is comfortably below the DMA queue depth
-_DMA_SLOTS = 8
+# ring depth comes from the shared substrate (ops.ring_buffer) — kept as a
+# module alias because the kernels' semaphore-ring scratch shapes and the
+# ring_substrate contract both reference it
+_DMA_SLOTS = rb.DMA_SLOTS
 
 
 def _gather_gram_kernel(cols_ref, aw_ref, bw_ref, V_hbm, A_ref, b_ref,
@@ -98,25 +99,12 @@ def _gather_gram_kernel(cols_ref, aw_ref, bw_ref, V_hbm, A_ref, b_ref,
     def _copy(e, slot):
         t = e // wc
         k = e % wc
-        return pltpu.make_async_copy(
+        return rb.local_copy(
             V_hbm.at[cols_ref[t, k]], Vg.at[t, k], sem.at[slot])
 
-    # prime the ring, then wait entry e / start entry e+DEPTH into the
-    # slot e just vacated — the standard multiple-buffering schedule
-    depth = min(_DMA_SLOTS, n_e)
-    for s in range(depth):
-        _copy(s, s).start()
-
-    def _pump(e, carry):
-        _copy(e, e % depth).wait()
-
-        @pl.when(e + depth < n_e)
-        def _next():
-            _copy(e + depth, e % depth).start()
-
-        return carry
-
-    jax.lax.fori_loop(0, n_e, _pump, 0)
+    # the substrate's multiple-buffering schedule: prime the ring, then
+    # wait entry e / start entry e+depth into the slot e just vacated
+    rb.pump(n_e, _copy)
 
     Vg_t = Vg[:]
     aw = aw_ref[:]
@@ -225,7 +213,7 @@ def gather_gram(V, cols, aw, bw, *, two_sided, interpret=False):
             pltpu.VMEM((tn, wc, r_pad), V.dtype),
             pltpu.VMEM((tn, r_pad, r_pad), jnp.float32),
             pltpu.VMEM((tn, r_pad), jnp.float32),
-            pltpu.SemaphoreType.DMA((min(_DMA_SLOTS, tn * wc),)),
+            pltpu.SemaphoreType.DMA((rb.dma_slots(tn * wc),)),
         ],
         # bytes = THE roofline fused-stage model (perf.roofline) at the
         # kernel's padded shapes — tests/test_ne_audit.py extracts this
@@ -313,23 +301,10 @@ def _gather_solve_kernel(cols_ref, aw_ref, bw_ref, cw_ref, YtY_ref, V_hbm,
     def _copy(e, slot):
         t = e // wc
         k = e % wc
-        return pltpu.make_async_copy(
+        return rb.local_copy(
             V_hbm.at[cols_ref[t, k]], Vg.at[t, k], sem.at[slot])
 
-    depth = min(_DMA_SLOTS, n_e)
-    for s in range(depth):
-        _copy(s, s).start()
-
-    def _pump(e, carry):
-        _copy(e, e % depth).wait()
-
-        @pl.when(e + depth < n_e)
-        def _next():
-            _copy(e + depth, e % depth).start()
-
-        return carry
-
-    jax.lax.fori_loop(0, n_e, _pump, 0)
+    rb.pump(n_e, _copy)
 
     Vg_t = Vg[:]
     aw = aw_ref[:]
@@ -458,7 +433,7 @@ def gather_solve(V, cols, aw, bw, cw, YtY=None, *, two_sided, reg,
             pltpu.VMEM((tn, r_pad, r_pad), jnp.float32),
             pltpu.VMEM((tn, r_pad), jnp.float32),
             pltpu.VMEM((tn, r_pad), jnp.float32),
-            pltpu.SemaphoreType.DMA((min(_DMA_SLOTS, tn * wc),)),
+            pltpu.SemaphoreType.DMA((rb.dma_slots(tn * wc),)),
         ],
         # bytes = THE roofline fused-solve model (perf.roofline) at the
         # kernel's padded shapes — the fused_solve_audit contract
@@ -501,6 +476,344 @@ def gather_fused_solve_implicit(V, cols, vals, mask, reg, alpha, YtY, *,
     cw = pref * mask
     return gather_solve(V, cols, aw, bw, cw, YtY, two_sided=False,
                         reg=float(reg), jitter=jitter, interpret=interpret)
+
+
+# --------------------------------------------------------------------------
+# Fused-comm ring: the whole-iteration kernel UNDER shard_map, with the
+# inter-chip factor rotation moved INSIDE the kernel as a
+# make_async_remote_copy ring (solve_backend="gather_fused_ring").
+# --------------------------------------------------------------------------
+
+# collective_id for the ring kernel's barrier semaphore (compiled path
+# only); any process-unique small int works — it namespaces the barrier
+# across distinct collective kernels, and this repo has exactly one
+_RING_COLLECTIVE_ID = 7
+
+
+def _gather_solve_ring_kernel(cols_ref, aw_ref, bw_ref, cw_ref, YtY_ref,
+                              V_hbm, x_ref, buf0, buf1, Vg, S, LT, bacc,
+                              cnt, sem, send_sem, recv_sem, ack_sem, *,
+                              axis_name, n_shards, n_wc, two_sided, panel,
+                              reg, jitter, sync):
+    """One (row-tile, ring-step, width-chunk) grid cell of the fused-comm
+    half-step.  Grid dims ``(i, t, j)``: per row tile ``i``, ring step
+    ``t`` streams source shard ``(me - t) % S`` — held in ``V_hbm`` at
+    ``t == 0`` and in the substrate's two HBM landing buffers
+    ``buf0``/``buf1`` (parity ``t % 2``) afterwards — while the remote
+    copy forwarding the held shard to the RIGHT neighbor is in flight
+    under the same gather/Gram front end as :func:`_gather_solve_kernel`.
+    The weight blocks arrive pre-rotated by the wrapper (leading axis
+    ``t`` indexes the shard held at step ``t``), so the accumulation is
+    just the fused-solve kernel's, once per shard; the ridge/YtY/
+    empty-guard tail and the blocked Cholesky solve run at the last
+    ``(t, j)`` cell exactly as in the single-device kernel — at
+    ``n_shards == 1`` the ring degenerates to :func:`_gather_solve_kernel`
+    bitwise (no sends trace at all).
+
+    ``sync`` (compiled path only — interpret mode emulates devices
+    sequentially, so it validates the schedule and the numerics but NOT
+    race-freedom, and remote ``semaphore_signal`` is not implemented by
+    the interpreter): two extra arms close the two real-hardware races of
+    a 2-buffer ring —
+
+    * **ack backpressure**: my step-``t`` send lands in the right
+      neighbor's ``buf[t % 2]``, which that neighbor reads as ``cur`` at
+      step ``t - 1``; a sender running one step ahead would clobber it.
+      After consuming ``cur(t)`` each receiver signals its LEFT
+      neighbor's ``ack_sem`` (steps ``t <= S - 3`` — one ack per gated
+      send), and every send at ``t >= 1`` waits one ack first.
+    * **pass barrier**: row tile ``i + 1`` restarts the ring at ``t = 0``
+      targeting ``buf0`` while a slower neighbor may still be reading its
+      pass-``i`` buffers; each pass opens with a neighbor barrier on the
+      ``collective_id``-scoped barrier semaphore.
+    """
+    t = pl.program_id(1)
+    j = pl.program_id(2)
+    _, tn, wc = cols_ref.shape
+    r = S.shape[-1]
+    n_e = tn * wc
+
+    if n_shards > 1:
+        me = jax.lax.axis_index(axis_name)
+        right = jax.lax.rem(me + 1, n_shards)
+        left = jax.lax.rem(me + n_shards - 1, n_shards)
+        odd = jax.lax.rem(t, 2) == 1
+
+        if sync:
+            @pl.when((t == 0) & (j == 0))
+            def _pass_barrier():
+                bar = pltpu.get_barrier_semaphore()
+                pltpu.semaphore_signal(
+                    bar, 1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                pltpu.semaphore_signal(
+                    bar, 1, device_id=right,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                pltpu.semaphore_wait(bar, 2)
+
+            @pl.when((t >= 1) & (t <= n_shards - 2) & (j == 0))
+            def _ack_gate():
+                pltpu.semaphore_wait(ack_sem, 1)
+
+        # forward cur(t) to the right neighbor's landing buffer for step
+        # t+1 (parity (t+1)%2 == destination buf[t%2]... the dst of step
+        # t's send IS what the neighbor reads as cur(t+1)); three static
+        # source variants because cur(t) is V_hbm / buf0 / buf1
+        @pl.when((t == 0) & (j == 0))
+        def _send_home():
+            rb.remote_copy(V_hbm, buf0, send_sem, recv_sem, right).start()
+
+        @pl.when((t >= 1) & (t <= n_shards - 2) & odd & (j == 0))
+        def _send_odd():
+            rb.remote_copy(buf0, buf1, send_sem, recv_sem, right).start()
+
+        @pl.when((t >= 1) & (t <= n_shards - 2) & ~odd & (j == 0))
+        def _send_even():
+            rb.remote_copy(buf1, buf0, send_sem, recv_sem, right).start()
+
+    @pl.when((t == 0) & (j == 0))
+    def _init():
+        S[:] = jnp.zeros_like(S)
+        bacc[:] = jnp.zeros_like(bacc)
+        cnt[:] = jnp.zeros_like(cnt)
+
+    def _gather_from(src):
+        def _copy(e, slot):
+            tt = e // wc
+            k = e % wc
+            return rb.local_copy(
+                src.at[cols_ref[0, tt, k]], Vg.at[tt, k], sem.at[slot])
+
+        rb.pump(n_e, _copy)
+
+    if n_shards == 1:
+        _gather_from(V_hbm)
+    else:
+        @pl.when(t == 0)
+        def _g_home():
+            _gather_from(V_hbm)
+
+        @pl.when((t >= 1) & odd)
+        def _g_odd():
+            _gather_from(buf0)
+
+        @pl.when((t >= 1) & ~odd)
+        def _g_even():
+            _gather_from(buf1)
+
+    Vg_t = Vg[:]
+    aw = aw_ref[0]
+    Vw = Vg_t * aw[..., None]
+    S[:] = S[:] + jax.lax.dot_general(
+        Vw, Vw if two_sided else Vg_t,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    bacc[:] = bacc[:] + jax.lax.dot_general(
+        bw_ref[0], Vg_t,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    cnt[:] = cnt[:] + jnp.sum(
+        cw_ref[0], axis=1).astype(jnp.float32)[:, None]  # lane-uniform
+
+    if n_shards > 1:
+        @pl.when((t <= n_shards - 2) & (j == n_wc - 1))
+        def _drain():
+            # retire my send and the incoming shard (recv_sem is signaled
+            # by the LEFT neighbor's symmetric send) before step t+1
+            # reads the landing buffer; all variants share one shape, so
+            # one canonical descriptor waits both semaphores
+            d = rb.remote_copy(buf0, buf1, send_sem, recv_sem, right)
+            d.wait_send()
+            d.wait_recv()
+
+        if sync:
+            @pl.when((t <= n_shards - 3) & (j == n_wc - 1))
+            def _ack_left():
+                # cur(t) fully consumed (the last width chunk's pump has
+                # retired) — free the left neighbor's next gated send
+                pltpu.semaphore_signal(
+                    ack_sem, 1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    @pl.when((t == n_shards - 1) & (j == n_wc - 1))
+    def _solve():
+        from tpu_als.ops.pallas_solve import factorize, substitute
+
+        ii = jax.lax.broadcasted_iota(jnp.int32, (tn, r, r), 1)
+        kk = jax.lax.broadcasted_iota(jnp.int32, (tn, r, r), 2)
+        diag = ii == kk
+        c3 = cnt[:][:, None, :]                       # [TN, 1, r] broadcast
+        # same explicit weight-dtype rounding as _gather_solve_kernel —
+        # see the comment there (bitwise ridge parity with the reference
+        # builders at bf16)
+        fi = jnp.finfo(cw_ref.dtype)
+        reg_w = jnp.asarray(reg, cw_ref.dtype).astype(jnp.float32)
+        ridge = jax.lax.reduce_precision(
+            jax.lax.reduce_precision(c3, fi.nexp, fi.nmant) * reg_w,
+            fi.nexp, fi.nmant)
+        A = S[:] + YtY_ref[:][None].astype(jnp.float32)
+        A = jnp.where(diag, A + ridge + jitter, A)
+        A = jnp.where(c3 <= 0.0, jnp.where(diag, 1.0 + jitter, 0.0), A)
+        S[:] = A
+        factorize(S, LT, tn=tn, r=r, panel=panel)
+        x_ref[:] = substitute(LT, bacc[:], tn=tn, r=r, panel=panel)
+
+
+def gather_solve_ring(V_shard, cols, aw, bw, cw, YtY=None, *, two_sided,
+                      reg, axis_name=None, jitter=DEFAULT_JITTER, panel=16,
+                      interpret=False):
+    """Fused-comm half-step core (inside ``shard_map``): one kernel call
+    per bucket runs the WHOLE distributed iteration — the inter-chip ring
+    rotation (``make_async_remote_copy``), the DMA row gather, the Gram
+    accumulation across all ``S`` source shards, and the ridge/YtY/solve
+    tail — overlapped on the substrate's shared double buffers.  Returns
+    ``x [n, r]`` f32; neither the rotated shards (beyond the two ``[per,
+    r]`` HBM landing buffers) nor A/b ever exist as XLA values.
+
+    V_shard [per, r]: THIS device's shard of the opposite factors (compute
+    dtype).  cols/aw/bw/cw [S, n, w]: the RingCsr bucket's shard-local
+    column ids and weights, source-shard-major and UNROTATED — the wrapper
+    rotates the leading axis by ``(me - t) % S`` so block ``t`` always
+    weighs the shard held at ring step ``t``.  ``axis_name`` names the
+    mesh axis (required when ``S > 1``).
+
+    Off-TPU pass ``interpret=True`` (the forced-host-device CPU mesh):
+    numerics and schedule are exercised, the hardware-race arms (ack
+    backpressure + pass barrier, see the kernel docstring) compile only
+    on real meshes.
+    """
+    per, r = V_shard.shape
+    n_shards, n, w = cols.shape
+    r_pad = max(128, -(-r // 128) * 128)
+    if r_pad % panel:
+        raise ValueError(f"panel {panel} must divide padded rank {r_pad}")
+    tn, wc, w_pad = _tiles_solve(r_pad, -(-w // 8) * 8, panel=panel)
+    assert wc == w_pad or (wc % 128 == 0 and w_pad % wc == 0), (wc, w_pad)
+    n_pad = -(-n // tn) * tn
+    V_p = jnp.pad(V_shard, ((0, 0), (0, r_pad - r)))
+
+    if n_shards > 1:
+        if axis_name is None:
+            raise ValueError("axis_name is required when n_shards > 1")
+        me = jax.lax.axis_index(axis_name)
+        src_order = jnp.mod(
+            me - jnp.arange(n_shards, dtype=jnp.int32), n_shards)
+
+        def _rot(x):
+            return jnp.take(x, src_order, axis=0)
+    else:
+        def _rot(x):
+            return x
+
+    def _prep(x):
+        # padding slots index row 0 with zero weight; padded batch rows
+        # have count 0 and hit the empty-row guard (x = 0) — the
+        # gather_solve contract
+        return jnp.pad(_rot(x), ((0, 0), (0, n_pad - n), (0, w_pad - w)))
+
+    cols_p = _prep(cols.astype(jnp.int32))
+    aw_p = _prep(aw)
+    bw_p = _prep(bw)
+    cw_p = _prep(cw)
+    YtY_p = (jnp.zeros((r_pad, r_pad), jnp.float32) if YtY is None
+             else jnp.pad(YtY.astype(jnp.float32),
+                          ((0, r_pad - r), (0, r_pad - r))))
+    n_wc = w_pad // wc
+    n_rt = n_pad // tn
+
+    from tpu_als.perf.roofline import fused_ring_kernel_bytes, \
+        ring_remote_bytes
+
+    db = jnp.dtype(V_shard.dtype).itemsize
+    sync = not interpret and n_shards > 1
+    kernel = functools.partial(
+        _gather_solve_ring_kernel, axis_name=axis_name, n_shards=n_shards,
+        n_wc=n_wc, two_sided=two_sided, panel=panel, reg=float(reg),
+        jitter=float(jitter), sync=sync)
+    x = pl.pallas_call(
+        kernel,
+        grid=(n_rt, n_shards, n_wc),
+        in_specs=[
+            pl.BlockSpec((1, tn, wc), lambda i, t, j: (t, i, j),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, tn, wc), lambda i, t, j: (t, i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tn, wc), lambda i, t, j: (t, i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tn, wc), lambda i, t, j: (t, i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((r_pad, r_pad), lambda i, t, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((tn, r_pad), lambda i, t, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pad, r_pad), jnp.float32),
+        scratch_shapes=[
+            pltpu.ANY((per, r_pad), V_shard.dtype),   # buf0 (HBM landing)
+            pltpu.ANY((per, r_pad), V_shard.dtype),   # buf1
+            pltpu.VMEM((tn, wc, r_pad), V_shard.dtype),
+            pltpu.VMEM((tn, r_pad, r_pad), jnp.float32),
+            pltpu.VMEM((tn, r_pad, r_pad), jnp.float32),
+            pltpu.VMEM((tn, r_pad), jnp.float32),
+            pltpu.VMEM((tn, r_pad), jnp.float32),
+            pltpu.SemaphoreType.DMA((rb.dma_slots(tn * wc),)),
+            pltpu.SemaphoreType.DMA,      # send
+            pltpu.SemaphoreType.DMA,      # recv
+            pltpu.SemaphoreType.REGULAR,  # ack (sync arm only)
+        ],
+        # bytes = THE roofline fused-comm model (perf.roofline): the
+        # fused-solve stream plus the in-kernel remote-DMA ring payload —
+        # the extended comm_audit contract (analysis/contracts.py)
+        # extracts both from the traced kernel and pins them to the
+        # closed forms
+        cost_estimate=pl.CostEstimate(
+            flops=int(2.0 * n_pad * n_shards * w_pad * r_pad * (r_pad + 1)
+                      + n_pad * (r_pad ** 3 / 3 + 2 * r_pad ** 2)),
+            bytes_accessed=fused_ring_kernel_bytes(
+                n_pad * n_shards * w_pad, n_pad, r_pad, db,
+                ring_remote_bytes(n_rt, n_shards, per, r_pad, db)),
+            transcendentals=n_pad * r_pad,
+        ),
+        compiler_params=(
+            pltpu.TPUCompilerParams(collective_id=_RING_COLLECTIVE_ID)
+            if sync else None),
+        interpret=interpret,
+    )(cols_p, aw_p, bw_p, cw_p, YtY_p, V_p)
+    return x[:n, :r]
+
+
+def gather_fused_ring_explicit(V_shard, cols, vals, mask, reg, *,
+                               axis_name=None, jitter=DEFAULT_JITTER,
+                               interpret=False):
+    """Fused-comm drop-in for one explicit ring half-step: the reference
+    builders' exact weight expressions over the UNROTATED [S, n, w] bucket
+    arrays, then one :func:`gather_solve_ring` call.  At ``S == 1`` this
+    is :func:`gather_fused_solve_explicit` bitwise (same kernel body, no
+    sends)."""
+    aw = mask
+    bw = vals * mask
+    cw = mask
+    return gather_solve_ring(V_shard, cols, aw, bw, cw, two_sided=True,
+                             reg=float(reg), axis_name=axis_name,
+                             jitter=jitter, interpret=interpret)
+
+
+def gather_fused_ring_implicit(V_shard, cols, vals, mask, reg, alpha, YtY,
+                               *, axis_name=None, jitter=DEFAULT_JITTER,
+                               interpret=False):
+    """Fused-comm drop-in for one implicit ring half-step — weights from
+    the shared :func:`implicit_weights`, YtY + weighted-λ tail in-kernel."""
+    conf_m1, pref = implicit_weights(vals, mask, alpha)
+    aw = conf_m1
+    bw = (1.0 + conf_m1) * pref * mask
+    cw = pref * mask
+    return gather_solve_ring(V_shard, cols, aw, bw, cw, YtY,
+                             two_sided=False, reg=float(reg),
+                             axis_name=axis_name, jitter=jitter,
+                             interpret=interpret)
 
 
 from tpu_als.utils.platform import probe_cache as _probe_cache
@@ -731,3 +1044,92 @@ def solve_faster_than_unfused(rank=128, compute_dtype="float32", n=2048,
         return best(fused) < best(unfused)
 
     return probe_kernel(_SOLVE_FASTER, ("speed", r_pad, cdt, n, w), probe)
+
+
+_RING_AVAILABLE = _probe_cache("pallas_gather_ring")
+
+
+def ring_available(rank=128, compute_dtype="float32", n_shards=None):
+    """Compile-and-validate probe for the fused-comm ring kernel ON THE
+    LIVE MESH, cached per (padded rank, dtype, n_shards) — the gate
+    ``trainer.make_ring_step`` consults before adopting
+    ``solve_backend='gather_fused_ring'`` on hardware.
+
+    Unlike the single-device probes this one executes a COLLECTIVE (the
+    in-kernel remote-DMA ring under ``shard_map`` over the first
+    ``n_shards`` local devices), so its verdict is only meaningful for
+    the mesh it ran on — the cache key carries ``n_shards``, and the
+    planner's persistence layer (utils.platform.snapshot_probes) may bank
+    it like any other probe because the CONSUMER re-validates shape: a
+    banked verdict for a different shard count is a cache miss, never a
+    steer.  Validates explicit AND implicit variants against the
+    single-device whole-iteration kernel on the concatenated global
+    column space.  Off-TPU → False (the CPU path doesn't need it: the
+    interpret-mode kernel is dispatched unconditionally there).
+    """
+    from tpu_als.utils.platform import probe_kernel
+
+    if n_shards is None:
+        n_shards = jax.device_count()
+    r_pad = max(128, -(-rank // 128) * 128)
+    cdt = str(compute_dtype)
+
+    def probe():
+        import functools as ft
+
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from tpu_als.parallel.mesh import shard_map
+
+        if jax.device_count() < n_shards:
+            return False
+        S = n_shards
+        ax = "ring_probe"
+        mesh = Mesh(np.array(jax.devices()[:S]), (ax,))
+        dt = jnp.dtype(cdt)
+        rng = np.random.default_rng(0)
+        tn, _, _ = _tiles_solve(r_pad, 16)
+        per, n, w = 64, tn + 8, 16  # ragged: one partial kernel row tile
+        V = jnp.asarray(rng.normal(size=(S * per, rank))
+                        .astype(np.float32) / np.sqrt(rank)).astype(dt)
+        cols = rng.integers(0, per, size=(S, S, n, w)).astype(np.int32)
+        vals = rng.normal(size=(S, S, n, w)).astype(np.float32)
+        mask = (rng.random(size=(S, S, n, w)) < 0.8).astype(np.float32)
+        YtY = np.asarray(V.astype(jnp.float32).T @ V.astype(jnp.float32))
+
+        @jax.jit
+        @ft.partial(shard_map, mesh=mesh,
+                    in_specs=(P(ax), P(ax), P(ax), P(ax), P()),
+                    out_specs=(P(ax), P(ax)), check_vma=False)
+        def run(V_shard, c, v, m, yty):
+            xe = gather_fused_ring_explicit(
+                V_shard, c[0], v[0].astype(dt), m[0].astype(dt), 0.1,
+                axis_name=ax)
+            xi = gather_fused_ring_implicit(
+                V_shard, c[0], v[0].astype(dt), m[0].astype(dt), 0.1,
+                4.0, yty, axis_name=ax)
+            return xe[None], xi[None]
+
+        xe, xi = run(V, jnp.asarray(cols), jnp.asarray(vals),
+                     jnp.asarray(mask), jnp.asarray(YtY))
+        xe.block_until_ready()
+        xe, xi = np.asarray(xe), np.asarray(xi)
+        tol = dict(atol=1e-3, rtol=1e-2)
+        for d in range(S):
+            gc = np.concatenate([cols[d, s] + s * per for s in range(S)],
+                                axis=1)
+            gv = np.concatenate([vals[d, s] for s in range(S)], axis=1)
+            gm = np.concatenate([mask[d, s] for s in range(S)], axis=1)
+            re_ = gather_fused_solve_explicit(
+                V, jnp.asarray(gc), jnp.asarray(gv).astype(dt),
+                jnp.asarray(gm).astype(dt), 0.1)
+            ri = gather_fused_solve_implicit(
+                V, jnp.asarray(gc), jnp.asarray(gv).astype(dt),
+                jnp.asarray(gm).astype(dt), 0.1, 4.0, jnp.asarray(YtY))
+            if not (np.allclose(xe[d], np.asarray(re_), **tol)
+                    and np.allclose(xi[d], np.asarray(ri), **tol)):
+                return False
+        return True
+
+    return probe_kernel(_RING_AVAILABLE, (r_pad, cdt, n_shards), probe)

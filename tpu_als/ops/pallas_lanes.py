@@ -52,6 +52,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_als.ops.ring_buffer import local_copy
+
 LANES = 128
 
 # MXU contractions inside the factorization run at HIGHEST precision: the
@@ -92,7 +94,7 @@ def _chol_lanes_kernel(A_ref, b_ref, x_ref, S, Pn, sem, *, r, panel, mxu):
     for) is unchanged.
     """
     g = pl.program_id(0)
-    cp = pltpu.make_async_copy(A_ref.at[g], S, sem)
+    cp = local_copy(A_ref.at[g], S, sem)
     cp.start()
     cp.wait()
     sub = jax.lax.broadcasted_iota(jnp.int32, (r, LANES), 0)  # row index b

@@ -50,6 +50,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_als.ops.ring_buffer import local_copy
+
 LANES = 128
 BLOCK = 128
 PANEL = 8
@@ -74,7 +76,7 @@ def _chol_blocked_kernel(A_ref, out_ref, W, Bs, Cs, sem, *, nb, panel, mxu):
     sub = jax.lax.broadcasted_iota(jnp.int32, (B, LANES), 0)
 
     def dma(src, dst):
-        cp = pltpu.make_async_copy(src, dst, sem)
+        cp = local_copy(src, dst, sem)
         cp.start()
         cp.wait()
 

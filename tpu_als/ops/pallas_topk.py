@@ -13,6 +13,15 @@ each [TU, TI] score tile on the MXU, and merges it in-register with k rounds
 of vectorized argmax-extraction on the VPU.  Scores never touch HBM; HBM
 traffic drops to the factor matrices themselves plus the [users, k] result.
 
+The item factor table stays HBM-resident (``memory_space=ANY``) and its
+tiles stream into a 2-slot VMEM ring via the shared double-buffer substrate
+(:mod:`tpu_als.ops.ring_buffer`): :func:`ring_buffer.grid_pump` waits tile
+``j`` and puts tile ``j+1``'s DMA in flight under tile ``j``'s GEMM+merge —
+the same slot/semaphore discipline as ``pallas_gather_ne``'s row gather,
+stated once.  (Under BlockSpec auto-pipelining the compiler ran an
+equivalent schedule; owning the copy makes the kernel's HBM stream explicit
+and substrate-audited — bytes and numerics are unchanged.)
+
 Replaces the reference stack's ``recommendForAll`` (blockify + crossJoin +
 per-block GEMM + BoundedPriorityQueue merge across a shuffle,
 ``mllib/.../recommendation/MatrixFactorizationModel.scala`` — SURVEY.md §3.3).
@@ -27,20 +36,25 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_als.ops import ring_buffer as rb
+
 NEG_INF = -3.4e38
 
 # lane width: the merge buffer reserves one lane-tile for the carried best-k
 LANES = 128
 
 
-def _topk_kernel(U_ref, V_ref, valid_ref, out_s_ref, out_i_ref, *, k, tile_i):
+def _topk_kernel(U_ref, V_hbm, valid_ref, out_s_ref, out_i_ref, Vt, sem,
+                 *, k, tile_i, n_ti):
     """One (user-tile, item-tile) grid cell.
 
     U_ref   [TU, r]      resident user factor tile
-    V_ref   [TI, r]      this step's item factor tile
+    V_hbm   [Ni, r]      the HBM-resident item factor table (``ANY``)
     valid_ref [1, TI]    1.0 = rankable item, 0.0 = padding/cold
     out_s/out_i [TU, LANES]  running best (revisited across the item grid
                          dim; only the first k lanes are meaningful)
+    Vt [2, TI, r] / sem: the substrate's 2-slot item-tile ring — slot
+    ``j%2`` holds this step's tile while ``j+1``'s DMA is in flight.
     """
     j = pl.program_id(1)
 
@@ -49,10 +63,16 @@ def _topk_kernel(U_ref, V_ref, valid_ref, out_s_ref, out_i_ref, *, k, tile_i):
         out_s_ref[:] = jnp.full_like(out_s_ref, NEG_INF)
         out_i_ref[:] = jnp.zeros_like(out_i_ref)
 
+    def _copy(e, slot):
+        return rb.local_copy(
+            V_hbm.at[pl.ds(e * tile_i, tile_i)], Vt.at[slot], sem.at[slot])
+
+    rb.grid_pump(j, n_ti, _copy)
+
     tu = U_ref.shape[0]
-    # [TU, TI] score tile on the MXU
+    # [TU, TI] score tile on the MXU, streamed from the slot just waited
     scores = jax.lax.dot_general(
-        U_ref[:], V_ref[:],
+        U_ref[:], Vt[jax.lax.rem(j, 2)],
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
@@ -118,15 +138,15 @@ def topk_scores_pallas(U, V, item_valid, k, tile_u=256, tile_i=512,
     ).reshape(1, i_pad)
 
     grid = (n_pad // tile_u, i_pad // tile_i)
-    kernel = functools.partial(_topk_kernel, k=k, tile_i=tile_i)
+    kernel = functools.partial(_topk_kernel, k=k, tile_i=tile_i,
+                               n_ti=i_pad // tile_i)
     out_s, out_i = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((tile_u, r_pad), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile_i, r_pad), lambda i, j: (j, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec((1, tile_i), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ],
@@ -139,6 +159,10 @@ def topk_scores_pallas(U, V, item_valid, k, tile_u=256, tile_i=512,
         out_shape=[
             jax.ShapeDtypeStruct((n_pad, LANES), jnp.float32),
             jax.ShapeDtypeStruct((n_pad, LANES), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, tile_i, r_pad), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * n_pad * i_pad * r_pad,

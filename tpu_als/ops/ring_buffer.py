@@ -1,0 +1,166 @@
+"""The ONE double-buffer ring substrate: slots, semaphore discipline, copies.
+
+Three hand-rolled double-buffer schedules grew up independently — the
+HBM→VMEM ``make_async_copy`` ring inside ``ops.pallas_gather_ne``'s kernels,
+the ppermute-under-einsum rotation in ``parallel.comm.ring_half_step`` and
+the block-gather prefetch in ``parallel.comm.chunked_gather_half_step`` —
+each re-stating the same discipline: a fixed ring of slots, *start* entry
+``e+depth`` into the slot entry ``e`` just vacated, *wait* before reading.
+This module is that discipline stated once, at both levels where it occurs:
+
+**In-kernel (Pallas)** — descriptors + pumps over DMA semaphore rings:
+
+- :func:`local_copy` / :func:`remote_copy`: the two copy descriptors.  A
+  slot's copy is *local* (HBM→VMEM ``make_async_copy``, one DMA semaphore)
+  or *remote* (inter-chip ``make_async_remote_copy``, send/recv semaphore
+  pair, ``LOGICAL`` device ids — the form that lowers on hardware meshes
+  AND emulates under ``interpret=True`` on forced-host-device CPU meshes;
+  ``MESH`` tuple ids do not interpret on jax 0.4.37).
+- :func:`pump`: the multiple-buffering schedule inside one grid step
+  (``ops.pallas_gather_ne``'s row-gather front end, the remote tile stream
+  of the fused-comm ring kernel).
+- :func:`grid_pump`: the same schedule unrolled *across* grid steps, for
+  kernels whose natural chunk is one grid iteration (``ops.pallas_topk``
+  streams one item tile per step).
+
+**XLA-level (inside shard_map)** — the identical start/consume/wait shape
+with collectives as the "DMA":
+
+- :func:`rotate_stream`: ring rotation (``ppermute``) with the optional
+  one-in-flight overlap slot — ``ring_half_step``'s schedule.
+- :func:`prefetch_stream`: indexed fetches (``all_gather`` of block ``c``)
+  with the next fetch issued under the current consume —
+  ``chunked_gather_half_step``'s schedule.
+
+The ``ring_substrate`` contract (analysis/contracts.py) pins that routing
+``pallas_gather_ne`` through :func:`pump` emits a **byte-identical** jaxpr
+to the pre-extraction hand-rolled loop, and that no private
+``make_async_copy`` / ``make_async_remote_copy`` call sites survive outside
+this module.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# outstanding-DMA ring depth: row copies are small (r·db bytes, 512 B at
+# rank 128 f32), so several must be in flight to hide per-descriptor
+# latency; 8 is comfortably below the DMA queue depth
+DMA_SLOTS = 8
+
+
+def dma_slots(n_entries):
+    """Slot-ring depth for a pump over ``n_entries`` copies (never more
+    slots than entries — each primed slot must map to a distinct entry)."""
+    return min(DMA_SLOTS, n_entries)
+
+
+def local_copy(src, dst, sem):
+    """Local async-DMA descriptor (HBM↔VMEM): start/wait via ``sem``."""
+    return pltpu.make_async_copy(src, dst, sem)
+
+
+def remote_copy(src, dst, send_sem, recv_sem, device_id):
+    """Inter-device RDMA descriptor: ``src`` here → ``dst`` on the logical
+    device ``device_id``; symmetric SPMD rings wait their own incoming via
+    ``.wait_recv()`` on the same descriptor (``dst`` names the local
+    landing buffer, ``recv_sem`` is signaled by the neighbor's send).
+
+    ``LOGICAL`` scalar ids on purpose — see the module docstring.
+    """
+    return pltpu.make_async_remote_copy(
+        src_ref=src, dst_ref=dst, send_sem=send_sem, recv_sem=recv_sem,
+        device_id=device_id, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def pump(n_entries, make_copy, depth=None):
+    """The multiple-buffering schedule: prime ``depth`` copies, then wait
+    entry ``e`` / start entry ``e+depth`` into the slot ``e`` just vacated.
+
+    ``make_copy(entry, slot)`` returns a started-able descriptor
+    (:func:`local_copy` / :func:`remote_copy` over the caller's refs and
+    semaphore ring); callers read the landed data after pump returns (the
+    last ``depth`` waits retire in entry order).  The emitted op sequence
+    is EXACTLY the pre-extraction hand-rolled loop of
+    ``pallas_gather_ne`` — the ``ring_substrate`` contract pins the jaxpr
+    byte-for-byte, so think twice before "improving" this function.
+    """
+    if depth is None:
+        depth = dma_slots(n_entries)
+    for s in range(depth):
+        make_copy(s, s).start()
+
+    def _pump(e, carry):
+        make_copy(e, e % depth).wait()
+
+        @pl.when(e + depth < n_entries)
+        def _next():
+            make_copy(e + depth, e % depth).start()
+
+        return carry
+
+    jax.lax.fori_loop(0, n_entries, _pump, 0)
+
+
+def grid_pump(step, n_steps, make_copy, depth=2):
+    """:func:`pump` unrolled across a Pallas grid dimension: call once per
+    grid step with ``step = pl.program_id(dim)``; the chunk landed by the
+    previous step's start is waited here while ``step+1``'s copy is put in
+    flight under this step's compute.  Slots (and their semaphores) must
+    persist across steps, i.e. live in ``scratch_shapes``.
+
+    ``make_copy(entry, slot)`` as in :func:`pump`, but both arguments are
+    traced scalars (use ``.at[pl.ds(...)]`` descriptors).
+    """
+    @pl.when(step == 0)
+    def _prime():
+        make_copy(0, 0).start()
+
+    make_copy(step, jax.lax.rem(step, depth)).wait()
+
+    @pl.when(step + 1 < n_steps)
+    def _next():
+        make_copy(step + 1, jax.lax.rem(step + 1, depth)).start()
+
+
+def rotate_stream(n_steps, rotate, consume, buf, carry, overlap=False):
+    """XLA-level ring rotation (inside ``shard_map``): consume the held
+    buffer each step, rotate every step — after ``n_steps`` rotations the
+    buffer is home, so the next pass starts clean.
+
+    ``overlap=True`` is the one-in-flight slot: the rotation for step
+    ``t+1`` is issued *before* step ``t``'s consume, so XLA's latency-
+    hiding scheduler keeps one async collective-permute under the compute.
+    Bytes moved, rotation count and numerics are identical either way.
+
+    ``rotate(buf) -> buf'``; ``consume(t, buf, carry) -> carry``.
+    Returns ``(buf, carry)``.
+    """
+    for t in range(n_steps):
+        if overlap:
+            nxt = rotate(buf)
+            carry = consume(t, buf, carry)
+            buf = nxt
+        else:
+            carry = consume(t, buf, carry)
+            buf = rotate(buf)
+    return buf, carry
+
+
+def prefetch_stream(n_steps, fetch, consume, carry):
+    """XLA-level indexed prefetch (inside ``shard_map``): fetch block 0,
+    then each step issues block ``c+1``'s fetch *before* consuming block
+    ``c`` — one async fetch in flight under the compute, the chunked
+    all_gather schedule.
+
+    ``fetch(c) -> buf``; ``consume(c, buf, carry) -> carry``.
+    """
+    nxt = fetch(0)
+    for c in range(n_steps):
+        cur = nxt
+        if c + 1 < n_steps:
+            nxt = fetch(c + 1)
+        carry = consume(c, cur, carry)
+    return carry

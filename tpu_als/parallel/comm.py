@@ -50,6 +50,7 @@ from tpu_als.core.ratings import (
     scan_chunk,
     trainer_chunk,
 )
+from tpu_als.ops.ring_buffer import prefetch_stream, rotate_stream
 from tpu_als.ops.solve import solve_cg, solve_nnls, solve_spd
 from tpu_als.parallel.mesh import AXIS
 
@@ -192,8 +193,53 @@ def shard_csr_grid(row_part, col_part, row_idx, col_idx, vals,
                    positions=tuple(pos_list) if local else None)
 
 
+def ring_fused_half_step(V_shard, ring_buckets, num_rows, n_shards, cfg,
+                         YtY=None, interpret=False):
+    """One half-step as ONE Pallas kernel call per bucket (inside
+    ``shard_map``): ``solve_backend='gather_fused_ring'`` moves the ring
+    rotation itself into the whole-iteration fused kernel — the factor
+    shard streams to the right neighbor via ``make_async_remote_copy``
+    INSIDE the kernel, tile-by-tile into the same HBM landing buffers
+    that feed the gather/Gram/solve panels, overlapped with the compute
+    (tpu_als.ops.pallas_gather_ne.gather_solve_ring).  No ``ppermute``
+    traces; no per-tile XLA loop (the kernel grid does the row tiling);
+    the per-row counts come from the in-kernel ``cw`` accumulation, so no
+    ``counts`` lookup either.  Off-TPU pass ``interpret=True`` — the
+    forced-host-device CPU mesh runs the identical schedule.
+
+    Solver precedence matches ``ring_half_step``'s tail (AlsConfig doc:
+    nonnegative > forced fused backends > cg): the CALLER routes
+    ``cfg.nonnegative`` to the XLA ring before dispatching here.
+    """
+    from tpu_als.ops.pallas_gather_ne import (
+        gather_fused_ring_explicit,
+        gather_fused_ring_implicit,
+    )
+
+    r = V_shard.shape[-1]
+    cdt = jnp.dtype(cfg.compute_dtype)
+    V_c = V_shard.astype(cdt)
+    out = jnp.zeros((num_rows, r), dtype=jnp.float32)
+    for b in ring_buckets:
+        with jax.named_scope("gather_fused_ring"):
+            if cfg.implicit_prefs:
+                x = gather_fused_ring_implicit(
+                    V_c, b.cols, b.vals.astype(cdt), b.mask.astype(cdt),
+                    cfg.reg_param, cfg.alpha, YtY.astype(jnp.float32),
+                    axis_name=AXIS, jitter=cfg.jitter,
+                    interpret=interpret)
+            else:
+                x = gather_fused_ring_explicit(
+                    V_c, b.cols, b.vals.astype(cdt), b.mask.astype(cdt),
+                    cfg.reg_param, axis_name=AXIS, jitter=cfg.jitter,
+                    interpret=interpret)
+        out = out.at[b.rows].set(x, mode="drop", unique_indices=True)
+    return out
+
+
 def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
-                   chunk_elems, YtY=None, prev=None, overlap=False):
+                   chunk_elems, YtY=None, prev=None, overlap=False,
+                   fused=False, interpret=False):
     """One half-step with streaming factor shards (inside ``shard_map``).
 
     V_shard [per_opposite, r]: this device's shard of the opposite factors.
@@ -220,7 +266,18 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
     traffic is modeled by the same ``comm_bytes_per_iter('ring', ...)``
     closed form and verified against the traced jaxpr in
     tests/test_comm_audit.py.
+
+    ``fused=True`` dispatches to :func:`ring_fused_half_step` — the
+    in-kernel remote-DMA ring (``solve_backend='gather_fused_ring'``) —
+    unless ``cfg.nonnegative`` demands the NNLS sweep tail, which has no
+    fused kernel (same precedence rule as the local path).  The caller
+    (``trainer.make_ring_step``) decides ``fused`` at build time from the
+    knob + availability probe; ``interpret`` follows ``not on_tpu()``.
     """
+    if fused and not cfg.nonnegative:
+        return ring_fused_half_step(V_shard, ring_buckets, num_rows,
+                                    n_shards, cfg, YtY=YtY,
+                                    interpret=interpret)
     r = V_shard.shape[-1]
     cdt = jnp.dtype(cfg.compute_dtype)
     me = jax.lax.axis_index(AXIS)
@@ -228,20 +285,24 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
     eye = jnp.eye(r, dtype=jnp.float32)
     out = jnp.zeros((num_rows, r), dtype=jnp.float32)
 
+    def rotate(V_c):
+        # the substrate's in-flight slot (overlap=True): rotate_stream
+        # issues this permute for shard t+1 BEFORE shard t's accumulate,
+        # so XLA's latency-hiding scheduler keeps one async
+        # collective-permute under the einsum (V_c stays readable, the
+        # permute result is the in-flight slot)
+        if overlap:
+            with jax.named_scope("ring_prefetch"):
+                return jax.lax.ppermute(V_c, AXIS, perm)
+        return jax.lax.ppermute(V_c, AXIS, perm)
+
     def tile_pass(V_c, rows, cols, vals, mask):
         """rows [tile]; cols/vals/mask [S, tile, w] -> (V_c, x [tile, r])"""
         tile = rows.shape[0]
-        A = jnp.zeros((tile, r, r), dtype=jnp.float32)
-        bb = jnp.zeros((tile, r), dtype=jnp.float32)
-        for t in range(n_shards):
+
+        def accumulate(t, V_c, carry):
+            A, bb = carry
             src = (me - t) % n_shards  # shard held after t rotations
-            if overlap:
-                # issue the rotation for shard t+1 NOW — the permute only
-                # reads V_c, so it runs concurrently with this shard's
-                # gather+einsum below (double buffer: V_c stays readable,
-                # V_next is the in-flight slot)
-                with jax.named_scope("ring_prefetch"):
-                    V_next = jax.lax.ppermute(V_c, AXIS, perm)
             with jax.named_scope("ring_gather"):
                 c = jax.lax.dynamic_index_in_dim(cols, src, 0, False)
                 v = jax.lax.dynamic_index_in_dim(vals, src, 0, False)
@@ -266,11 +327,14 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
                     bb = bb + jnp.einsum(
                         "nw,nwr->nr", (v * m).astype(cdt), Vg,
                         preferred_element_type=jnp.float32)
-            # rotate every step: after n_shards rotations the shard is home
-            if overlap:
-                V_c = V_next
-            else:
-                V_c = jax.lax.ppermute(V_c, AXIS, perm)
+            return A, bb
+
+        # rotate every step: after n_shards rotations the shard is home
+        V_c, (A, bb) = rotate_stream(
+            n_shards, rotate, accumulate, V_c,
+            (jnp.zeros((tile, r, r), dtype=jnp.float32),
+             jnp.zeros((tile, r), dtype=jnp.float32)),
+            overlap=overlap)
         # padding rows (rows == num_rows) read an arbitrary count; their
         # b is 0 so x solves to 0 and the scatter drops them anyway
         cnt = counts[jnp.clip(rows, 0, num_rows - 1)]
@@ -282,13 +346,14 @@ def ring_half_step(V_shard, ring_buckets, counts, num_rows, n_shards, cfg,
                 x = solve_nnls(A, bb, cnt, sweeps=cfg.nnls_sweeps,
                                jitter=cfg.jitter)
             elif (cfg.cg_iters > 0
-                  and cfg.solve_backend != "gather_fused_solve"):
+                  and cfg.solve_backend not in ("gather_fused_solve",
+                                                "gather_fused_ring")):
                 # same precedence as local_half_step (AlsConfig doc:
                 # nonnegative > forced fused backends > cg) so one config
-                # means one solver across every gatherStrategy; ring has
-                # no fused kernel (its A is accumulated across streamed
-                # shards), so the forced fusion degrades to the exact
-                # solve here
+                # means one solver across every gatherStrategy; when the
+                # forced fusion cannot run here (no availability probe
+                # pass — ``fused=False`` above) it degrades to the exact
+                # solve, never to cg
                 x0 = (prev[jnp.clip(rows, 0, num_rows - 1)]
                       if prev is not None else None)
                 x = solve_cg(A, bb, cnt, x0=x0, iters=cfg.cg_iters,
@@ -393,18 +458,13 @@ def chunked_gather_half_step(V_shard, buckets, num_rows, n_shards, cfg,
     def tile_pass(rows, cols, vals, mask):
         """rows [tile]; cols/vals/mask [tile, w] -> x [tile, r]"""
         tile = rows.shape[0]
-        A = jnp.zeros((tile, r, r), dtype=jnp.float32)
-        bb = jnp.zeros((tile, r), dtype=jnp.float32)
-        cnt = jnp.zeros((tile,), dtype=jnp.float32)
         d = cols // per
         loc = cols % per
         # ragged last block: every local row >= starts[-1] belongs to it
         blkid = jnp.minimum(loc // sub, C - 1)
-        G_next = gather_block(0)
-        for c in range(C):
-            G = G_next
-            if c + 1 < C:
-                G_next = gather_block(c + 1)  # in flight under this einsum
+
+        def accumulate(c, G, carry):
+            A, bb, cnt = carry
             m_c = mask * (blkid == c)
             # clip keeps masked-out entries' indices in bounds; their
             # contribution is zeroed by m_c
@@ -432,6 +492,15 @@ def chunked_gather_half_step(V_shard, buckets, num_rows, n_shards, cfg,
                         "nw,nwr->nr", (vals * m_c).astype(cdt), Vg,
                         preferred_element_type=jnp.float32)
                     cnt = cnt + m_c.sum(axis=-1)
+            return A, bb, cnt
+
+        # block c+1's all_gather goes in flight under block c's einsum —
+        # the substrate's indexed-prefetch schedule
+        A, bb, cnt = prefetch_stream(
+            C, gather_block, accumulate,
+            (jnp.zeros((tile, r, r), dtype=jnp.float32),
+             jnp.zeros((tile, r), dtype=jnp.float32),
+             jnp.zeros((tile,), dtype=jnp.float32)))
         A = A + (cfg.reg_param * cnt)[:, None, None] * eye
         if cfg.implicit_prefs:
             A = A + YtY[None]
@@ -440,7 +509,8 @@ def chunked_gather_half_step(V_shard, buckets, num_rows, n_shards, cfg,
                 x = solve_nnls(A, bb, cnt, sweeps=cfg.nnls_sweeps,
                                jitter=cfg.jitter)
             elif (cfg.cg_iters > 0
-                  and cfg.solve_backend != "gather_fused_solve"):
+                  and cfg.solve_backend not in ("gather_fused_solve",
+                                                "gather_fused_ring")):
                 x0 = (prev[jnp.clip(rows, 0, num_rows - 1)]
                       if prev is not None else None)
                 x = solve_cg(A, bb, cnt, x0=x0, iters=cfg.cg_iters,

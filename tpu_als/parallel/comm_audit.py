@@ -22,6 +22,14 @@ conventions per primitive mirror the model's documented ones
   totals count once; disagreeing branches raise (data-dependent traffic)
 - ``while``       → a collective in the body OR the predicate raises
   (unbounded trip count cannot be scaled)
+
+:func:`remote_dma_bytes` extends the audit to traffic NO collective
+primitive represents: the fused-comm ring kernel
+(``solve_backend='gather_fused_ring'``) moves its inter-chip bytes with
+``make_async_remote_copy`` *inside* a ``pallas_call``, visible only as
+``dma_start`` equations in the kernel jaxpr.  The ``comm_audit`` contract
+(analysis/contracts.py) pins both counters to
+``trainer.comm_bytes_per_iter``'s closed forms.
 """
 
 from __future__ import annotations
@@ -139,3 +147,81 @@ def collective_bytes(fn, *args, axis_size):
     # the jaxpr is per-program; under shard_map the collectives are
     # per-device ops already, so no further division
     return int(sum(breakdown.values())), breakdown
+
+
+def remote_dma_bytes(fn, *args):
+    """Per-device IN-KERNEL inter-chip bytes of one call of ``fn(*args)``:
+    the remote-DMA payloads a Pallas kernel moves with
+    ``make_async_remote_copy`` (ops.ring_buffer.remote_copy), which
+    :func:`collective_bytes` cannot see — no collective primitive traces;
+    the transfer is a ``dma_start`` equation inside the ``pallas_call``.
+
+    A ``dma_start`` is REMOTE iff it carries a send/recv semaphore PAIR
+    (local copies have exactly one DMA semaphore); its payload is the
+    source ref's aval.  Multiplicity comes from the fused-comm ring's
+    schedule contract (ops.pallas_gather_ne._gather_solve_ring_kernel):
+    grid ``(row_tiles, ring_steps, width_chunks)``, ONE transfer per
+    (row tile, step ``t <= S-2``) — the parity-variant ``dma_start``s are
+    mutually exclusive ``cond`` arms of that one transfer, so the audit
+    requires them to move identical payloads and counts
+    ``grid[0] * (grid[1] - 1)`` fires per kernel call.  A kernel whose
+    remote arms disagree on payload is data-dependent traffic → raise,
+    same policy as :func:`collective_bytes`'s ``cond`` rule.
+
+    Returns ``(total_bytes, per_call)`` where ``per_call`` lists each
+    ``pallas_call``'s contribution (scan-scaled).
+    """
+    closed = jax.make_jaxpr(fn)(*args)
+    per_call = []
+
+    def kernel_remote_payloads(jaxpr, out):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "dma_start":
+                sems = [v for v in eqn.invars
+                        if "semaphore" in str(getattr(v, "aval", ""))]
+                if len(sems) >= 2:
+                    out.append(_aval_bytes(eqn.invars[0].aval))
+            for p in ("jaxpr", "call_jaxpr", "body_jaxpr", "cond_jaxpr"):
+                inner = eqn.params.get(p) if eqn.params else None
+                if inner is not None:
+                    kernel_remote_payloads(
+                        getattr(inner, "jaxpr", inner), out)
+            for br in (eqn.params.get("branches", ())
+                       if eqn.params else ()):
+                kernel_remote_payloads(getattr(br, "jaxpr", br), out)
+
+    def walk(jaxpr, mult):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name == "pallas_call":
+                payloads = []
+                kernel_remote_payloads(eqn.params["jaxpr"], payloads)
+                if not payloads:
+                    continue
+                if len(set(payloads)) != 1:
+                    raise ValueError(
+                        "remote-DMA arms move different payloads "
+                        f"{sorted(set(payloads))} — data-dependent "
+                        "traffic is unauditable")
+                grid = tuple(eqn.params["grid_mapping"].grid)
+                if len(grid) != 3:
+                    raise ValueError(
+                        f"remote-DMA kernel with grid {grid}: the audit "
+                        "only knows the fused-comm ring schedule "
+                        "(row_tiles, ring_steps, width_chunks)")
+                fires = grid[0] * max(0, grid[1] - 1)
+                per_call.append(mult * payloads[0] * fires)
+            elif name == "scan":
+                walk(eqn.params["jaxpr"].jaxpr,
+                     mult * int(eqn.params["length"]))
+            elif name == "cond":
+                for br in eqn.params["branches"]:
+                    walk(br.jaxpr, mult)
+            else:
+                for p in ("jaxpr", "call_jaxpr"):
+                    inner = eqn.params.get(p) if eqn.params else None
+                    if inner is not None:
+                        walk(getattr(inner, "jaxpr", inner), mult)
+
+    walk(closed.jaxpr, 1)
+    return int(sum(per_call)), per_call

@@ -330,10 +330,12 @@ def train_multihost(u, i, r, num_users, num_items, cfg, mesh=None,
         extra = (assemble(ush.send_idx), assemble(ish.send_idx))
         step_factory = make_a2a_step
     else:
+        from tpu_als.parallel.trainer import EXECUTABLE_STRATEGIES
+
         raise ValueError(
             f"unknown strategy {strategy!r} for multi-host training "
-            "(expected 'all_gather', 'all_gather_chunked', 'ring', "
-            "'ring_overlap' or 'all_to_all')")
+            f"(expected one of {EXECUTABLE_STRATEGIES} — the table in "
+            "parallel.trainer.GATHER_STRATEGIES)")
 
     ub = jax.tree.map(assemble, ush.device_buckets())
     ib = jax.tree.map(assemble, ish.device_buckets())

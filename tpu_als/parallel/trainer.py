@@ -33,6 +33,47 @@ from tpu_als.parallel.mesh import AXIS, shard_map
 from tpu_als.resilience import faults
 
 
+#: THE authoritative gather-strategy table.  The CLI's
+#: ``--gather-strategy`` help, the :class:`tpu_als.api.estimator.ALS`
+#: ``gatherStrategy`` docs and :func:`train_sharded` all render or
+#: validate against THIS dict instead of restating it — three
+#: hand-copied variants drifted apart once already (PR 15).  ``auto``
+#: is a front-end name only (the CLI/estimator resolve it via the
+#: execution planner before :func:`train_sharded` runs); the ring rows
+#: additionally accept ``AlsConfig.solve_backend='gather_fused_ring'``,
+#: which moves the rotation itself into the gather-solve kernel as
+#: in-kernel remote DMAs (ops/pallas_gather_ne; one kernel per
+#: half-step — identical traffic model, see :func:`comm_bytes_per_iter`).
+GATHER_STRATEGIES = {
+    "auto": "the execution planner's comm-model pick (tpu_als.plan; "
+            "single-process mesh fits only)",
+    "all_gather": "full opposite-factor gather per half-step "
+                  "(the default)",
+    "all_gather_chunked": "column-block gathers per row tile — the "
+                          "full opposite table never materializes",
+    "ring": "ppermute streaming: shards rotate around the mesh, "
+            "accumulators stay put; opposite factors never "
+            "materialize in full",
+    "ring_overlap": "ring with the double-buffered "
+                    "ppermute-under-einsum schedule — identical bytes, "
+                    "the collective flies under the compute",
+    "all_to_all": "ragged exchange of only the referenced rows "
+                  "(needs the built A2aCsr request plans)",
+}
+
+#: The strategy names train_sharded actually executes ('auto' resolves
+#: to one of these upstream).
+EXECUTABLE_STRATEGIES = tuple(k for k in GATHER_STRATEGIES
+                              if k != "auto")
+
+
+def strategy_help(include_auto=True):
+    """One-line rendering of :data:`GATHER_STRATEGIES` for CLI help /
+    error messages — so callers print the table instead of copying it."""
+    keys = GATHER_STRATEGIES if include_auto else EXECUTABLE_STRATEGIES
+    return "; ".join(f"{k} = {GATHER_STRATEGIES[k]}" for k in keys)
+
+
 class FactorsCorrupt(RuntimeError):
     """Non-finite factors detected after a collective step — the sharded
     equivalent of a torn message (a bad DMA, a poisoned reduction).  ALS
@@ -186,6 +227,7 @@ def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig,
     ``overlap=False``.
     """
     from tpu_als.parallel.comm import ring_half_step
+    from tpu_als.utils.platform import on_tpu
 
     D = mesh.devices.size
     _check_shard_containers(mesh, user_ring, item_ring)
@@ -194,6 +236,24 @@ def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig,
     u_chunk = user_ring.chunk_elems
     i_chunk = item_ring.chunk_elems
     _prewarm(cfg, matfree_capable=False)
+
+    # fused-comm dispatch is decided HERE, at build time (the trace needs
+    # a static branch): the explicit knob, minus nonnegative (NNLS has no
+    # fused kernel — same precedence as everywhere), gated ON THE LIVE
+    # MESH by the availability probe when compiled (a banked or stale
+    # verdict must never steer a collective schedule — the multi-host
+    # safety rule).  Off-TPU the kernel runs in interpret mode, no gate.
+    interpret = not on_tpu()
+    fused_ring = (cfg.solve_backend == "gather_fused_ring"
+                  and not cfg.nonnegative)
+    if fused_ring and not interpret:
+        from tpu_als.ops import pallas_gather_ne
+
+        if not pallas_gather_ne.ring_available(
+                cfg.rank, cfg.compute_dtype, D):
+            obs.event("ring_fused_unavailable", rank=cfg.rank,
+                      n_shards=D, fallback="xla_ring")
+            fused_ring = False
 
     def step_body(U_loc, V_loc, ubuckets, ibuckets, ucounts, icounts):
         ubuckets = _squeeze0(ubuckets)
@@ -205,13 +265,15 @@ def make_ring_step(mesh, user_ring, item_ring, cfg: AlsConfig,
                      if cfg.implicit_prefs else None)
             V_new = ring_half_step(U_loc, ibuckets, icounts, per_i, D,
                                    cfg, i_chunk, YtY_u, prev=V_loc,
-                                   overlap=overlap)
+                                   overlap=overlap, fused=fused_ring,
+                                   interpret=interpret)
         with jax.named_scope("user_half_step"):
             YtY_v = (jax.lax.psum(compute_yty(V_new), AXIS)
                      if cfg.implicit_prefs else None)
             U_new = ring_half_step(V_new, ubuckets, ucounts, per_u, D,
                                    cfg, u_chunk, YtY_v, prev=U_loc,
-                                   overlap=overlap)
+                                   overlap=overlap, fused=fused_ring,
+                                   interpret=interpret)
         return U_new, V_new
 
     sharded = shard_map(
@@ -323,7 +385,8 @@ def make_a2a_step(mesh, user_a2a, item_a2a, cfg: AlsConfig):
 
 def comm_bytes_per_iter(strategy, user_part, item_part, rank,
                         user_container=None, item_container=None,
-                        implicit=False):
+                        implicit=False, compute_dtype="float32",
+                        panel=16):
     """Per-device collective traffic for ONE full ALS iteration, in bytes
     — the "gather bytes" line of the observability spec (SURVEY.md §5.5).
 
@@ -345,11 +408,21 @@ def comm_bytes_per_iter(strategy, user_part, item_part, rank,
       ``ShardedCsr`` containers when given, else assumed 1.
     - ``all_to_all``: only the requested rows move, ``(D−1)/D · D·R·r·4``
       received (+ the same sent); needs the built ``A2aCsr`` plans for R.
+    - ``gather_fused_ring``: the in-kernel remote-DMA ring — see the
+      branch comment below; ``compute_dtype``/``panel`` only matter here
+      (the payload is the kernel's lane-padded shard in the compute
+      dtype; ``panel`` sets the kernel row-tile size).
     - implicit adds one ``psum(YtY)`` per half-step: ``2·(D−1)/D·r²·4``
       with a bidirectional-ring cost model.
     """
+    from tpu_als.perf.roofline import ring_remote_bytes
+
     D = user_part.n_shards
     fb = 4 * rank
+    _db = jax.numpy.dtype(compute_dtype).itemsize
+
+    def _r_pad(r):
+        return max(128, -(-r // 128) * 128)
 
     def tiles(container):
         if container is None or not getattr(container, "buckets", None):
@@ -359,6 +432,20 @@ def comm_bytes_per_iter(strategy, user_part, item_part, rank,
             S, nb, w = b.cols.shape[-3:]
             chunk = trainer_chunk(nb, w, rank, container.chunk_elems)
             n += nb // chunk
+        return max(1, n)
+
+    def _ring_tiles(container, r):
+        # KERNEL row tiles: the fused ring tiles rows by _tiles_solve's
+        # TN (the grid does the chunking — trainer_chunk never applies)
+        from tpu_als.ops.pallas_gather_ne import _tiles_solve
+
+        if container is None or not getattr(container, "buckets", None):
+            return 1
+        n = 0
+        for b in container.buckets:
+            S, nb, w = b.cols.shape[-3:]
+            tn, _, _ = _tiles_solve(_r_pad(r), -(-w // 8) * 8, panel=panel)
+            n += -(-nb // tn)
         return max(1, n)
 
     if strategy == "all_gather":
@@ -379,6 +466,23 @@ def comm_bytes_per_iter(strategy, user_part, item_part, rank,
         # recv + send, excluding the self-shard slice
         half_u = 2 * (D - 1) * user_container.request_budget * fb
         half_v = 2 * (D - 1) * item_container.request_budget * fb
+    elif strategy == "gather_fused_ring":
+        # the in-kernel remote-DMA ring (solve_backend='gather_fused_ring'
+        # under 'ring'/'ring_overlap'): every KERNEL row tile runs its own
+        # (D−1)-rotation pass over the [rows_per_shard, r_pad] shard in
+        # the compute dtype — no homecoming rotation (the kernel
+        # re-streams from its immutable HBM copy), hence D−1 where the
+        # XLA ring pays D; the payload is rank-PADDED because the kernel
+        # ships its lane-padded V.  Same closed form as
+        # perf.roofline.ring_remote_bytes, summed over both half-steps —
+        # the extended comm_audit contract pins the traced in-kernel
+        # remote-copy bytes to exactly this.
+        half_u = (ring_remote_bytes(
+            _ring_tiles(user_container, rank), D,
+            item_part.rows_per_shard, _r_pad(rank), _db))
+        half_v = (ring_remote_bytes(
+            _ring_tiles(item_container, rank), D,
+            user_part.rows_per_shard, _r_pad(rank), _db))
     else:
         raise ValueError(f"unknown strategy {strategy!r}")
     total = half_u + half_v
@@ -407,15 +511,14 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
     """Distributed ALS training loop.  Returns slot-space (U, V) jax.Arrays
     sharded over ``mesh``; index with ``Partition.slot`` to get entity rows.
 
-    strategy: 'all_gather' (full opposite-factor gather per half-step),
-    'all_gather_chunked' (same containers, gathered in ``gather_blocks``
-    column blocks per row tile — the full opposite table is never
-    materialized), 'ring' (ppermute streaming; pass RingCsr containers and
-    ``ring_counts=(user_counts, item_counts)`` from :func:`stacked_counts`),
-    'ring_overlap' (ring with the double-buffered ppermute-under-einsum
-    schedule; same containers/counts as 'ring'), or 'all_to_all' (ragged
-    row exchange; pass A2aCsr containers from
-    tpu_als.parallel.a2a.build_a2a).
+    strategy: any :data:`EXECUTABLE_STRATEGIES` row — the semantics live
+    in :data:`GATHER_STRATEGIES` (the one authoritative table; 'auto' is
+    resolved by the CLI/estimator before this runs).  Container
+    contract per family: the gather strategies take ShardedCsr
+    ('all_gather_chunked' reads ``gather_blocks``), the ring family
+    takes RingCsr plus ``ring_counts=(user_counts, item_counts)`` from
+    :func:`stacked_counts`, and 'all_to_all' takes A2aCsr from
+    tpu_als.parallel.a2a.build_a2a.
 
     ``init``: optional entity-space ``(U0, V0)`` warm start (checkpoint
     resume, SURVEY.md §5.3); rows are scattered into slot space here.
@@ -446,11 +549,10 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
             _slot_init(kv, item_part, cfg.rank), leading
         )
 
-    if strategy not in ("all_gather", "all_gather_chunked", "ring",
-                        "ring_overlap", "all_to_all"):
-        raise ValueError(f"unknown strategy {strategy!r} "
-                         "(expected 'all_gather', 'all_gather_chunked', "
-                         "'ring', 'ring_overlap' or 'all_to_all')")
+    if strategy not in EXECUTABLE_STRATEGIES:
+        raise ValueError(f"unknown strategy {strategy!r} (expected one "
+                         f"of {EXECUTABLE_STRATEGIES}; "
+                         f"{strategy_help(include_auto=False)})")
     with obs.span("train.build_step", strategy=strategy):
         if strategy == "all_to_all":
             us = jax.device_put(user_sharded.send_idx, leading)

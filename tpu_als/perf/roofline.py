@@ -95,6 +95,33 @@ def fused_solve_kernel_bytes(P, n, r, db):
     return int(P * r * db + P * (4 + 3 * db) + n * r * 4)
 
 
+def ring_remote_bytes(n_row_tiles, n_shards, per, r, db):
+    """In-kernel remote-DMA payload of ONE ``gather_solve_ring`` call
+    (tpu_als.ops.pallas_gather_ne): every row tile runs its own full ring
+    pass, and each pass forwards the held ``[per, r]`` factor shard
+    ``S - 1`` times — there is NO homecoming rotation (the XLA ring's
+    S-th permute exists only to restore the shard for the next tile; the
+    kernel re-streams from its immutable HBM copy instead, which is why
+    the in-kernel ring moves (S-1)/S of the XLA ring's bytes per pass).
+
+    THE single source of truth shared by the kernel's ``pl.CostEstimate``
+    ring term, ``trainer.comm_bytes_per_iter('gather_fused_ring', …)``,
+    and the extended ``comm_audit`` contract (analysis/contracts.py) that
+    pins the traced remote-DMA payload × fire count to this formula.
+    """
+    return int(n_row_tiles * max(0, n_shards - 1) * per * r * db)
+
+
+def fused_ring_kernel_bytes(P, n, r, db, ring_bytes):
+    """HBM bytes of the fused-comm ring kernel
+    (tpu_als.ops.pallas_gather_ne.gather_solve_ring): the whole-iteration
+    fused model (:func:`fused_solve_kernel_bytes` — rows read once, weight
+    streams, x out) plus the inter-chip ring payload
+    (:func:`ring_remote_bytes`, counted once per transfer: the send's HBM
+    read on this chip; the matching write lands on the neighbor)."""
+    return fused_solve_kernel_bytes(P, n, r, db) + int(ring_bytes)
+
+
 def einsum_ne_build_bytes(P, n, r, db, restream=1.0):
     """Modeled NE-build bytes of the UNFUSED path (gather_stream +
     normal_eq stages below, summed): the gather reads one factor row per

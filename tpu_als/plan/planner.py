@@ -48,7 +48,10 @@ from tpu_als.plan import cache as plan_cache
 
 PlanCacheCorrupt = plan_cache.PlanCacheCorrupt
 
-# tie-break preference when the comm model scores candidates equal
+# tie-break preference when the comm model scores candidates equal — a
+# SUBSET of parallel.trainer.GATHER_STRATEGIES (the authoritative
+# table): all_to_all is excluded because its byte model needs built
+# A2aCsr plans the planner doesn't have at pick time
 GATHER_CANDIDATES = ("all_gather", "all_gather_chunked", "ring_overlap",
                      "ring")
 

@@ -117,6 +117,15 @@ STEPS=(
   "kernel_lab_r256|580|python scripts/kernel_lab.py --rank 256 --n 8192 --panels 4 8 16"
   "ablate_full_cg2|900|python scripts/ablate.py --scale 1 --iters 3 --variants full no-solve --cg-iters 2"
   "twotower_20ep|1500|python bench.py --no-auto-config --mode twotower --probe-attempts 1"
+  # PR 17 sharded-serving A/B, appended BEHIND the queue (the training
+  # numbers above are the round's priority): the same open-loop load
+  # once on the sharded int8 fan-out and once probe-gated (auto -> the
+  # in-kernel merge_ring when merge_ring_available passes on the live
+  # mesh, else sharded — the report's `backend` field records which
+  # one actually served).  serve-bench prints the bench-JSON line
+  # step_ok expects and banks with banked_at provenance.
+  "serve_sharded|580|python -m tpu_als.cli serve-bench --users 20000 --items 50000 --rank 64 --k 10 --shortlist-k 64 --qps 2000 --duration 5 --slo-ms 50 --mesh-devices 8 --serve-backend sharded --bench-json sweep_logs/BENCH_serve_sharded_tpu.json"
+  "serve_mring|580|python -m tpu_als.cli serve-bench --users 20000 --items 50000 --rank 64 --k 10 --shortlist-k 64 --qps 2000 --duration 5 --slo-ms 50 --mesh-devices 8 --serve-backend auto --update-qps 100 --update-items --freshness-slo-ms 2000 --bench-json sweep_logs/BENCH_serve_mring_tpu.json"
 )
 
 step_ok() {  # decide DONE from the step's .out: bench JSON without error,

@@ -517,15 +517,24 @@ def test_last_good_cache_keyed_by_mesh(rng):
     np.testing.assert_allclose(s1, s0, atol=1e-5)
 
 
-def test_last_good_cache_keyed_by_strategy(rng):
-    """A catalog served via all_gather must not back a degraded ring
-    answer — the strategies' tie-breaking differs, and a mixed cache
-    would silently change results across the failover."""
+def test_last_good_cache_bounded_per_mesh(rng):
+    """The degraded cache holds ONE entry per mesh — the newest
+    successful serve, whatever strategy produced it — and that entry
+    backs any strategy's failover (a catalog of generation g is correct
+    for every strategy; the answer is already flagged degraded).
+    Per-strategy entries only multiplied full-catalog retention."""
     serve, U, V, mesh = _serve_setup(rng)
     serve.topk_sharded(U, V, 5, mesh, strategy="all_gather")
+    serve.topk_sharded(U, V, 5, mesh, strategy="ring")
+    with serve._last_good_lock:
+        assert len(serve._last_good) == 1       # bounded: one per mesh
+        (Vg, validg), = serve._last_good.values()
+    assert Vg.shape == V.shape
     faults.install("serve.gather=raise@nth=1")
-    with pytest.raises(serve.ServeShardLost):
-        serve.topk_sharded(U, V, 5, mesh, strategy="ring")
+    s, _, info = serve.topk_sharded(U, V, 5, mesh, strategy="all_gather",
+                                    return_info=True)
+    assert info["degraded"]                     # ring's newest catalog
+    assert s.shape == (U.shape[0], 5)           # backs any failover
 
 
 # ---------------------------------------------------------------------------

@@ -1078,8 +1078,14 @@ def cmd_serve_bench(args):
     # plan for this device/jax key, else the DEFAULT_BUCKETS walk)
     buckets = (tuple(int(b) for b in args.buckets.split(","))
                if args.buckets else None)
+    mesh = None
+    if args.mesh_devices:
+        from tpu_als.parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.mesh_devices)
     engine = ServingEngine(
         k=args.k, buckets=buckets, shortlist_k=args.shortlist_k,
+        mesh=mesh, serve_backend=args.serve_backend,
         max_queue=args.max_queue, max_wait_s=args.max_wait_ms / 1e3,
         default_deadline_s=(args.deadline_ms / 1e3
                             if args.deadline_ms else None),
@@ -1241,6 +1247,24 @@ def cmd_serve_bench(args):
             "foldin_frac": args.foldin_frac,
         },
     }
+    if mesh is not None:
+        result["backend"] = engine._backend
+        result["config"]["mesh_devices"] = int(args.mesh_devices)
+        result["config"]["serve_backend"] = args.serve_backend
+    # feed the OBSERVED request-size mix back into the planner: the
+    # batch_rows histogram's {p50,p90,p99,max}, weight-reconstructed
+    # into a sample so the planner's own quantiles land on the same
+    # rungs, become the banked pow2 ladder for this device/rank key
+    # (quantiles are bucketed UPPER bounds — the derived ladder can
+    # only over-provision, never undersize a bucket)
+    if obs.histogram_count("serving.batch_rows"):
+        from tpu_als import plan
+
+        bq = [obs.histogram_quantile("serving.batch_rows", q)
+              for q in (0.5, 0.9, 0.99, 1.0)]
+        sample = ([bq[0]] * 50 + [bq[1]] * 40 + [bq[2]] * 9 + [bq[3]])
+        result["derived_buckets"] = list(plan.resolve_serving_buckets(
+            rank=args.rank, observed=sample))
     if updater is not None:
         from tpu_als.serving import build_index
 
@@ -1862,6 +1886,17 @@ def main(argv=None):
     sb.add_argument("--foldin-frac", type=float, default=0.0,
                     help="fraction of requests carrying a fold-in "
                          "factor row instead of a user id")
+    sb.add_argument("--mesh-devices", type=int, default=0,
+                    help="> 0 serves from a device mesh of this many "
+                         "shards: the catalog lives shard-resident "
+                         "(never committed whole to one device) and "
+                         "scoring runs the sharded fabric "
+                         "(docs/serving.md)")
+    sb.add_argument("--serve-backend", default="auto",
+                    choices=("auto", "local", "sharded", "merge_ring"),
+                    help="scoring backend on the mesh: sharded int8 "
+                         "fan-out, the in-kernel merge-ring top-k, or "
+                         "auto (probe-gated); local ignores the mesh")
     sb.add_argument("--update-qps", type=float, default=0.0,
                     help="concurrent rating-event rate through the "
                          "live fold-in → publish pipeline; >0 makes "

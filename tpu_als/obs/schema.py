@@ -286,6 +286,11 @@ EVENTS = {
         ("seq", "items", "quantized"),
         "one per ServingEngine.publish: the generation sequence number, "
         "catalog size, and whether an int8 index was built for it"),
+    "serving_backend": (
+        ("backend", "n_shards"),
+        "one per ServingEngine, at first publish: the scoring backend "
+        "the engine resolved (local / sharded / merge_ring), after the "
+        "live-mesh probe for the in-kernel merge"),
     "serve_degraded": (
         ("strategy", "reason"),
         "a sharded top-k request fell back to last-good gathered "

@@ -289,14 +289,66 @@ def resolve_gather_strategy(*, requested="auto", n_users, n_items, rank,
     return choice
 
 
-def resolve_serving_buckets(*, rank=0, requested=None):
-    """Serving batch-bucket ladder.  Explicit buckets pass through; the
-    default consults the bank (a previously warmed/recorded ladder wins)
-    and falls back to ``serving.batcher.DEFAULT_BUCKETS``."""
+def _ladder_from_observed(observed):
+    """Pow2-rounded quantile ladder from an observed request-size mix.
+
+    One bucket per {p50, p90, p99, max} of the observed batch sizes,
+    each rounded UP to the next power of two (one pinned executable per
+    rung, pad waste bounded by 2x at every quantile the traffic
+    actually hits).  Returns None when there is nothing to learn from.
+    """
+    from tpu_als.core.ratings import _next_pow2
+
+    xs = sorted(int(s) for s in observed if int(s) > 0)
+    if not xs:
+        return None
+    rungs = {int(_next_pow2(xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]))
+             for q in (0.50, 0.90, 0.99, 1.0)}
+    return tuple(sorted(rungs))
+
+
+def resolve_serving_buckets(*, rank=0, requested=None, observed=None):
+    """Serving batch-bucket ladder.  Explicit buckets pass through;
+    ``observed`` (a sequence of served batch sizes, e.g. drained from
+    the ``serving.batch_rows`` histogram after a bench run) derives a
+    pow2 quantile ladder and re-banks it so later default resolutions
+    inherit the measured mix; the bare default consults the bank (a
+    previously recorded ladder wins) and falls back to
+    ``serving.batcher.DEFAULT_BUCKETS``."""
     from tpu_als.serving.batcher import DEFAULT_BUCKETS
 
     if requested is not None:
         return tuple(int(b) for b in requested)
+    if observed is not None:
+        ladder = _ladder_from_observed(observed) or tuple(DEFAULT_BUCKETS)
+        if armed():
+            key = plan_key(rank=int(rank or 0), dtype="float32")
+            entry, _ = _load_or_quarantine(key)
+            if entry is None:
+                entry = {"schema_version": plan_cache.SCHEMA_VERSION,
+                         "plan_key": key, "probes": {}, "components": {}}
+            entry["components"]["serving_buckets"] = {
+                "resolved": [int(b) for b in ladder],
+                "provenance": {
+                    "banked_at": _now(),
+                    "walk_seconds": 0.0,
+                    "probes_executed": [],
+                    "probe_timings": {},
+                    "model": {"observed_n": len(list(observed)),
+                              "reason": "pow2 quantile ladder "
+                                        "(p50/p90/p99/max) from the "
+                                        "observed request-size mix"},
+                },
+            }
+            try:
+                plan_cache.store_entry(key, entry)
+            except OSError as e:
+                obs.emit("warning", what="plan_cache",
+                         reason=f"could not bank observed ladder: {e}")
+            obs.emit("plan_resolved", key=_key_str(key),
+                     component="serving_buckets", source="observed",
+                     resolved=_summ(list(ladder)))
+        return ladder
     if not armed():
         return tuple(DEFAULT_BUCKETS)
     key = plan_key(rank=int(rank or 0), dtype="float32")

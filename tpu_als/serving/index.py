@@ -203,7 +203,7 @@ class Int8CandidateIndex:
         return int(self.d_rows.size)
 
     def _copy_shell(self, seq):
-        new = object.__new__(Int8CandidateIndex)
+        new = object.__new__(type(self))
         new.V, new.valid = self.V, self.valid
         new.Vq, new.sv = self.Vq, self.sv
         new.n_items = self.n_items
@@ -213,7 +213,12 @@ class Int8CandidateIndex:
         new._dV, new._dVq = self._dV, self._dVq
         new._dsv, new._dvalid = self._dsv, self._dvalid
         new._dev_delta = self._dev_delta
+        self._copy_extra(new)
         return new
+
+    def _copy_extra(self, new):
+        """Subclass hook: carry extra attributes through shell copies
+        (the sharded index's mesh placement state)."""
 
     def retag(self, seq):
         """A shallow copy sharing every array, tagged for a new publish.
@@ -381,3 +386,259 @@ def build_index(V, item_valid=None, shortlist_k=64, seq=0):
     """
     return Int8CandidateIndex(V, item_valid=item_valid,
                               shortlist_k=shortlist_k, seq=seq)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_sharded_int8(mesh, k, k_loc, sk_loc, ni_loc, has_delta):
+    """shard_map'd int8 shortlist + exact rescore, one program per shard.
+
+    Each shard runs the SAME shortlist→rescore pipeline as
+    :func:`_int8_topk` / :func:`_int8_topk_delta` over its catalog slice
+    only — no shard ever sees another's rows, so nothing here reads the
+    full table.  The (tiny, replicated) delta segment is scored by every
+    shard but masked to the rows it OWNS (``row // ni_loc == me``), so
+    each delta row is scored exactly once mesh-wide.  Per-shard local
+    top-``k_loc`` lands as a stacked ``[S, n, k_loc]`` output the final
+    (out-of-shard-map, same jit) merge concatenates in shard order and
+    reduces with one stable ``lax.top_k`` — ``S*k_loc`` values per
+    query, never a per-shard candidate LIST in host memory.
+    """
+    from tpu_als.parallel.mesh import AXIS, shard_map
+
+    P = jax.sharding.PartitionSpec
+    D = int(mesh.devices.size)
+
+    def body(U, Vq, sv, V, valid, *delta):
+        me = jax.lax.axis_index(AXIS)
+        n = U.shape[0]
+        Uq, su = _quantize_rows(U)
+        acc = jnp.einsum("nr,cr->nc", Uq, Vq,
+                         preferred_element_type=jnp.int32)
+        approx = acc.astype(jnp.float32) * su[:, None] * sv[None, :]
+        if has_delta:
+            drows, dVq, dsv, dV, dvalid = delta
+            d = dVq.shape[0]
+            idx = drows - me * ni_loc          # local slot, if owned
+            owned = (idx >= 0) & (idx < ni_loc)
+            # overridden base rows mask regardless of dvalid (a delta
+            # row may mark an item invalid); ni_loc is the OOB sentinel
+            over = jnp.zeros((ni_loc,), jnp.bool_).at[
+                jnp.where(owned, idx, ni_loc)].set(True, mode="drop")
+            base_ok = valid & ~over
+            approx = jnp.where(base_ok[None, :], approx, NEG_INF)
+            dmask = dvalid & owned
+            acc_d = jnp.einsum("nr,cr->nc", Uq, dVq,
+                               preferred_element_type=jnp.int32)
+            approx_d = (acc_d.astype(jnp.float32)
+                        * su[:, None] * dsv[None, :])
+            approx_d = jnp.where(dmask[None, :], approx_d, NEG_INF)
+            approx = jnp.concatenate([approx, approx_d], axis=1)
+        else:
+            base_ok = valid
+            approx = jnp.where(base_ok[None, :], approx, NEG_INF)
+        _, cand = jax.lax.top_k(approx, sk_loc)
+        flat = cand.reshape(-1)
+        if has_delta:
+            in_base = flat < ni_loc
+            base_ix = jnp.minimum(flat, ni_loc - 1)
+            delta_ix = jnp.clip(flat - ni_loc, 0, d - 1)
+            Vc = jnp.where(in_base[:, None],
+                           jnp.take(V, base_ix, axis=0),
+                           jnp.take(dV, delta_ix, axis=0))
+        else:
+            Vc = jnp.take(V, flat, axis=0)
+        exact_all = jnp.einsum("nr,cr->nc", U, Vc,
+                               preferred_element_type=jnp.float32)
+        pos = (jnp.arange(n, dtype=jnp.int32)[:, None] * sk_loc
+               + jnp.arange(sk_loc, dtype=jnp.int32)[None, :])
+        exact = jnp.take_along_axis(exact_all, pos, axis=1)
+        if has_delta:
+            cand_ok = jnp.where(in_base, jnp.take(base_ok, base_ix),
+                                jnp.take(dmask, delta_ix))
+            gid = jnp.where(in_base, flat + me * ni_loc,
+                            jnp.take(drows, delta_ix))
+        else:
+            cand_ok = jnp.take(base_ok, flat)
+            gid = flat + me * ni_loc
+        exact = jnp.where(cand_ok.reshape(n, sk_loc), exact, NEG_INF)
+        s, sel = jax.lax.top_k(exact, k_loc)
+        gids = jnp.take_along_axis(gid.reshape(n, sk_loc), sel, axis=1)
+        return s[None], gids.astype(jnp.int32)[None]
+
+    delta_specs = (P(),) * 5 if has_delta else ()
+    sharded = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS)) + delta_specs,
+        out_specs=(P(AXIS), P(AXIS)), check_vma=False)
+
+    def merged(U, Vq, sv, V, valid, last_id, *delta):
+        s, ix = sharded(U, Vq, sv, V, valid, *delta)
+        n = U.shape[0]
+        cat_s = jnp.transpose(s, (1, 0, 2)).reshape(n, D * k_loc)
+        cat_i = jnp.transpose(ix, (1, 0, 2)).reshape(n, D * k_loc)
+        if D * k_loc < k:      # tiny shards: pad so top_k(k) is legal
+            pad = k - D * k_loc
+            cat_s = jnp.pad(cat_s, ((0, 0), (0, pad)),
+                            constant_values=NEG_INF)
+            cat_i = jnp.pad(cat_i, ((0, 0), (0, pad)))
+        bs, sel = jax.lax.top_k(cat_s, k)
+        bi = jnp.take_along_axis(cat_i, sel, axis=1)
+        return bs, jnp.minimum(bi, last_id)
+
+    return jax.jit(merged)
+
+
+class ShardedInt8Index(Int8CandidateIndex):
+    """:class:`Int8CandidateIndex` with the catalog SHARDED over a mesh.
+
+    Build/publish places each shard's quantized slice device-resident —
+    the base arrays are padded to ``n_shards * ni_loc`` and placed with
+    ``jax.device_put(..., shard_leading(mesh))``, which transfers each
+    host slice to its own device; the full table is never committed to
+    any single device (same placement discipline as
+    ``parallel.serve.topk_sharded``).  Quantization runs jitted on the
+    already-sharded array — per-row, so it stays sharded and each device
+    quantizes only its slice.
+
+    The PR 11 live pipeline composes unchanged: :meth:`with_updates`
+    inherits the base's host-side delta merge (O(touched) per publish,
+    base arrays shared by reference), the replicated delta segment is
+    routed to owning shards at SCORE time by ``row // ni_loc``, and
+    :meth:`compact` scatters the segment into the sharded base in place
+    of the base class's grow-then-scatter (capacity always covers
+    ``n_items`` here — growth past the shard stride rebuilds, see
+    :meth:`with_updates`).
+
+    Equality contract: same as the base index — scores match the exact
+    kernel bitwise when the true top-k survives the (now per-shard)
+    shortlist, which is a strictly WEAKER condition: each shard
+    shortlists ``min(sk, ni_loc + d_pad)`` of its own slice, so the
+    mesh-wide candidate pool is a superset of the single-device one.
+    The bitwise TIE-ORDER contract lives on the f32 merge-ring kernel
+    (``ops.pallas_topk.topk_merge_ring``), not on this int8 path — same
+    caveat as the single-device int8 index.
+    """
+
+    def __init__(self, V, mesh, item_valid=None, shortlist_k=64, seq=0):
+        from tpu_als.parallel.mesh import shard_leading
+
+        V = np.asarray(V, dtype=np.float32)
+        Ni = int(V.shape[0])
+        if Ni == 0:
+            raise ValueError("cannot index an empty catalog")
+        D = int(mesh.devices.size)
+        ni_loc = -(-Ni // D)
+        cap = D * ni_loc
+        valid = (np.ones(Ni, dtype=bool) if item_valid is None
+                 else np.asarray(item_valid, dtype=bool).ravel())
+        spec = shard_leading(mesh)
+        self.mesh = mesh
+        self.n_shards = D
+        self.ni_loc = ni_loc
+        self.V = jax.device_put(np.pad(V, ((0, cap - Ni), (0, 0))), spec)
+        self.valid = jax.device_put(np.pad(valid, (0, cap - Ni)), spec)
+        self.Vq, self.sv = _quantize_rows(self.V)
+        self.n_items = Ni
+        self.shortlist_k = min(int(shortlist_k), Ni)
+        self.seq = seq
+        self._clear_delta()
+
+    def _copy_extra(self, new):
+        new.mesh = self.mesh
+        new.n_shards = self.n_shards
+        new.ni_loc = self.ni_loc
+
+    @property
+    def capacity(self):
+        """Catalog ids the sharded base can hold without re-striding."""
+        return self.n_base
+
+    def with_updates(self, rows, V_rows, valid_rows=None, seq=None):
+        rows_a = np.asarray(rows, dtype=np.int64).ravel()
+        if rows_a.size and int(rows_a.max()) >= self.capacity:
+            return self._regrown(rows_a, V_rows, valid_rows, seq)
+        return super().with_updates(rows, V_rows, valid_rows, seq)
+
+    def _regrown(self, rows, V_rows, valid_rows, seq):
+        """Growth past the shard stride: every id's owning shard moves,
+        so there is no incremental path — rebuild the sharded base at
+        the grown size (O(catalog), the rare capacity-crossing publish;
+        within capacity :meth:`with_updates` stays O(touched))."""
+        if rows.min() < 0:
+            raise ValueError("negative catalog row id in delta update")
+        r = int(self.V.shape[1])
+        V_rows = np.asarray(V_rows, dtype=np.float32).reshape(len(rows), r)
+        valid_rows = (np.ones(len(rows), dtype=bool) if valid_rows is None
+                      else np.asarray(valid_rows, dtype=bool).ravel())
+        base = self.compact() if self.d_rows.size else self
+        n_new = int(max(self.n_items, int(rows.max()) + 1))
+        missing = sorted(set(range(self.n_items, n_new))
+                         - set(rows[rows >= self.n_items].tolist()))
+        if missing:
+            raise ValueError(
+                f"append gap: ids {missing} missing — appended rows "
+                "must be contiguous above the current catalog")
+        V_full = np.zeros((n_new, r), dtype=np.float32)
+        V_full[:self.n_items] = np.asarray(base.V)[:self.n_items]
+        valid_full = np.zeros(n_new, dtype=bool)
+        valid_full[:self.n_items] = np.asarray(base.valid)[:self.n_items]
+        # numpy fancy assignment keeps the LAST duplicate: newest wins,
+        # matching the base class's in-call dedup
+        V_full[rows] = V_rows
+        valid_full[rows] = valid_rows
+        return type(self)(V_full, self.mesh, item_valid=valid_full,
+                          shortlist_k=self.shortlist_k,
+                          seq=self.seq if seq is None else int(seq))
+
+    def compact(self, seq=None):
+        """Fold the delta into the sharded base: same memcpy-class
+        scatter as the base class, minus its grow branch (capacity
+        always covers ``n_items`` — see :meth:`_regrown`); results are
+        re-placed shard-leading so residency survives the scatter."""
+        if not self.d_rows.size:
+            return self._copy_shell(seq)
+        from tpu_als.parallel.mesh import shard_leading
+
+        spec = shard_leading(self.mesh)
+        ix = jnp.asarray(self.d_rows, dtype=jnp.int32)
+        new = self._copy_shell(seq)
+        new.V = jax.device_put(
+            self.V.at[ix].set(jnp.asarray(self._dV)), spec)
+        new.Vq = jax.device_put(
+            self.Vq.at[ix].set(jnp.asarray(self._dVq)), spec)
+        new.sv = jax.device_put(
+            self.sv.at[ix].set(jnp.asarray(self._dsv)), spec)
+        new.valid = jax.device_put(
+            self.valid.at[ix].set(jnp.asarray(self._dvalid)), spec)
+        new._clear_delta()
+        return new
+
+    def topk(self, U, k, shortlist_k=None):
+        """Top-k of ``U @ V.T`` scored shard-resident (see class
+        docstring); per-query device traffic is ``S * k_loc`` merged
+        candidates, never a per-shard list."""
+        sk = self.shortlist_k if shortlist_k is None else \
+            min(int(shortlist_k), self.n_items)
+        if k > sk:
+            raise ValueError(
+                f"k={k} exceeds shortlist_k={sk}; the shortlist must "
+                "contain at least k candidates")
+        U = jnp.asarray(U, dtype=jnp.float32)
+        has_delta = bool(self.delta_count)
+        d_pad = _next_pow2(self.delta_count) if has_delta else 0
+        sk_loc = min(sk, self.ni_loc + d_pad)
+        k_loc = min(int(k), sk_loc)
+        fn = _build_sharded_int8(self.mesh, int(k), k_loc, sk_loc,
+                                 self.ni_loc, has_delta)
+        last = jnp.int32(self.n_items - 1)
+        if has_delta:
+            return fn(U, self.Vq, self.sv, self.V, self.valid, last,
+                      *self._device_delta())
+        return fn(U, self.Vq, self.sv, self.V, self.valid, last)
+
+
+def build_sharded_index(V, mesh, item_valid=None, shortlist_k=64, seq=0):
+    """Full sharded rebuild: quantize the whole catalog, device-resident
+    per shard.  The mesh-placed counterpart of :func:`build_index`."""
+    return ShardedInt8Index(V, mesh, item_valid=item_valid,
+                            shortlist_k=shortlist_k, seq=seq)

@@ -1,51 +1,65 @@
 #!/bin/bash
 # Chaos smoke: the resilience subsystem's CI gate, CPU-only (no
-# accelerator, no network).  Four stages, fail-fast:
+# accelerator, no network).  Five stages, fail-fast:
 #
 #   1. the fast chaos matrix — every fault point exercised with at least
 #      one injected failure (tests/test_resilience.py, tier-1 subset)
 #      plus the resume/preemption suite,
 #   2. the static checks — the obs-schema shim (the resilience event
-#      vocabulary — retry_attempt, fault_injected, preempted, ... —
-#      must stay declared) plus the analysis gate
+#      vocabulary — retry_attempt, fault_injected, preempted,
+#      device_lost, ... — must stay declared) plus the analysis gate
 #      (scripts/lint_smoke.sh: poisoned-jax tracer-safety lint + the
 #      jaxpr contract registry, which re-verifies guardrails_disarmed
-#      by name),
+#      and elastic_disarmed by name),
 #   3. one END-TO-END kill-and-resume train via the scenario harness
 #      (`tpu_als scenario run preempt-resume` — the ONE implementation
 #      of this flow, shared with tests/test_scenarios.py): preempt the
 #      CLI at an iteration boundary (deterministic TPU_ALS_PREEMPT_AT
 #      knob), assert the distinct exit code 43, resume with
 #      --resume auto, assert success + checkpoint discovery,
-#   4. the numerical-guardrail scenarios (solver-divergence +
+#   4. one END-TO-END device loss on a real multi-device (forced-host)
+#      CPU mesh (`tpu_als scenario run device-loss`): a peer dies at
+#      step 3 of an elastic sharded train, the mesh re-forms on the
+#      survivors, resumes from the last atomic checkpoint, and the
+#      final factors are BITWISE equal to a fresh shrunk-mesh fit
+#      resumed from the same checkpoint,
+#   5. the numerical-guardrail scenarios (solver-divergence +
 #      poisoned-stream: injected NaN -> rollback -> clean-band RMSE;
 #      poisoned stream -> every bad record quarantined), then the bench
 #      regression gate (scripts/bench_gate.sh — the PR 7 gate
 #      scenario_smoke and serve_smoke already run): chaos changes must
 #      not regress the headline perf path either.
 #
-# Usage: scripts/chaos_smoke.sh   (from the repo root; ~2 min on CPU)
+# Usage: scripts/chaos_smoke.sh   (from the repo root; ~3 min on CPU)
 set -u
 
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 fail=0
 
-echo "== chaos smoke 1/4: fault-point matrix (fast tier) =="
+echo "== chaos smoke 1/5: fault-point matrix (fast tier) =="
 python -m pytest tests/test_resilience.py tests/test_resume.py \
     -q -m 'not slow' -p no:cacheprovider || fail=1
 
-echo "== chaos smoke 2/4: static checks (obs schema + analysis gate) =="
+echo "== chaos smoke 2/5: static checks (obs schema + analysis gate) =="
 python scripts/check_obs_schema.py || fail=1
 scripts/lint_smoke.sh || fail=1
 
-echo "== chaos smoke 3/4: end-to-end kill-and-resume (scenario) =="
+echo "== chaos smoke 3/5: end-to-end kill-and-resume (scenario) =="
 # the preempt-resume scenario asserts exit code 43 on the preempted
 # train, exit 0 + "resuming from" discovery + saved manifest.json on
 # the --resume auto rerun (tpu_als/scenario/library.py)
 python -m tpu_als.cli scenario run preempt-resume || fail=1
 
-echo "== chaos smoke 4/4: guardrail scenarios + bench regression gate =="
+echo "== chaos smoke 4/5: end-to-end device loss (elastic scenario) =="
+# the device-loss scenario runs the real CLI on an 8-device forced-host
+# CPU mesh, kills a peer at step 3 (mesh.device_lost fault point),
+# asserts the device_lost -> mesh_reformed -> elastic_resume trail and
+# BITWISE factors vs a fresh shrunk-mesh resume from the same
+# checkpoint (tpu_als/scenario/library.py)
+python -m tpu_als.cli scenario run device-loss || fail=1
+
+echo "== chaos smoke 5/5: guardrail scenarios + bench regression gate =="
 # the two numerical-health scenarios (tpu_als/scenario/library.py) are
 # the end-to-end proof of the guardrails contract; the bench gate then
 # pins the disarmed headline path against BENCH_BASELINE.json

@@ -126,6 +126,15 @@ STEPS=(
   # step_ok expects and banks with banked_at provenance.
   "serve_sharded|580|python -m tpu_als.cli serve-bench --users 20000 --items 50000 --rank 64 --k 10 --shortlist-k 64 --qps 2000 --duration 5 --slo-ms 50 --mesh-devices 8 --serve-backend sharded --bench-json sweep_logs/BENCH_serve_sharded_tpu.json"
   "serve_mring|580|python -m tpu_als.cli serve-bench --users 20000 --items 50000 --rank 64 --k 10 --shortlist-k 64 --qps 2000 --duration 5 --slo-ms 50 --mesh-devices 8 --serve-backend auto --update-qps 100 --update-items --freshness-slo-ms 2000 --bench-json sweep_logs/BENCH_serve_mring_tpu.json"
+  # PR 18 elastic A/B, appended BEHIND the queue: the same sharded
+  # train once with the elastic detector disarmed and once armed.  The
+  # elastic_disarmed contract already proves the traced step jaxpr is
+  # byte-identical; this pair banks the measured wall-clock of the
+  # host-side wrapper (per-step fault check + exception frame) on a
+  # real mesh — expected to be noise, and the train.iteration timings
+  # in each obs trail are the evidence.  Script steps: rc=0 is DONE.
+  "elastic_off|580|python -m tpu_als.cli train --data synthetic:20000x10000x500000 --rank 64 --max-iter 5 --seed 7 --devices 4 --output sweep_logs/elastic_off_model --obs-dir sweep_logs/elastic_off_obs"
+  "elastic_on|580|python -m tpu_als.cli train --data synthetic:20000x10000x500000 --rank 64 --max-iter 5 --seed 7 --devices 4 --elastic --output sweep_logs/elastic_on_model --obs-dir sweep_logs/elastic_on_obs"
 )
 
 step_ok() {  # decide DONE from the step's .out: bench JSON without error,

@@ -152,6 +152,33 @@ def test_jitter_is_deterministic_per_seed():
     assert all(0.75 <= d <= 1.25 for d in da)
 
 
+def test_deterministic_jitter_is_drawcount_independent():
+    """Deterministic mode: the jitter for attempt k is a pure function
+    of (seed, k), so two same-seed policies agree byte for byte even
+    after one has already drawn — the replay property traced runs
+    need.  The stateful default walks its stream instead."""
+    a = RetryPolicy(base_delay=1.0, jitter=0.25, seed=3,
+                    deterministic=True)
+    b = RetryPolicy(base_delay=1.0, jitter=0.25, seed=3,
+                    deterministic=True)
+    for _ in range(7):
+        a.delay(0)   # burn draws on a only
+    assert ([a.delay(k) for k in range(5)]
+            == [b.delay(k) for k in range(5)])
+    c = RetryPolicy(base_delay=1.0, jitter=0.25, seed=3,
+                    deterministic=False)
+    assert len({c.delay(0) for _ in range(5)}) > 1
+
+
+def test_deterministic_jitter_resolves_from_trace_env(monkeypatch):
+    monkeypatch.delenv("TPU_ALS_TRACE", raising=False)
+    assert RetryPolicy().deterministic is False
+    monkeypatch.setenv("TPU_ALS_TRACE", "1")
+    assert RetryPolicy().deterministic is True
+    # an explicit argument beats the env resolution
+    assert RetryPolicy(deterministic=False).deterministic is False
+
+
 def test_retry_succeeds_after_transient_failures():
     calls, infos = [], []
 
@@ -598,6 +625,36 @@ def test_preempt_env_knob_fires_at_exact_iteration(monkeypatch):
     assert preempt.pending(3)
 
 
+@pytest.mark.parametrize("bad", ["three", "0", "-2", "2.5"])
+def test_preempt_at_malformed_is_typed_error(monkeypatch, bad):
+    """A deterministic-preemption knob that silently fails to fire is
+    the worst chaos tooling: the malformed value is a typed error at
+    arm time (guard entry) AND at every poll, never a no-op."""
+    from tpu_als.resilience import preempt
+
+    monkeypatch.setenv(preempt.ENV_PREEMPT_AT, bad)
+    with pytest.raises(preempt.PreemptAtError):
+        preempt.preempt_at()
+    with pytest.raises(preempt.PreemptAtError):
+        with preempt.PreemptionGuard():
+            pass
+    assert preempt.installed() is None   # arm-time failure leaks nothing
+    with pytest.raises(preempt.PreemptAtError):
+        preempt.pending(1)
+    assert isinstance(preempt.PreemptAtError("x"), ValueError)
+
+
+def test_preempt_at_unset_empty_and_valid(monkeypatch):
+    from tpu_als.resilience import preempt
+
+    monkeypatch.delenv(preempt.ENV_PREEMPT_AT, raising=False)
+    assert preempt.preempt_at() is None
+    monkeypatch.setenv(preempt.ENV_PREEMPT_AT, "")
+    assert preempt.preempt_at() is None
+    monkeypatch.setenv(preempt.ENV_PREEMPT_AT, "4")
+    assert preempt.preempt_at() == 4
+
+
 def test_preempted_is_systemexit_with_distinct_code():
     from tpu_als.resilience import preempt
 
@@ -623,6 +680,136 @@ def test_estimator_preempts_at_iteration_boundary(rng, tmp_path,
     assert ei.value.iteration == 3
     manifest, *_ = load_factors(str(tmp_path / "als_checkpoint"))
     assert manifest["iteration"] == 3
+
+
+# ---------------------------------------------------------------------------
+# elastic mesh training: the detect -> classify -> reschedule primitives
+# (the end-to-end loss -> reform -> bitwise resume lives in the
+# device-loss scenario, tests/test_scenarios.py)
+
+
+@pytest.fixture
+def _no_lost():
+    from tpu_als.resilience import elastic
+
+    elastic.clear_lost()
+    yield elastic
+    elastic.clear_lost()
+
+
+def test_lost_registry_roundtrip(_no_lost):
+    elastic = _no_lost
+    assert elastic.lost_devices() == frozenset()
+    elastic.mark_lost(2, 5)
+    assert elastic.lost_devices() == frozenset({2, 5})
+    elastic.clear_lost()
+    assert elastic.lost_devices() == frozenset()
+
+
+def test_victim_index_validates():
+    from tpu_als.resilience import elastic
+
+    assert elastic._victim_index(4, environ={}) == 3
+    assert elastic._victim_index(
+        4, environ={elastic.ENV_LOST_DEVICE: "1"}) == 1
+    with pytest.raises(ValueError, match="not an integer"):
+        elastic._victim_index(4, environ={elastic.ENV_LOST_DEVICE: "x"})
+    with pytest.raises(ValueError, match="out of range"):
+        elastic._victim_index(4, environ={elastic.ENV_LOST_DEVICE: "4"})
+
+
+def test_classify_reports_only_dead_peers(_no_lost):
+    import jax
+
+    elastic = _no_lost
+    devices = jax.devices()[:4]
+    policy = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=0.0)
+    assert elastic.classify(devices, policy=policy) == ()
+    elastic.mark_lost(devices[2].id)
+    assert elastic.classify(devices, policy=policy) == (
+        int(devices[2].id),)
+
+
+def test_surviving_devices_preserve_mesh_order(_no_lost):
+    from tpu_als.parallel.mesh import make_mesh
+
+    elastic = _no_lost
+    mesh = make_mesh(4)
+    flat = list(mesh.devices.flat)
+    elastic.mark_lost(flat[1].id)
+    survivors = elastic.surviving_devices(mesh)
+    assert [int(d.id) for d in survivors] == [
+        int(d.id) for d in (flat[0], flat[2], flat[3])]
+
+
+def _probe_fast(max_attempts=2):
+    return RetryPolicy(max_attempts=max_attempts, base_delay=0.0,
+                       jitter=0.0, sleep=lambda s: None,
+                       retry_on=(OSError, TimeoutError))
+
+
+def test_wrap_step_transient_failure_retried_in_place(_no_lost):
+    from tpu_als.parallel.mesh import make_mesh
+    from tpu_als.resilience import elastic
+
+    mesh = make_mesh(2)
+    calls = []
+
+    def step(U, V):
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError("ICI hiccup")   # every peer probes healthy
+        return U, V
+
+    wrapped = elastic.wrap_step(step, mesh, policy=_probe_fast())
+    assert wrapped(1, 2) == (1, 2)
+    assert len(calls) == 2
+
+
+def test_wrap_step_dead_peer_raises_device_lost(_no_lost):
+    from tpu_als.parallel.mesh import make_mesh
+    from tpu_als.resilience import elastic
+    from tpu_als.resilience.elastic import DeviceLost
+
+    mesh = make_mesh(4)
+    faults.install("mesh.device_lost=corrupt@once")
+    wrapped = elastic.wrap_step(lambda U, V: (U, V), mesh,
+                                policy=_probe_fast())
+    with pytest.raises(DeviceLost) as ei:
+        wrapped(0, 0)
+    assert ei.value.lost == (int(mesh.devices.flat[-1].id),)
+    assert ei.value.surviving == 3
+    assert isinstance(ei.value.__cause__, elastic.ProbeFailed)
+
+
+def test_elastic_vocabulary_pins_hold():
+    """The recovery-trail names are a cross-process contract (the
+    device-loss scenario counts them in events.jsonl): the explicit
+    vocab pin must hold — declared AND emitted/consulted."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_tal_vocab_elastic_test",
+        os.path.join(repo, "tpu_als", "analysis", "vocab.py"))
+    vocab = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(vocab)
+    assert vocab.check_elastic_vocabulary(repo) == []
+
+
+def test_wrap_step_transient_budget_exhausts(_no_lost):
+    from tpu_als.parallel.mesh import make_mesh
+    from tpu_als.resilience import elastic
+
+    mesh = make_mesh(2)
+
+    def step(U, V):
+        raise OSError("persistent but no peer is dead")
+
+    wrapped = elastic.wrap_step(step, mesh, policy=_probe_fast(),
+                                max_transient=2)
+    with pytest.raises(OSError, match="persistent"):
+        wrapped(0, 0)
 
 
 # ---------------------------------------------------------------------------

@@ -164,6 +164,70 @@ def test_cli_preempt_then_resume_auto_is_bitwise_exact(tmp_path):
         assert np.array_equal(full["ids"], res["ids"]), side
 
 
+def test_cli_resume_auto_quarantines_corrupt_and_uses_old(tmp_path):
+    """Disk corruption in the crash window: the primary checkpoint
+    generation is torn with only the ``.old`` generation complete, so
+    ``--resume auto`` must quarantine the primary to ``.corrupt/``
+    (forensics, out of the next save's way), fall back to ``.old``, and
+    still converge to factors BITWISE equal to an uninterrupted run —
+    the ``.old`` swap contract driven end to end through the real CLI."""
+    import shutil
+
+    from tpu_als.resilience.preempt import EXIT_PREEMPTED
+
+    base = ["train", "--data", "synthetic:80x40x1500", "--rank", "4",
+            "--max-iter", "6", "--reg-param", "0.05", "--seed", "7"]
+    ckdir, ck2, out_full, out_res = (str(tmp_path / d)
+                                     for d in ("ck", "ck2", "full",
+                                               "resumed"))
+
+    p = _cli(base + ["--output", out_full])
+    assert p.returncode == 0, p.stderr
+
+    # preempted at iteration 4: the primary generation
+    p = _cli(base + ["--checkpoint-dir", ckdir,
+                     "--checkpoint-interval", "100"],
+             env={"TPU_ALS_PREEMPT_AT": "4"})
+    assert p.returncode == EXIT_PREEMPTED, (p.returncode, p.stderr)
+    primary = os.path.join(ckdir, "als_checkpoint")
+    assert load_factors(primary)[0]["iteration"] == 4
+
+    # reconstruct the crash-window state: a complete iteration-2 .old
+    # generation next to the (about to be torn) iteration-4 primary.
+    # ALS iterations are max_iter-independent, so a finished maxIter=2
+    # run's checkpoint IS the iteration-2 interval generation.
+    prefix = list(base)
+    prefix[prefix.index("--max-iter") + 1] = "2"
+    p = _cli(prefix + ["--checkpoint-dir", ck2,
+                       "--checkpoint-interval", "2"])
+    assert p.returncode == 0, p.stderr
+    shutil.move(os.path.join(ck2, "als_checkpoint"), primary + ".old")
+    assert load_factors(primary + ".old")[0]["iteration"] == 2
+
+    # tear the primary: truncate a manifest-listed factor file
+    fp = os.path.join(primary, "user_factors.npz")
+    raw = open(fp, "rb").read()
+    with open(fp, "wb") as f:
+        f.write(raw[:len(raw) // 2])
+
+    p = _cli(base + ["--checkpoint-dir", ckdir, "--resume", "auto",
+                     "--output", out_res])
+    assert p.returncode == 0, p.stderr
+    assert "resuming from" in p.stderr
+
+    # the torn generation was preserved for forensics, not deleted
+    qdir = os.path.join(ckdir, ".corrupt")
+    assert os.path.isdir(qdir) and os.listdir(qdir)
+    assert not os.path.exists(primary)
+
+    # iterations 3..6 from the .old generation: bitwise vs uninterrupted
+    for side in ("user_factors.npz", "item_factors.npz"):
+        full = np.load(os.path.join(out_full, side))
+        res = np.load(os.path.join(out_res, side))
+        assert np.array_equal(full["factors"], res["factors"]), side
+        assert np.array_equal(full["ids"], res["ids"]), side
+
+
 @pytest.mark.slow
 def test_cli_real_sigterm_checkpoints_and_exits_43(tmp_path):
     """A REAL SIGTERM mid-fit (not the deterministic knob): the guard

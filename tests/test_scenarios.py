@@ -312,6 +312,22 @@ def test_preempt_resume_scenario_subprocess():
     assert f["resume_discovered"] is True and f["model_saved"] is True
 
 
+def test_device_loss_scenario_subprocess():
+    """Elastic training acceptance (PR 18 tentpole): a device dies
+    mid-fit, the run COMPLETES (exit 0, not a crash), the recovery tree
+    (device_lost -> mesh_reformed -> elastic_resume) is re-derivable
+    from events.jsonl alone, and the final factors are bitwise equal to
+    a fresh shrunk-mesh fit resumed from the same checkpoint."""
+    result = scenario.run_scenario(scenario.get_scenario("device-loss"))
+    assert result["passed"], result["assertions"]
+    f = result["facts"]
+    assert f["elastic_exit_code"] == 0
+    assert (f["device_lost_events"] == f["mesh_reformed_events"]
+            == f["elastic_resume_events"] == 1)
+    assert f["resume_from_checkpoint"] is True
+    assert f["factors_bitwise_equal"] is True
+
+
 # ---------------------------------------------------------------------------
 # degraded-mode serving, single process (ISSUE 6 satellite)
 

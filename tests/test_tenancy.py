@@ -100,6 +100,82 @@ def test_remove_tears_down_and_emits(_fresh):
                for e in _fresh._events)
 
 
+def test_register_is_publish_before_visible(monkeypatch):
+    """The churn invariant (PR 18): a tenant is never observable in the
+    registry before its engine's first publish completes, and a failed
+    publish leaves no zombie — the engine is stopped and the name is
+    immediately reusable."""
+    from tpu_als.serving.engine import ServingEngine
+
+    rng = np.random.default_rng(0)
+    U, V = _factors(rng)
+    reg = TenantRegistry()
+
+    seen = {}
+    real_publish = ServingEngine.publish
+
+    def spying_publish(self, *a, **kw):
+        seen["visible_during_publish"] = "a" in reg
+        return real_publish(self, *a, **kw)
+
+    monkeypatch.setattr(ServingEngine, "publish", spying_publish)
+    reg.register(TenantSpec(name="a"), U, V)
+    assert seen["visible_during_publish"] is False
+
+    stopped = {}
+    real_stop = ServingEngine.stop
+
+    def failing_publish(self, *a, **kw):
+        raise RuntimeError("boom: torn first publish")
+
+    def spying_stop(self, *a, **kw):
+        stopped["called"] = True
+        return real_stop(self, *a, **kw)
+
+    monkeypatch.setattr(ServingEngine, "publish", failing_publish)
+    monkeypatch.setattr(ServingEngine, "stop", spying_stop)
+    with pytest.raises(RuntimeError, match="torn first publish"):
+        reg.register(TenantSpec(name="b"), U, V)
+    assert "b" not in reg
+    assert stopped.get("called") is True
+
+    monkeypatch.setattr(ServingEngine, "publish", real_publish)
+    monkeypatch.setattr(ServingEngine, "stop", real_stop)
+    assert reg.register(TenantSpec(name="b"), U, V).name == "b"
+
+
+def test_tenant_churn_snapshots_always_servable():
+    """Register/remove churn on one name while a reader thread takes
+    registry snapshots: every tenant a snapshot ever exposes has a
+    published generation (``published_seq >= 1``), so the scheduler can
+    never pick up a tenant mid-construction."""
+    import threading
+
+    rng = np.random.default_rng(0)
+    U, V = _factors(rng, users=8, items=8, rank=4)
+    reg = TenantRegistry()
+    reg.register(TenantSpec(name="stable"), U, V)
+    bad, stop = [], threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            for t in reg.tenants():
+                if t.engine.published_seq < 1:
+                    bad.append(t.name)
+
+    r = threading.Thread(target=reader)
+    r.start()
+    try:
+        for _ in range(25):
+            reg.register(TenantSpec(name="churn"), U, V)
+            reg.remove("churn")
+    finally:
+        stop.set()
+        r.join()
+    assert not bad, f"snapshot exposed unpublished tenants: {bad}"
+    assert reg.names() == ("stable",)
+
+
 def test_same_shape_tenants_share_plan_entry():
     rng = np.random.default_rng(0)
     reg = TenantRegistry()
@@ -364,7 +440,8 @@ def test_tenant_isolation_scenario_registered():
 
     s = get_scenario("tenant-isolation")
     assert [p.name for p in s.phases] == [
-        "solo-baseline", "multi-tenant-start", "fault-storm", "judge"]
+        "solo-baseline", "multi-tenant-start", "fault-storm",
+        "tenant-churn", "judge"]
     checks = {a.check for a in s.assertions}
     assert {"b_topk_bitwise", "b_p99_under_slo", "b_zero_shed",
             "a_spike_shed", "a_quarantine_attributed",

@@ -770,6 +770,72 @@ def _pin_serve_comm_audit(a):
 
 # -- registry ---------------------------------------------------------------
 
+# -- elastic_disarmed -------------------------------------------------------
+
+def _build_elastic_disarmed():
+    import os
+
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_als.core.als import AlsConfig
+    from tpu_als.parallel.data import partition_balanced, shard_csr
+    from tpu_als.parallel.mesh import AXIS, make_mesh
+    from tpu_als.parallel.trainer import make_sharded_step
+    from tpu_als.resilience import elastic, faults
+
+    D = min(2, len(jax.devices()))
+    mesh = make_mesh(D)
+    gen = np.random.default_rng(0)
+    nU, nI, nnz = 24, 16, 200
+    u = gen.integers(0, nU, nnz)
+    i = gen.integers(0, nI, nnz)
+    r = gen.uniform(0.5, 5.0, nnz).astype(np.float32)
+    upart = partition_balanced(np.bincount(u, minlength=nU), D)
+    ipart = partition_balanced(np.bincount(i, minlength=nI), D)
+    ush = shard_csr(upart, ipart, u, i, r)
+    ish = shard_csr(ipart, upart, i, u, r)
+    cfg = AlsConfig(rank=4, max_iter=2)
+    leading = NamedSharding(mesh, P(AXIS))
+    ub = jax.device_put(ush.device_buckets(), leading)
+    ib = jax.device_put(ish.device_buckets(), leading)
+    U0 = jax.device_put(
+        np.zeros((upart.padded_rows, cfg.rank), np.float32), leading)
+    V0 = jax.device_put(
+        np.zeros((ipart.padded_rows, cfg.rank), np.float32), leading)
+
+    step = make_sharded_step(mesh, ush, ish, cfg)
+    disarmed = str(jax.make_jaxpr(step)(U0, V0, ub, ib))
+    # arm the detector's fault point (a schedule that never fires, so
+    # tracing completes) AND route tracing through the elastic wrapper —
+    # exactly what train_sharded(elastic=True) installs
+    spec_was = os.environ.get(faults.ENV_VAR)
+    os.environ[faults.ENV_VAR] = "mesh.device_lost=raise@nth=999999"
+    faults.install_from_env()
+    try:
+        wrapped = elastic.wrap_step(step, mesh)
+        armed = str(jax.make_jaxpr(wrapped)(U0, V0, ub, ib))
+    finally:
+        if spec_was is None:
+            os.environ.pop(faults.ENV_VAR, None)
+        else:
+            os.environ[faults.ENV_VAR] = spec_was
+        faults.install_from_env()
+    return {"disarmed": disarmed, "armed": armed}
+
+
+def _pin_elastic_disarmed(a):
+    _require(a["disarmed"] == a["armed"],
+             "arming the elastic device-loss detector changed the "
+             f"production step's jaxpr ({len(a['disarmed'])} vs "
+             f"{len(a['armed'])} chars) — the detector must stay a "
+             "host-level wrapper, never enter the traced graph")
+    return ("elastic-armed wrapped step jaxpr == raw step jaxpr "
+            f"({len(a['disarmed'])} chars)")
+
+
 _REGISTRY = {
     c.name: c for c in (
         Contract("ne_audit", _build_ne_audit, _pin_ne_audit,
@@ -801,6 +867,9 @@ _REGISTRY = {
         Contract("serve_comm_audit", _build_serve_comm_audit,
                  _pin_serve_comm_audit,
                  "tests/test_serve_fabric.py, PR 17"),
+        Contract("elastic_disarmed", _build_elastic_disarmed,
+                 _pin_elastic_disarmed,
+                 "tests/test_resilience.py, PR 18"),
     )
 }
 

@@ -111,6 +111,16 @@ PLAN_EVENTS = ("plan_resolved", "plan_probe", "plan_cache_hit",
 # silently voids the per-tenant SLO assertions without failing a test
 TENANT_PREFIXES = ("serving.", "live.")
 
+# the elastic-training recovery trail is a cross-process contract too:
+# the device-loss scenario (and any orchestrator watching events.jsonl)
+# re-derives the loss -> reform -> resume tree from exactly these
+# names, so a rename would green the scenario's zero-count assertions
+# instead of failing them.  Pinned declared AND emitted, the PLAN_EVENTS
+# discipline.
+ELASTIC_EVENTS = ("device_lost", "mesh_reformed", "elastic_resume")
+ELASTIC_SPANS = ("elastic.detect", "elastic.reform", "elastic.resume")
+ELASTIC_FAULT_POINT = "mesh.device_lost"
+
 
 def _load_standalone(name, relpath, repo):
     """Load one stdlib-only registry module by file path, bypassing the
@@ -161,6 +171,61 @@ def check_plan_vocabulary(repo=REPO):
                     f"tpu_als/plan/planner.py: never emits {name!r} — "
                     "the plan_* event trail is the warm-start test "
                     "contract (docs/planner.md)")
+    return errors
+
+
+def check_elastic_vocabulary(repo=REPO):
+    """The elastic recovery-trail contract: the three elastic events
+    declared in the schema AND emitted by the fit loop
+    (tpu_als/api/fitting.py), the ``mesh.device_lost`` fault point
+    declared AND consulted by the detector
+    (tpu_als/resilience/elastic.py), the three ``elastic.*`` trace
+    spans declared, and the ``train.reformations`` counter declared."""
+    schema, faults = load_registries(repo)
+    errors = []
+    for name in ELASTIC_EVENTS:
+        if name not in schema.EVENTS:
+            errors.append(
+                f"tpu_als/obs/schema.py: elastic event {name!r} is not "
+                "declared in EVENTS (the device-loss recovery trail "
+                f"pins all of {', '.join(ELASTIC_EVENTS)})")
+    fitting_py = os.path.join(repo, "tpu_als", "api", "fitting.py")
+    if os.path.exists(fitting_py):
+        with open(fitting_py, encoding="utf-8") as f:
+            text = f.read()
+        for name in ELASTIC_EVENTS:
+            if f'"{name}"' not in text:
+                errors.append(
+                    f"tpu_als/api/fitting.py: never emits {name!r} — "
+                    "the recovery trail is the device-loss scenario's "
+                    "contract (docs/resilience.md)")
+    for name in ELASTIC_SPANS:
+        if name not in getattr(schema, "TRACE_SPANS", ()):
+            errors.append(
+                f"tpu_als/obs/schema.py: trace span {name!r} is not "
+                "declared in TRACE_SPANS (the elastic recovery hops)")
+    if ELASTIC_FAULT_POINT not in faults.FAULT_POINTS:
+        errors.append(
+            "tpu_als/resilience/faults.py: fault point "
+            f"{ELASTIC_FAULT_POINT!r} is not declared in FAULT_POINTS "
+            "— deterministic device-loss injection is the elastic "
+            "test surface")
+    elastic_py = os.path.join(repo, "tpu_als", "resilience",
+                              "elastic.py")
+    if not os.path.exists(elastic_py):
+        errors.append("tpu_als/resilience/elastic.py: missing (the "
+                      "device-loss detector)")
+    else:
+        with open(elastic_py, encoding="utf-8") as f:
+            if f'"{ELASTIC_FAULT_POINT}"' not in f.read():
+                errors.append(
+                    "tpu_als/resilience/elastic.py: never consults the "
+                    f"declared {ELASTIC_FAULT_POINT!r} fault point")
+    if schema.METRICS.get("train.reformations", ("",))[0] != "counter":
+        errors.append(
+            "tpu_als/obs/schema.py: METRICS['train.reformations'] must "
+            "be a counter — the mesh-reformation tally "
+            "(docs/observability.md)")
     return errors
 
 
@@ -480,6 +545,7 @@ def main(argv=None):
         errors.extend(check_plan_vocabulary())
         errors.extend(check_tenant_vocabulary())
         errors.extend(check_trace_vocabulary())
+        errors.extend(check_elastic_vocabulary())
     nfiles = 0
     for path in py_files(paths):
         nfiles += 1

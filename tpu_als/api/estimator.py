@@ -215,7 +215,15 @@ class ALS(_ALSParams, Estimator):
     (``'off'``/``'warn'``/``'recover'``; ``None``, the default, inherits
     ``TPU_ALS_GUARDRAILS``): armed fits quarantine non-finite /
     out-of-range ratings instead of aborting, and ``'recover'`` adds the
-    sentinel / adaptive-solve / rollback ladder — docs/resilience.md.
+    sentinel / adaptive-solve / rollback ladder — docs/resilience.md;
+    ``elastic`` — single-process mesh fits: device loss becomes a
+    rescheduling event instead of a crash.  A failed step is
+    health-probed (``resilience.elastic``) into transient-retry vs
+    ``DeviceLost``; on loss the mesh re-forms on the surviving devices
+    and training resumes from the last atomic checkpoint (or from the
+    seed-deterministic init when no ``checkpointDir`` is set).  Off by
+    default — the detector adds nothing to the traced step either way
+    (the ``elastic_disarmed`` contract) — docs/resilience.md.
     """
 
     def __init__(self, *, mesh=None, gatherStrategy="all_gather",
@@ -223,9 +231,10 @@ class ALS(_ALSParams, Estimator):
                  fitCallback=None, fitCallbackInterval=1,
                  dataMode="replicated", cgIters=0, cgMode="matfree",
                  checkpointSharded=False, guardrails=None,
-                 **kwargs):
+                 elastic=False, **kwargs):
         super().__init__()
         self.mesh = mesh
+        self.elastic = bool(elastic)
         if guardrails is not None and guardrails not in ("off", "warn",
                                                          "recover"):
             raise ValueError(f"unknown guardrails mode {guardrails!r} "

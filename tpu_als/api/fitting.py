@@ -205,7 +205,104 @@ def fit_multiprocess(est, u_idx, i_idx, r, user_map, item_map, cfg,
 
 def fit_sharded(est, u_idx, i_idx, r, user_map, item_map, cfg,
                 init, start_iter):
-    """Single-process fit over a device mesh: balanced entity partitions,
+    """Single-process fit over a device mesh, with elastic recovery.
+
+    The happy path is one :func:`_fit_sharded_once` pass over
+    ``est.mesh``.  With ``est.elastic`` on, a mid-fit device loss (the
+    typed ``DeviceLost`` from the resilience.elastic detector) becomes a
+    rescheduling event instead of a crash: the epoch since the last
+    checkpoint is quarantined, the mesh re-forms on the surviving
+    devices, partitions/containers/shard plan are re-derived for the new
+    device count (the plan key carries it), and training re-enters the
+    shrunk ring from the last atomic checkpoint — or from the original
+    init when no checkpoint exists yet.  Each pass is deterministic
+    given (mesh size, init, start_iter), so the recovered run is
+    bitwise-identical to a fresh fit on the shrunk mesh resumed from the
+    same checkpoint (the device-loss scenario pins this).
+
+    Returns entity-space ``(U, V)``.
+    """
+    from tpu_als.resilience.elastic import DeviceLost
+
+    mesh = est.mesh
+    reforms = 0
+    max_reforms = int(mesh.devices.size) - 1  # can't shrink below 1
+    while True:
+        try:
+            return _fit_sharded_once(est, mesh, u_idx, i_idx, r,
+                                     user_map, item_map, cfg, init,
+                                     start_iter)
+        except DeviceLost as e:
+            if reforms >= max_reforms:
+                raise
+            reforms += 1
+            mesh, init, start_iter = _reform_and_resume(
+                est, mesh, e, cfg, user_map, item_map, init, start_iter)
+
+
+def _reform_and_resume(est, mesh, exc, cfg, user_map, item_map,
+                       orig_init, orig_start):
+    """One elastic recovery: emit the device-loss record, rebuild the
+    mesh from the survivors, and pick the resume point (last atomic
+    checkpoint if one matches this fit, else the original init — the
+    quarantined epoch is re-run in full).  Returns
+    ``(new_mesh, init, start_iter)`` for the next training pass.  The
+    event trail (``device_lost`` → ``mesh_reformed`` →
+    ``elastic_resume`` + the ``elastic.*`` trace spans) is the recovery
+    tree ``observe explain`` reconstructs from events.jsonl alone."""
+    from tpu_als import obs
+    from tpu_als.io.checkpoint import discover_resume, load_factors
+    from tpu_als.obs import tracing
+    from tpu_als.parallel.mesh import make_mesh
+
+    lost = sorted(set(exc.lost))
+    old = list(mesh.devices.flat)
+    surviving = [d for d in old if int(d.id) not in set(lost)]
+    if not surviving:
+        raise exc
+    obs.counter("train.reformations")
+    obs.emit("device_lost", iteration=exc.iteration, lost=lost,
+             surviving=len(surviving))
+    ctx = tracing.start_trace("elastic.detect", iteration=exc.iteration,
+                              lost=lost)
+    import jax
+
+    if jax.process_count() > 1:
+        # the cross-host barrier must re-form before any collective on
+        # the shrunk mesh (no-op single-process — every CPU test)
+        from tpu_als.parallel.multihost import rejoin
+
+        rejoin()
+    new_mesh = make_mesh(devices=surviving)
+    obs.emit("mesh_reformed", old_devices=len(old),
+             new_devices=len(surviving), lost=lost)
+    ctx = tracing.record_span(ctx, "elastic.reform",
+                              old_devices=len(old),
+                              new_devices=len(surviving))
+    init, start_iter, source, path = orig_init, orig_start, "scratch", None
+    if est.checkpointDir is not None:
+        path = discover_resume(est.checkpointDir)
+    if path is not None:
+        manifest, c_uids, c_U, c_iids, c_V = load_factors(path)
+        if (manifest.get("rank") == cfg.rank
+                and np.array_equal(c_uids, user_map.ids)
+                and np.array_equal(c_iids, item_map.ids)):
+            init = (c_U, c_V)
+            start_iter = int(manifest.get("iteration") or 0)
+            source = "checkpoint"
+        else:
+            path = None  # a foreign checkpoint is not this fit's state
+    extra = {"path": path} if source == "checkpoint" else {}
+    obs.emit("elastic_resume", iteration=start_iter, source=source,
+             devices=len(surviving), **extra)
+    tracing.record_span(ctx, "elastic.resume", iteration=start_iter,
+                        source=source)
+    return new_mesh, init, start_iter
+
+
+def _fit_sharded_once(est, mesh, u_idx, i_idx, r, user_map, item_map,
+                      cfg, init, start_iter):
+    """One training pass over ``mesh``: balanced entity partitions,
     per-strategy rating containers (with the degenerate-a2a -> all_gather
     fallback), traffic model bookkeeping, then ``train_sharded``.
 
@@ -220,8 +317,8 @@ def fit_sharded(est, u_idx, i_idx, r, user_map, item_map, cfg,
     )
 
     callback = est._checkpoint_callback(user_map, item_map)
-    D = est.mesh.devices.size
-    obs.update_manifest(mesh_shape=list(est.mesh.devices.shape),
+    D = mesh.devices.size
+    obs.update_manifest(mesh_shape=list(mesh.devices.shape),
                         mesh_devices=int(D))
     with obs.span("train.partition"):
         upart = partition_balanced(
@@ -300,10 +397,12 @@ def fit_sharded(est, u_idx, i_idx, r, user_map, item_map, cfg,
                 Ve = np.asarray(V)[ipart.slot]
             callback(iteration, Ue, Ve)
     with obs.span("train.fit", strategy=strategy):
-        Us, Vs = train_sharded(est.mesh, upart, ipart, ush, ish, cfg,
+        Us, Vs = train_sharded(mesh, upart, ipart, ush, ish, cfg,
                                callback=sharded_cb, init=init,
                                start_iter=start_iter, strategy=strategy,
-                               ring_counts=ring_counts)
+                               ring_counts=ring_counts,
+                               elastic=bool(getattr(est, "elastic",
+                                                    False)))
         U = np.asarray(Us)[upart.slot]
         V = np.asarray(Vs)[ipart.slot]
     return U, V

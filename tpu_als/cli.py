@@ -379,7 +379,8 @@ def cmd_train(args):
               checkpointDir=args.checkpoint_dir,
               checkpointInterval=args.checkpoint_interval,
               resumeFrom=_resolve_resume(args),
-              guardrails=args.guardrails)
+              guardrails=args.guardrails,
+              elastic=getattr(args, "elastic", False))
     print(f"training on {len(train):,} ratings "
           f"({len(test):,} held out)", file=sys.stderr)
     try:
@@ -496,7 +497,8 @@ def _train_multiprocess(args):
               checkpointDir=args.checkpoint_dir,
               checkpointInterval=args.checkpoint_interval,
               resumeFrom=_resolve_resume(args),
-              guardrails=args.guardrails)
+              guardrails=args.guardrails,
+              elastic=getattr(args, "elastic", False))
     ctx = contextlib.nullcontext()
     if args.profile_dir:
         from tpu_als.utils.observe import trace
@@ -1763,6 +1765,13 @@ def main(argv=None):
                         "and bounded rollback from the last-good factor "
                         "snapshot; default inherits TPU_ALS_GUARDRAILS "
                         "(unset = off)")
+    t.add_argument("--elastic", action="store_true",
+                   help="elastic mesh training (needs --devices > 1): "
+                        "device loss becomes a rescheduling event — a "
+                        "failed step is health-probed, the mesh re-forms "
+                        "on the surviving devices and training resumes "
+                        "from the last atomic checkpoint "
+                        "(docs/resilience.md)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("evaluate", help="score a dataset with a saved model",

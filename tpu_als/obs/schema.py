@@ -162,6 +162,11 @@ METRICS = {
         "micro-batches whose scoring raised, failed in isolation "
         "(labeled tenant=<name>: the failing tenant's tickets erred, "
         "every other tenant kept serving)"),
+    "train.reformations": (
+        "counter", "reformations",
+        "elastic mesh reformations: a mid-fit device loss was detected, "
+        "the ring re-formed on the surviving mesh and training resumed "
+        "from the last atomic checkpoint (resilience.elastic)"),
 }
 
 # metric name -> label keys its writers may attach.  Any key outside
@@ -221,6 +226,9 @@ TRACE_SPANS = (
     "live.foldin",        # folded into the touched factor rows
     "live.publish",       # rode an incremental publish_update
     "live.visible",       # its publish seq became score-path visible
+    "elastic.detect",     # a failed step was classified (probe verdict)
+    "elastic.reform",     # the mesh was rebuilt on the survivors
+    "elastic.resume",     # training re-entered from the checkpoint
 )
 
 # per-span outcome vocabulary; "ok" is the happy path, everything else
@@ -407,6 +415,24 @@ EVENTS = {
         "tenancy; `tpu_als observe explain` rebuilds the tree from "
         "these events alone (name in TRACE_SPANS, status in "
         "TRACE_STATUSES; seconds may be null for instantaneous hops)"),
+    "device_lost": (
+        ("iteration", "lost", "surviving"),
+        "the elastic detector classified a failed collective/ring step "
+        "as device loss: the health probe (bounded retry backoff) "
+        "exhausted on the named logical device ids; 'surviving' is how "
+        "many devices stay in the mesh (resilience.elastic)"),
+    "mesh_reformed": (
+        ("old_devices", "new_devices", "lost"),
+        "the mesh was rebuilt from the surviving logical device ids and "
+        "the shard plan / bucket schedule re-derived through the "
+        "planner for the new device count (api.fitting elastic "
+        "recovery)"),
+    "elastic_resume": (
+        ("iteration", "source", "devices"),
+        "training re-entered the (shrunk) ring at an iteration "
+        "boundary: from the last atomic checkpoint ('checkpoint', with "
+        "its path in an extra field) or from the seed-deterministic "
+        "init ('scratch' — the quarantined epoch is re-run in full)"),
     "plan_cache_miss": (
         ("key", "component", "reason"),
         "a plan component was not servable from the cache (reason: "

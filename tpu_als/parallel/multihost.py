@@ -97,6 +97,32 @@ def init_distributed(coordinator_address=None, num_processes=None,
     return jax.process_index(), jax.process_count()
 
 
+def rejoin(coordinator_address=None, num_processes=None, process_id=None,
+           retry_policy=None):
+    """Re-run the deployment rendezvous after an elastic mesh
+    reformation (resilience.elastic → api.fitting recovery).
+
+    Single-process deployments (every CPU test, and the single-host
+    mesh path the elastic recovery currently drives) are a no-op —
+    there is no cross-host barrier to re-form.  Multi-process: tear
+    down the distributed client and rendezvous again with the
+    survivors' coordinates, under the same retried
+    :func:`init_distributed` discipline (the coordinator may itself be
+    restarting).  Returns ``(process_index, process_count)``.
+    """
+    if jax.process_count() <= 1 and coordinator_address is None \
+            and os.environ.get("JAX_COORDINATOR_ADDRESS") is None:
+        return jax.process_index(), jax.process_count()
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass  # a dead peer may have already torn the client down
+    return init_distributed(coordinator_address=coordinator_address,
+                            num_processes=num_processes,
+                            process_id=process_id,
+                            retry_policy=retry_policy)
+
+
 def _triples_digest(u, i, r):
     """Order-independent int64 digest of (u, i, r) triples: blake2b over
     the lexicographically sorted rows.  Used to detect identical per-host

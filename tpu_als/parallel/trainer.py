@@ -31,6 +31,7 @@ from tpu_als.core.ratings import trainer_chunk
 from tpu_als.ops.solve import compute_yty
 from tpu_als.parallel.mesh import AXIS, shard_map
 from tpu_als.resilience import faults
+from tpu_als.resilience.elastic import DeviceLost
 
 
 #: THE authoritative gather-strategy table.  The CLI's
@@ -507,7 +508,7 @@ def stacked_counts(part, row_idx, vals=None, positive_only=False):
 def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
                   cfg: AlsConfig, callback=None, strategy="all_gather",
                   ring_counts=None, init=None, start_iter=0,
-                  gather_blocks=4):
+                  gather_blocks=4, elastic=False):
     """Distributed ALS training loop.  Returns slot-space (U, V) jax.Arrays
     sharded over ``mesh``; index with ``Partition.slot`` to get entity rows.
 
@@ -523,6 +524,14 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
     ``init``: optional entity-space ``(U0, V0)`` warm start (checkpoint
     resume, SURVEY.md §5.3); rows are scattered into slot space here.
     Resumes at ``start_iter``, running the remaining iterations.
+
+    ``elastic=True`` wraps the jitted step with the host-level device-
+    loss detector (resilience.elastic.wrap_step): a failed step is
+    health-probed into transient-retry-in-place vs the typed
+    ``DeviceLost`` (stamped with the failing iteration), which
+    ``api.fitting.fit_sharded`` converts into mesh re-formation.  The
+    wrapper never enters the traced graph, so the step jaxpr is
+    byte-identical either way (the ``elastic_disarmed`` contract).
     """
     leading = NamedSharding(mesh, P(AXIS))
     with obs.span("train.stage", strategy=strategy):
@@ -578,13 +587,21 @@ def train_sharded(mesh, user_part, item_part, user_sharded, item_sharded,
         else:
             step = make_sharded_step(mesh, user_sharded, item_sharded, cfg)
             args = (ub, ib)
+    if elastic:
+        from tpu_als.resilience import elastic as _elastic
+        step = _elastic.wrap_step(step, mesh)
     for it in range(start_iter, cfg.max_iter):
         # dispatch time unless the callback (or donation pressure)
         # blocks — the per-iteration wall clock lives in the CLI's
         # iteration events; this span pins compile+dispatch outliers
         with obs.span("train.iteration", iteration=it + 1,
                       strategy=strategy):
-            U, V = step(U, V, *args)
+            try:
+                U, V = step(U, V, *args)
+            except DeviceLost as e:
+                if e.iteration is None:
+                    e.iteration = it + 1   # stamp the failing iteration
+                raise
             if callback is not None:
                 callback(it + 1, U, V)
     return U, V

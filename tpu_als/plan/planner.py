@@ -92,7 +92,15 @@ def shape_class(n_users=None, n_items=None, nnz=None):
     return f"u{b(n_users)}.i{b(n_items)}.nnz{b(nnz)}"
 
 
-def plan_key(*, rank, dtype, shape_class="generic", mesh_shape=None):
+def plan_key(*, rank, dtype, shape_class="generic", mesh_shape=None,
+             device_count=None):
+    # device_count is its own key component (default: the mesh_shape
+    # product) so elastic reformation — same mesh RANK, fewer devices —
+    # re-derives the shard plan instead of replaying a stale entry
+    if device_count is None and mesh_shape:
+        device_count = 1
+        for n in mesh_shape:
+            device_count *= int(n)
     return {
         "device_kind": _device_kind(),
         "jax_version": plan_cache._jax_version(),
@@ -100,14 +108,17 @@ def plan_key(*, rank, dtype, shape_class="generic", mesh_shape=None):
         "dtype": str(dtype),
         "shape_class": shape_class,
         "mesh_shape": list(mesh_shape) if mesh_shape else None,
+        "device_count": int(device_count) if device_count else None,
     }
 
 
 def _key_str(key):
     mesh = key.get("mesh_shape")
+    dc = key.get("device_count")
     return (f"{key['device_kind']}|jax{key['jax_version']}"
             f"|r{key['rank']}|{key['dtype']}|{key['shape_class']}"
-            f"|mesh{'x'.join(map(str, mesh)) if mesh else '-'}")
+            f"|mesh{'x'.join(map(str, mesh)) if mesh else '-'}"
+            f"|D{dc if dc else '-'}")
 
 
 def _summ(resolved):

@@ -12,6 +12,12 @@ pre-probe phase):
 - :mod:`tpu_als.resilience.preempt` — SIGTERM/SIGINT → graceful
   checkpoint-and-exit (:data:`EXIT_PREEMPTED`) for spot/preemptible
   capacity.
+- :mod:`tpu_als.resilience.elastic` — device loss as a rescheduling
+  event: a failed collective/ring step is health-probed (bounded retry
+  backoff) into "transient, retry in place" vs the typed
+  :class:`DeviceLost`, which the elastic fit loop turns into ring
+  re-formation on the surviving mesh from the last atomic checkpoint.
+  (Module-level jax-free; jax loads lazily inside the probe.)
 
 Degraded-mode serving lives in :mod:`tpu_als.parallel.serve` (it needs
 jax) but its typed error is re-exported here for one-stop handling.
@@ -26,8 +32,14 @@ from tpu_als.resilience.faults import (
     InjectedFault,
 )
 from tpu_als.resilience import faults
+from tpu_als.resilience.elastic import (
+    DeviceLost,
+    ProbeFailed,
+)
+from tpu_als.resilience import elastic
 from tpu_als.resilience.preempt import (
     EXIT_PREEMPTED,
+    PreemptAtError,
     Preempted,
     PreemptionGuard,
 )
@@ -41,15 +53,19 @@ from tpu_als.resilience.retry import (
 
 __all__ = [
     "AttemptTimeout",
+    "DeviceLost",
     "EXIT_PREEMPTED",
     "FAULT_POINTS",
     "FAULT_SPEC_ENV",
     "FaultSpecError",
     "InjectedFault",
+    "PreemptAtError",
     "Preempted",
     "PreemptionGuard",
+    "ProbeFailed",
     "RetryExhausted",
     "RetryPolicy",
+    "elastic",
     "faults",
     "preempt",
     "retry_call",

@@ -1,0 +1,256 @@
+"""Elastic mesh training: device loss becomes a rescheduling event.
+
+The ring substrate addresses peers by logical device id and the
+resilience stack already does preempt → atomic checkpoint → resume
+(ROADMAP item 4); this module composes them.  A failed collective or
+ring step is *classified* instead of aborting the run:
+
+1. **Detect** — :func:`wrap_step` (installed by
+   ``parallel.trainer.train_sharded`` when elastic training is on)
+   catches the step failure on the host side, outside the traced graph,
+   so the production step's jaxpr is byte-identical with the detector
+   on or off (the ``elastic_disarmed`` contract in
+   ``analysis/contracts.py``).
+2. **Classify** — :func:`classify` health-probes every mesh device with
+   a bounded :mod:`tpu_als.resilience.retry` backoff.  A peer that
+   fails every probe attempt is DEAD (`RetryExhausted`); a step failure
+   with every peer probing healthy is a transient ICI hiccup, retried
+   in place up to ``max_transient`` times.
+3. **Reschedule** — a dead peer surfaces as the typed
+   :class:`DeviceLost`, which ``api.fitting.fit_sharded`` converts into
+   a mesh reformation: quarantine the epoch, rebuild the mesh from the
+   surviving logical device ids, re-derive the shard plan through the
+   planner (the plan key carries the device count), reshard the factor
+   tables from the last atomic checkpoint, and re-enter the (shrunk)
+   ring at an iteration boundary — the PreemptionGuard discipline, so
+   recovery is bitwise-reproducible from the checkpoint.
+
+Deterministic injection: the ``mesh.device_lost`` fault point
+(``TPU_ALS_FAULT_SPEC``).  ``corrupt`` mode kills a device — the
+victim (``TPU_ALS_LOST_DEVICE``, default the highest logical id) is
+marked lost in this module's registry, so the health probe confirms a
+dead peer without real hardware dying; ``raise`` mode injects a step
+failure with every peer healthy, exercising the transient-retry path.
+The registry also lets CPU tests simulate loss directly
+(:func:`mark_lost` / :func:`clear_lost`).
+
+Module-level imports are stdlib + sibling resilience modules only; jax
+loads lazily inside the probe so ``scenario list`` and the jax-free
+tooling stay instant.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+from tpu_als.resilience import faults
+from tpu_als.resilience.retry import (
+    RetryExhausted,
+    RetryPolicy,
+    retry_call,
+)
+
+#: logical device index (into the mesh's flat device order) that
+#: ``mesh.device_lost`` corrupt mode kills; default: the last device.
+ENV_LOST_DEVICE = "TPU_ALS_LOST_DEVICE"
+
+FAULT_POINT = "mesh.device_lost"
+
+
+class DeviceLost(RuntimeError):
+    """A mesh peer is dead: the health probe exhausted its retry budget
+    on the named logical device ids.  The elastic fit loop catches this
+    and re-forms the ring on the survivors; without elastic training it
+    propagates — device loss stays a hard failure unless opted into."""
+
+    def __init__(self, lost, surviving=None, iteration=None):
+        self.lost = tuple(int(d) for d in lost)
+        self.surviving = surviving
+        self.iteration = iteration
+        super().__init__(
+            f"device(s) {list(self.lost)} unreachable after probe "
+            f"retries exhausted; {surviving} device(s) surviving")
+
+
+class ProbeFailed(OSError):
+    """One health-probe attempt against one device failed.  Subclasses
+    ``OSError`` so the retry policy classifies it as transient — only
+    a FULL budget of failed probes (``RetryExhausted``) marks the
+    device dead."""
+
+
+# -- simulated-loss registry -------------------------------------------------
+# CPU tests (and the corrupt-mode fault point) mark devices lost here;
+# the health probe consults it before touching real hardware, so the
+# whole detect → classify → reform protocol is exercisable on an
+# 8-device CPU mesh.
+
+_lost = set()
+_lock = threading.Lock()
+
+
+def mark_lost(*device_ids):
+    """Mark logical device ids as dead for the health probe."""
+    with _lock:
+        _lost.update(int(d) for d in device_ids)
+
+
+def lost_devices():
+    """Frozen snapshot of the simulated-lost logical device ids."""
+    with _lock:
+        return frozenset(_lost)
+
+
+def clear_lost():
+    """Forget every simulated loss (tests; between scenario phases)."""
+    with _lock:
+        _lost.clear()
+
+
+def _victim_index(n_devices, environ=None):
+    """Which flat mesh position corrupt mode kills: the validated
+    ``TPU_ALS_LOST_DEVICE`` value, default the last position."""
+    raw = (environ if environ is not None else os.environ).get(
+        ENV_LOST_DEVICE)
+    if not raw:
+        return n_devices - 1
+    try:
+        idx = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{ENV_LOST_DEVICE}={raw!r} is not an integer mesh "
+            "position") from None
+    if not 0 <= idx < n_devices:
+        raise ValueError(
+            f"{ENV_LOST_DEVICE}={idx} out of range for a "
+            f"{n_devices}-device mesh")
+    return idx
+
+
+# -- health probe ------------------------------------------------------------
+
+
+def default_probe_policy():
+    """The bounded backoff that separates a hiccup from a corpse: a few
+    fast attempts per device.  Deterministic-jitter under
+    ``TPU_ALS_TRACE`` (RetryPolicy default), so a traced recovery
+    replays its probe schedule byte-identically."""
+    return RetryPolicy(max_attempts=3, base_delay=0.01, factor=2.0,
+                       max_delay=0.25, jitter=0.25,
+                       retry_on=(OSError, TimeoutError))
+
+
+def _probe_device(device):
+    """One probe attempt: a trivial round-trip computation pinned to
+    ``device``.  Simulated-lost devices fail unconditionally; a real
+    device that cannot complete the round-trip raises the retryable
+    :class:`ProbeFailed`."""
+    if int(device.id) in lost_devices():
+        raise ProbeFailed(
+            f"device {int(device.id)} is marked lost")
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        x = jax.device_put(jnp.ones((8,), jnp.float32), device)
+        ok = bool(jax.block_until_ready(x.sum()) == 8.0)
+    except Exception as e:   # noqa: BLE001 — any failure is the signal
+        raise ProbeFailed(
+            f"device {int(device.id)} probe raised "
+            f"{type(e).__name__}: {e}") from e
+    if not ok:
+        raise ProbeFailed(
+            f"device {int(device.id)} returned a wrong probe value")
+
+
+def classify(devices, policy=None):
+    """Probe every device; returns the tuple of DEAD logical device ids
+    (empty == the failure was transient).  Each device gets the full
+    retry budget with backoff — the "is it a hiccup" question is asked
+    ``max_attempts`` times per peer, never once."""
+    from tpu_als import obs
+
+    policy = policy or default_probe_policy()
+    dead = []
+    with obs.span("elastic.probe", devices=len(tuple(devices))):
+        for d in devices:
+            try:
+                retry_call(_probe_device, d, policy=policy,
+                           what=f"elastic.probe:d{int(d.id)}")
+            except RetryExhausted:
+                dead.append(int(d.id))
+    return tuple(dead)
+
+
+def surviving_devices(mesh):
+    """The mesh's devices minus the simulated-lost set, in mesh order —
+    the device list the re-formed mesh is built from."""
+    lost = lost_devices()
+    return [d for d in mesh.devices.flat if int(d.id) not in lost]
+
+
+# -- the detector ------------------------------------------------------------
+
+
+def _step_failure_types():
+    """Exception classes a failed collective/ring step can surface as:
+    the injected fault types plus, when jax is loaded, the XLA runtime
+    error a REAL dead peer produces."""
+    types = [faults.InjectedFault, ProbeFailed, OSError]
+    jax_errors = getattr(sys.modules.get("jax"), "errors", None)
+    for name in ("JaxRuntimeError", "XlaRuntimeError"):
+        cls = getattr(jax_errors, name, None)
+        if isinstance(cls, type) and cls not in types:
+            types.append(cls)
+    return tuple(types)
+
+
+def wrap_step(step, mesh, policy=None, max_transient=2):
+    """Host-level elastic detector around a jitted training step.
+
+    Fires the ``mesh.device_lost`` fault point before each step
+    (corrupt = kill the victim device and fail the step; raise = a
+    transient failure with every peer healthy), then classifies any
+    step failure via the health probe: dead peers raise
+    :class:`DeviceLost`; transient failures are retried in place up to
+    ``max_transient`` times with the probe policy's backoff.
+
+    Purely host-side — the wrapped step's traced jaxpr is the raw
+    step's, byte for byte (the ``elastic_disarmed`` contract).
+    """
+    from tpu_als import obs
+
+    devices = tuple(mesh.devices.flat)
+    policy = policy or default_probe_policy()
+    failure_types = _step_failure_types()
+
+    def elastic_step(U, V, *args):
+        transient = 0
+        while True:
+            try:
+                mode = faults.check("mesh.device_lost")
+                if mode == "corrupt":
+                    victim = devices[_victim_index(len(devices))]
+                    mark_lost(int(victim.id))
+                    raise ProbeFailed(
+                        f"collective failed: peer {int(victim.id)} "
+                        "unreachable (injected device loss)")
+                return step(U, V, *args)
+            except failure_types as e:
+                with obs.span("elastic.classify"):
+                    dead = classify(devices, policy=policy)
+                if dead:
+                    raise DeviceLost(
+                        dead, surviving=len(devices) - len(dead)) from e
+                transient += 1
+                obs.emit("warning", what="elastic.transient",
+                         reason=f"step failure with all peers healthy "
+                                f"(attempt {transient}/{max_transient}):"
+                                f" {type(e).__name__}: {e}")
+                if transient > max_transient:
+                    raise
+                policy.sleep(policy.delay(transient - 1))
+
+    return elastic_step

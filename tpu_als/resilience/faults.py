@@ -39,6 +39,14 @@ Named fault points (the complete vocabulary — sites call
                           records; corrupt = the record's rating column is
                           rewritten to ``nan`` pre-parse, a genuinely
                           poisoned text record for the quarantine path)
+``mesh.device_lost``      per trainer iteration of the sharded strategies
+                          (host-level, armed only, around the jitted
+                          step — the comm.ring_step pattern; corrupt =
+                          a device DIES: the elastic registry marks the
+                          victim lost, so the health probe confirms a
+                          dead peer; raise = a transient ICI hiccup —
+                          the step fails once but every peer probes
+                          healthy, so the detector retries in place)
 ========================  ====================================================
 
 Spec grammar (``TPU_ALS_FAULT_SPEC`` env var, or :func:`install`)::
@@ -85,6 +93,7 @@ FAULT_POINTS = (
     "serving.score",
     "solve.gram",
     "ingest.record",
+    "mesh.device_lost",
 )
 
 MODES = ("raise", "corrupt", "hang")

@@ -17,7 +17,10 @@ those.
 
 ``TPU_ALS_PREEMPT_AT=N`` makes :func:`pending` fire at iteration N
 without any signal — deterministic "preemption" for tests where real
-kill timing races a fast CPU run.
+kill timing races a fast CPU run.  A malformed value is a configuration
+error, not a silent no-op: it raises the typed :class:`PreemptAtError`
+at arm time (``PreemptionGuard.__enter__``) and at every poll, matching
+the ``TPU_ALS_FAULT_SPEC`` fail-loud convention.
 """
 
 from __future__ import annotations
@@ -30,6 +33,36 @@ import threading
 EXIT_PREEMPTED = 43
 
 ENV_PREEMPT_AT = "TPU_ALS_PREEMPT_AT"
+
+
+class PreemptAtError(ValueError):
+    """``TPU_ALS_PREEMPT_AT`` is set but not a positive integer.
+
+    A deterministic-preemption knob that silently fails to fire is the
+    worst kind of chaos tooling — the test passes because nothing was
+    injected.  Fail loud instead, the ``TPU_ALS_FAULT_SPEC`` way."""
+
+
+def preempt_at(environ=None):
+    """The validated ``TPU_ALS_PREEMPT_AT`` value: ``None`` when unset
+    or empty, the iteration as an int otherwise.  Raises
+    :class:`PreemptAtError` on a malformed value."""
+    at = (environ if environ is not None else os.environ).get(
+        ENV_PREEMPT_AT)
+    if not at:
+        return None
+    try:
+        n = int(at)
+    except ValueError:
+        raise PreemptAtError(
+            f"{ENV_PREEMPT_AT}={at!r} is not an integer — the "
+            "deterministic preemption knob takes an iteration number "
+            "(e.g. TPU_ALS_PREEMPT_AT=3)") from None
+    if n < 1:
+        raise PreemptAtError(
+            f"{ENV_PREEMPT_AT}={at!r} must be >= 1 (iterations are "
+            "1-based)")
+    return n
 
 
 class Preempted(SystemExit):
@@ -91,6 +124,7 @@ class PreemptionGuard:
         self._installed = False
 
     def __enter__(self):
+        preempt_at()   # arm-time validation: fail loud, not silent
         if threading.current_thread() is threading.main_thread():
             for s in self.signals:
                 self._saved[s] = signal.signal(s, self._handler)
@@ -130,7 +164,7 @@ def enabled():
     installed or the deterministic test knob is set.  Trainers use this
     to decide whether their loop needs a preemption-aware callback."""
     return (PreemptionGuard._active is not None
-            or bool(os.environ.get(ENV_PREEMPT_AT)))
+            or preempt_at() is not None)
 
 
 def pending(iteration=None):
@@ -144,13 +178,9 @@ def pending(iteration=None):
     if g is not None and g.triggered():
         return True
     if iteration is not None:
-        at = os.environ.get(ENV_PREEMPT_AT)
-        if at:
-            try:
-                if int(at) == iteration:
-                    if g is not None:
-                        g.trigger()
-                    return True
-            except ValueError:
-                pass
+        at = preempt_at()
+        if at is not None and at == iteration:
+            if g is not None:
+                g.trigger()
+            return True
     return False

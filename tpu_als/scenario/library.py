@@ -32,6 +32,11 @@ into one assertable run each:
                          (torn publish, poisoned stream, rollback, 10×
                          spike) while tenant B's top-k stays bitwise
                          equal to its solo run, in SLO, zero shed.
+``device-loss``          elastic training: a device dies mid-fit, the
+                         ring re-forms on the survivors and resumes from
+                         the last atomic checkpoint; the final factors
+                         are bitwise equal to a fresh shrunk-mesh fit
+                         resumed from the same checkpoint.
 
 All run on CPU in seconds (they are tier-1 tests via
 tests/test_scenarios.py) and bank ``BENCH_scenario_<name>.json`` on
@@ -1295,6 +1300,51 @@ def _ti_storm(ctx):
                      b_hard_failures=len(b_errors))
 
 
+def _ti_churn(ctx):
+    """Tenant churn under load: register/remove a short-lived tenant C
+    through the live front door while B keeps serving.  The registry's
+    publish-before-visible discipline is watched from a snapshot
+    thread — no snapshot may ever expose a tenant without a published
+    generation — and C must be servable the instant it IS visible."""
+    from tpu_als.tenancy import TenantSpec
+
+    c, s = ctx.config, ctx.state
+    eng = s["eng"]
+    rng = np.random.default_rng(c["seed"] + 7)
+    Uc = rng.normal(size=(16, c["rank"])).astype(np.float32)
+    Vc = rng.normal(size=(24, c["rank"])).astype(np.float32)
+    unpublished, stop = [], threading.Event()
+
+    def snapshotter():
+        while not stop.is_set():
+            for t in eng.registry.tenants():
+                if t.engine.published_seq < 1:
+                    unpublished.append(t.name)
+
+    watcher = threading.Thread(target=snapshotter,
+                               name="scenario-churn-watch", daemon=True)
+    watcher.start()
+    b_errors = 0
+    try:
+        for _ in range(c["churn_cycles"]):
+            eng.add_tenant(TenantSpec(name="c", k=c["k"]), Uc, Vc)
+            # servable the instant it is visible: its FIRST generation
+            # was published before the registry ever listed it
+            eng.recommend("c", 0, timeout=10.0)
+            for uid in s["uids"][:3]:
+                try:
+                    eng.recommend("b", int(uid), timeout=10.0)
+                except Exception:   # noqa: BLE001 — the judged bucket
+                    b_errors += 1
+            eng.remove_tenant("c")
+    finally:
+        stop.set()
+        watcher.join(5.0)
+    ctx.facts.update(churn_unpublished_snapshots=len(unpublished),
+                     churn_b_errors=b_errors,
+                     churn_final_tenants=len(eng.registry))
+
+
 def _ti_judge(ctx):
     """The isolation verdict, from B's answers and the labeled trail:
     B bitwise vs solo, B's tail and shed in budget, A's storm evidence
@@ -1341,7 +1391,7 @@ def _tenant_isolation():
                       n_queries=40, b_qps=80.0, b_slo_ms=500.0,
                       a_users=48, a_items=36, a_nnz=600,
                       a_max_queue=8, spike_submits=64,
-                      poison_events=3, good_events=8),
+                      poison_events=3, good_events=8, churn_cycles=5),
         phases=(
             Phase("solo-baseline", _ti_solo,
                   "tenant B alone: the bitwise reference answers"),
@@ -1351,6 +1401,9 @@ def _tenant_isolation():
             Phase("fault-storm", _ti_storm,
                   "spike + torn publish + poison + rollback, all on A, "
                   "under B's query load; drain before judging"),
+            Phase("tenant-churn", _ti_churn,
+                  "register/remove tenant C while B serves: no "
+                  "snapshot ever exposes an unpublished tenant"),
             Phase("judge", _ti_judge,
                   "B bitwise + SLO, A's evidence from the labeled "
                   "trail"),
@@ -1385,12 +1438,170 @@ def _tenant_isolation():
                       fact="a_live_published", op="==", value=True,
                       doc="A's live pipeline still published after the "
                           "poison"),
+            Assertion("churn_publish_before_visible", "fact",
+                      fact="churn_unpublished_snapshots", op="==",
+                      value=0,
+                      doc="no registry snapshot during churn exposed a "
+                          "tenant without a published generation"),
+            Assertion("churn_b_undisturbed", "fact",
+                      fact="churn_b_errors", op="==", value=0,
+                      doc="B served through every register/remove "
+                          "cycle of C"),
+            Assertion("churn_no_leak", "fact",
+                      fact="churn_final_tenants", op="==", value=2,
+                      doc="every churned C was fully torn down"),
             Assertion("quarantine_event", "event",
                       event="ingest_quarantined", op=">=", value=1),
             Assertion("sentinel_tripped", "event",
                       event="guardrail_tripped", op=">=", value=1),
             Assertion("rolled_back", "event", event="train_rollback",
                       op=">=", value=1),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# device-loss (elastic mesh training: loss -> reform -> resume, bitwise)
+
+
+def _dl_env(c):
+    """The forced-multi-device CPU environment every phase's CLI child
+    runs under (the elastic protocol needs a real mesh to shrink)."""
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": ("--xla_force_host_platform_device_count="
+                      f"{c['host_devices']}"),
+    }
+
+
+def _dl_train_args(c):
+    return ["train", "--data", c["data"], "--rank", str(c["rank"]),
+            "--reg-param", str(c["reg"]), "--seed", str(c["seed"])]
+
+
+def _dl_elastic(ctx):
+    import json
+
+    c = ctx.config
+    ckdir = os.path.join(ctx.workdir, "ck")
+    out = os.path.join(ctx.workdir, "elastic_model")
+    obsdir = os.path.join(ctx.workdir, "elastic_obs")
+    env = dict(_dl_env(c))
+    # deterministic loss: the nth traversal of the detector's fault
+    # point kills the victim device (corrupt mode = a dead peer the
+    # health probe confirms)
+    env["TPU_ALS_FAULT_SPEC"] = \
+        f"mesh.device_lost=corrupt@nth={c['lose_at']}"
+    p = _cli_subprocess(
+        _dl_train_args(c)
+        + ["--devices", str(c["devices"]), "--elastic",
+           "--max-iter", str(c["iters"]),
+           "--checkpoint-dir", ckdir, "--checkpoint-interval", "1",
+           "--output", out, "--obs-dir", obsdir],
+        env_extra=env)
+    ctx.facts["elastic_exit_code"] = p.returncode
+    ctx.state["elastic_stderr"] = p.stderr
+    by = {}
+    epath = os.path.join(obsdir, "events.jsonl")
+    if os.path.isfile(epath):
+        with open(epath) as f:
+            for line in f:
+                e = json.loads(line)
+                by.setdefault(e["type"], []).append(e)
+    # the recovery tree must be re-derivable from events.jsonl alone
+    ctx.facts["device_lost_events"] = len(by.get("device_lost", ()))
+    ctx.facts["mesh_reformed_events"] = len(by.get("mesh_reformed", ()))
+    ctx.facts["elastic_resume_events"] = len(
+        by.get("elastic_resume", ()))
+    res = (by.get("elastic_resume") or [{}])[0]
+    ctx.facts["resume_from_checkpoint"] = res.get("source") == "checkpoint"
+    ctx.state["resume_iteration"] = int(res.get("iteration") or 0)
+
+
+def _dl_reference(ctx):
+    """The recovery's ground truth, built WITHOUT any fault: the same
+    fit stopped at the elastic run's resume iteration reproduces the
+    checkpoint it recovered from (ALS iterations are max_iter-
+    independent), then a FRESH fit on the shrunk mesh resumes from it."""
+    c = ctx.config
+    env = _dl_env(c)
+    refck = os.path.join(ctx.workdir, "refck")
+    out = os.path.join(ctx.workdir, "reference_model")
+    it = ctx.state["resume_iteration"]
+    survivors = c["devices"] - 1   # corrupt mode kills ONE device
+    args = _dl_train_args(c)
+    p = _cli_subprocess(
+        args + ["--devices", str(c["devices"]), "--max-iter", str(it),
+                "--checkpoint-dir", refck, "--checkpoint-interval", "1"],
+        env_extra=env)
+    ctx.facts["reference_prefix_exit"] = p.returncode
+    p = _cli_subprocess(
+        args + ["--devices", str(survivors),
+                "--max-iter", str(c["iters"]),
+                "--resume", os.path.join(refck, "als_checkpoint"),
+                "--output", out],
+        env_extra=env)
+    ctx.facts["reference_exit_code"] = p.returncode
+    ctx.state["reference_stderr"] = p.stderr
+
+
+def _dl_judge(ctx):
+    a = os.path.join(ctx.workdir, "elastic_model")
+    b = os.path.join(ctx.workdir, "reference_model")
+    eq = True
+    for side in ("user_factors.npz", "item_factors.npz"):
+        pa, pb = os.path.join(a, side), os.path.join(b, side)
+        if not (os.path.isfile(pa) and os.path.isfile(pb)):
+            eq = False
+            break
+        fa, fb = np.load(pa), np.load(pb)
+        eq = (eq and np.array_equal(fa["factors"], fb["factors"])
+              and np.array_equal(fa["ids"], fb["ids"]))
+    ctx.facts["factors_bitwise_equal"] = bool(eq)
+
+
+def _device_loss():
+    return ScenarioSpec(
+        name="device-loss",
+        doc="elastic mesh training: a device dies mid-fit (injected "
+            "mesh.device_lost), the health probe confirms a dead peer, "
+            "the ring re-forms on the surviving mesh and training "
+            "resumes from the last atomic checkpoint; the run completes "
+            "and the final factors are BITWISE equal to a fresh "
+            "shrunk-mesh fit resumed from the same checkpoint.",
+        defaults=dict(data="synthetic:80x40x1500", rank=4, iters=5,
+                      reg=0.05, seed=7, devices=4, host_devices=8,
+                      lose_at=3),
+        phases=(
+            Phase("elastic-train", _dl_elastic,
+                  "device dies at iteration $lose_at; the fit recovers "
+                  "and completes"),
+            Phase("reference", _dl_reference,
+                  "fault-free shrunk-mesh fit resumed from the same "
+                  "checkpoint"),
+            Phase("judge", _dl_judge,
+                  "bitwise-compare the two models' factor tables"),
+        ),
+        assertions=(
+            Assertion("elastic_exit_0", "fact",
+                      fact="elastic_exit_code", op="==", value=0,
+                      doc="device loss is a rescheduling event, not a "
+                          "crash"),
+            Assertion("one_device_lost_event", "fact",
+                      fact="device_lost_events", op="==", value=1),
+            Assertion("one_mesh_reformed_event", "fact",
+                      fact="mesh_reformed_events", op="==", value=1),
+            Assertion("one_elastic_resume_event", "fact",
+                      fact="elastic_resume_events", op="==", value=1),
+            Assertion("resumed_from_checkpoint", "fact",
+                      fact="resume_from_checkpoint", op="==", value=True),
+            Assertion("reference_exit_0", "fact",
+                      fact="reference_exit_code", op="==", value=0),
+            Assertion("factors_bitwise_equal", "fact",
+                      fact="factors_bitwise_equal", op="==", value=True,
+                      doc="recovery is restart-from-factors of a "
+                          "deterministic iteration — anything weaker "
+                          "than array_equal would hide divergence"),
         ),
     )
 
@@ -1409,6 +1620,7 @@ _BUILDERS = (
     _poisoned_stream,
     _continuous_freshness,
     _tenant_isolation,
+    _device_loss,
 )
 
 SCENARIOS = {s.name: s for s in (b() for b in _BUILDERS)}

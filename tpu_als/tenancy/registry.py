@@ -145,38 +145,59 @@ class TenantRegistry:
 
     def __init__(self):
         self._tenants = {}
+        self._reserved = set()
         self._lock = threading.Lock()
 
     # -- membership ---------------------------------------------------
     def register(self, spec, U, V, *, item_valid=None, quantize=True):
         """Admit one tenant and publish its initial factors.  Returns
         the :class:`Tenant`.  Raises :class:`DuplicateTenant` on a name
-        collision, ``ValueError`` on a malformed spec."""
+        collision, ``ValueError`` on a malformed spec.
+
+        Publish-before-visible: the name is only *reserved* while the
+        engine is built and its first generation published; the tenant
+        enters the registry (scheduler snapshots, ``get``/``remove``)
+        strictly AFTER the publish succeeds.  A reader can therefore
+        never observe a registered tenant without a servable model, and
+        a failed publish leaves nothing behind but a released
+        reservation — no zombie tenant to ``remove``."""
         import numpy as np
 
         from tpu_als import plan as _plan
         from tpu_als.serving.engine import ServingEngine
 
-        U = np.asarray(U, dtype=np.float32)
-        V = np.asarray(V, dtype=np.float32)
-        tplan = _plan.resolve_tenant_plan(
-            rank=U.shape[1], n_users=U.shape[0], n_items=V.shape[0],
-            requested_buckets=spec.buckets)
-        engine = ServingEngine(
-            k=spec.k, buckets=tplan["buckets"],
-            shortlist_k=spec.shortlist_k, max_queue=spec.max_queue,
-            max_wait_s=spec.max_wait_s,
-            default_deadline_s=spec.default_deadline_s,
-            slo_s=spec.slo_s, flight_capacity=spec.flight_capacity,
-            tenant=spec.name)
-        tenant = Tenant(spec=spec, engine=engine,
-                        shape_class=tplan["shape_class"])
         with self._lock:
-            if spec.name in self._tenants:
+            if spec.name in self._tenants or spec.name in self._reserved:
                 raise DuplicateTenant(spec.name)
+            self._reserved.add(spec.name)
+        engine = None
+        try:
+            U = np.asarray(U, dtype=np.float32)
+            V = np.asarray(V, dtype=np.float32)
+            tplan = _plan.resolve_tenant_plan(
+                rank=U.shape[1], n_users=U.shape[0], n_items=V.shape[0],
+                requested_buckets=spec.buckets)
+            engine = ServingEngine(
+                k=spec.k, buckets=tplan["buckets"],
+                shortlist_k=spec.shortlist_k, max_queue=spec.max_queue,
+                max_wait_s=spec.max_wait_s,
+                default_deadline_s=spec.default_deadline_s,
+                slo_s=spec.slo_s, flight_capacity=spec.flight_capacity,
+                tenant=spec.name)
+            engine.publish(U, V, item_valid=item_valid,
+                           quantize=quantize)
+            tenant = Tenant(spec=spec, engine=engine,
+                            shape_class=tplan["shape_class"])
+        except BaseException:
+            if engine is not None:
+                engine.stop()
+            with self._lock:
+                self._reserved.discard(spec.name)
+            raise
+        with self._lock:
+            self._reserved.discard(spec.name)
             self._tenants[spec.name] = tenant
             n_now = len(self._tenants)
-        engine.publish(U, V, item_valid=item_valid, quantize=quantize)
         obs.gauge("tenancy.tenants", n_now)
         obs.emit("tenant_registered", tenant=spec.name,
                  users=int(U.shape[0]), items=int(V.shape[0]),

@@ -1,0 +1,78 @@
+#!/bin/bash
+# Soak smoke: the production-week subsystem's CI gate, CPU-only (no
+# accelerator, no network).  Five stages, fail-fast:
+#
+#   1. the soak test tier — traffic determinism (byte-for-byte across a
+#      process boundary), zipf/diurnal sanity, chaos-schedule LIFO
+#      arming, rotation read-back, the events-only verdict (including
+#      the poisoned-jax standalone pin), and the compressed in-process
+#      soak e2e (tests/test_soak.py),
+#   2. the static checks — the obs-schema shim (the soak vocabulary —
+#      soak_start/soak_window/soak_injection/soak_verdict events, the
+#      soak.* metrics, and verdict.py's zero-tpu_als-import contract —
+#      is pinned by analysis/vocab.py's check_soak_vocabulary) plus the
+#      analysis gate (scripts/lint_smoke.sh),
+#   3. the production week END TO END via the scenario harness
+#      (`tpu_als scenario run production-week`): zipfian/diurnal
+#      traffic over two tenants, live fold-in, periodic refit, all six
+#      chaos injections (torn publish, poisoned refit, solver rollback,
+#      tenant churn, preempt, device loss) observed AND recovered, and
+#      the verdict re-derived by a SUBPROCESS running verdict.py
+#      against the dumped events.jsonl alone,
+#   4. the real CLI under a small rotation bound: `tpu_als soak` writes
+#      a rotated obs trail and banks BENCH_soak_cpu.json; the
+#      standalone verdict and `observe summarize --window` then read
+#      the rotated trail back,
+#   5. the bench regression gate (scripts/bench_gate.sh): the soak
+#      subsystem must not regress the headline perf path.
+#
+# Usage: scripts/soak_smoke.sh   (from the repo root; ~6 min on CPU)
+set -u
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+fail=0
+tmp="$(mktemp -d -t tpu_als_soak_smoke.XXXXXX)"
+trap 'rm -rf "$tmp"' EXIT
+
+echo "== soak smoke 1/5: soak test tier =="
+python -m pytest tests/test_soak.py -q -m 'not slow' \
+    -p no:cacheprovider || fail=1
+
+echo "== soak smoke 2/5: static checks (obs schema + analysis gate) =="
+python scripts/check_obs_schema.py || fail=1
+scripts/lint_smoke.sh || fail=1
+
+echo "== soak smoke 3/5: production-week scenario (end to end) =="
+# soak + judge phases; the judge phase re-runs tpu_als/soak/verdict.py
+# in a subprocess against the dumped trail and asserts the verdicts
+# match (tpu_als/scenario/library.py)
+python -m tpu_als.cli scenario run production-week || fail=1
+
+echo "== soak smoke 4/5: CLI soak + rotated-trail re-derivation =="
+# a tight rotation bound forces events.00N.jsonl rotations mid-soak;
+# the standalone verdict and the summarize slicer must read them back
+TPU_ALS_OBS_ROTATE_BYTES=60000 python -m tpu_als.cli soak \
+    --windows 6 --window-s 1.0 --base-qps 25 --update-qps 12 \
+    --no-subprocess-chaos --obs-dir "$tmp/run" \
+    --bench-json "$tmp/BENCH_soak_cpu.json" || fail=1
+python tpu_als/soak/verdict.py "$tmp/run" || fail=1
+python -m tpu_als.cli observe summarize "$tmp/run" --window 1:4 \
+    >/dev/null || fail=1
+python - "$tmp/BENCH_soak_cpu.json" <<'EOF' || fail=1
+import json, sys
+rec = json.load(open(sys.argv[1]))
+assert rec["metric"] == "soak_survived_minutes" and rec["passed"], rec
+assert "+00:00" in rec["banked_at"], rec["banked_at"]
+print(f"banked: {rec['value']} survived-minutes "
+      f"({rec['recoveries']}/{rec['injections']} recovered)")
+EOF
+
+echo "== soak smoke 5/5: bench regression gate =="
+scripts/bench_gate.sh || fail=1
+
+if [ "$fail" -ne 0 ]; then
+    echo "soak smoke: FAIL" >&2
+    exit 1
+fi
+echo "soak smoke: OK"

@@ -921,6 +921,11 @@ def main(argv=None):
         for msg in vocab.check_tenant_vocabulary(REPO):
             path, _, rest = msg.partition(": ")
             findings.append(Finding(path, 1, "unregistered-name", rest))
+        # and the production-week soak trail: soak_* events declared
+        # AND emitted, soak.* metric kinds, stdlib-only verdict
+        for msg in vocab.check_soak_vocabulary(REPO):
+            path, _, rest = msg.partition(": ")
+            findings.append(Finding(path, 1, "unregistered-name", rest))
 
     baseline_path = None if args.baseline == "none" else args.baseline
     if args.write_baseline:

@@ -1539,12 +1539,14 @@ def cmd_observe(args):
 
     try:
         if args.action == "summarize":
-            print(report.cmd_summarize(args.run_dir, as_json=args.as_json))
+            print(report.cmd_summarize(args.run_dir, as_json=args.as_json,
+                                       since=args.since,
+                                       window=args.window))
         else:
             print(report.cmd_tail(args.run_dir, n=args.lines,
                                   event=args.event, tenant=args.tenant,
                                   trace=args.trace))
-    except FileNotFoundError as err:
+    except (FileNotFoundError, ValueError) as err:
         raise SystemExit(str(err))
 
 
@@ -1585,6 +1587,44 @@ def cmd_scenario(args):
         print(json.dumps(result, default=str))
     if args.bench_json:
         scenario.bank_result(result, args.bench_json)
+        print(f"banked {args.bench_json}", file=sys.stderr)
+    if not result["passed"]:
+        raise SystemExit(1)
+
+
+def cmd_soak(args):
+    """Run the production-week soak (tpu_als.soak): seeded zipfian/
+    diurnal traffic over a multi-tenant fleet with live fold-in and
+    periodic refit, under the declarative chaos schedule; exit 0 only
+    when the SLO verdict passes.  The verdict re-derives offline from
+    the run dir alone: ``python tpu_als/soak/verdict.py <obs-dir>``."""
+    from tpu_als.soak import chaos, orchestrator, traffic
+
+    cfg = traffic.TrafficConfig(
+        seed=args.seed, windows=args.windows, window_s=args.window_s,
+        base_qps=args.base_qps, update_qps=args.update_qps,
+        poison_frac=args.poison_frac)
+    schedule = chaos.default_schedule(
+        cfg.windows, victim=cfg.tenants[0][0],
+        subprocesses=not args.no_subprocess_chaos)
+    if args.plan:
+        print(f"{cfg.windows} windows x {cfg.window_s}s "
+              f"(~{cfg.windows * cfg.window_s / 60.0:.2f} scheduled "
+              f"minutes), tenants "
+              + ", ".join(f"{n}:{w:g}" for n, w in cfg.tenants))
+        print(schedule.describe())
+        return
+    result = orchestrator.run_soak(
+        cfg, schedule, rank=args.rank, refit_every=args.refit_every,
+        judge_config={"slo_ms": args.slo_ms,
+                      "freshness_slo_ms": args.freshness_slo_ms,
+                      "fairness_max": args.fairness_max,
+                      "shed_max": args.shed_max})
+    print(orchestrator.render(result))
+    if args.as_json:
+        print(json.dumps(result, default=str))
+    if args.bench_json:
+        orchestrator.bank_result(result, args.bench_json)
         print(f"banked {args.bench_json}", file=sys.stderr)
     if not result["passed"]:
         raise SystemExit(1)
@@ -1977,6 +2017,52 @@ def main(argv=None):
         "list", help="list the scenarios, their chaos and their phases")
     scl.set_defaults(fn=cmd_scenario, obs_dir=None)
 
+    sk = sub.add_parser(
+        "soak",
+        help="the production week at compressed timescale: synthetic "
+             "zipfian/diurnal traffic drives multi-tenant serve + live "
+             "fold-in + refit under a chaos schedule; exit 0 only when "
+             "the SLO verdict passes (tpu_als.soak; docs/soak.md)",
+        parents=[obs_common])
+    sk.add_argument("--windows", type=int, default=8,
+                    help="soak windows (the compressed week's length)")
+    sk.add_argument("--window-s", type=float, default=3.0,
+                    help="wall seconds per window")
+    sk.add_argument("--base-qps", type=float, default=40.0,
+                    help="serve queries/sec at the diurnal mean")
+    sk.add_argument("--update-qps", type=float, default=25.0,
+                    help="rating arrivals/sec at the diurnal mean")
+    sk.add_argument("--poison-frac", type=float, default=0.02,
+                    help="per-event probability a rating arrives "
+                         "poisoned (nan -> quarantine path)")
+    sk.add_argument("--seed", type=int, default=17,
+                    help="traffic seed; (seed, schedule) replays the "
+                         "whole workload byte-for-byte")
+    sk.add_argument("--rank", type=int, default=8)
+    sk.add_argument("--refit-every", type=int, default=3,
+                    help="periodic refit-and-republish cadence, in "
+                         "windows (0 disables; chaos refits still run)")
+    sk.add_argument("--no-subprocess-chaos", action="store_true",
+                    help="drop the CLI-child injections (preempt, "
+                         "device loss) for a fast in-process soak")
+    sk.add_argument("--slo-ms", type=float, default=None,
+                    help="serve p99 bound for victim-free tenants")
+    sk.add_argument("--freshness-slo-ms", type=float, default=None,
+                    help="rating-arrival -> servable p99 bound")
+    sk.add_argument("--fairness-max", type=float, default=None,
+                    help="max/min answered-rate ratio across tenants")
+    sk.add_argument("--shed-max", type=float, default=None,
+                    help="shed/offered ceiling over the whole soak")
+    sk.add_argument("--plan", action="store_true",
+                    help="print the chaos schedule and exit (no soak)")
+    sk.add_argument("--bench-json", default=None, metavar="PATH",
+                    help="bank the verdict (survived-minutes headline, "
+                         "tz-aware banked_at) here, e.g. "
+                         "BENCH_soak_cpu.json")
+    sk.add_argument("--json", dest="as_json", action="store_true",
+                    help="also print the result as one JSON object")
+    sk.set_defaults(fn=cmd_soak)
+
     f = sub.add_parser("foldin-bench", help="fold-in latency micro-benchmark",
                        parents=[obs_common])
     f.add_argument("--model", required=True)
@@ -1994,6 +2080,13 @@ def main(argv=None):
                      help="run dir (--output / --obs-dir of a past run)")
     os1.add_argument("--json", dest="as_json", action="store_true",
                      help="emit the summary as one JSON object")
+    os1.add_argument("--since", type=float, default=None, metavar="S",
+                     help="only events at/after S seconds into the "
+                          "trail (relative to its first event)")
+    os1.add_argument("--window", default=None, metavar="A:B",
+                     help="only events in [A, B) seconds into the "
+                          "trail (either side may be empty) — slice a "
+                          "soak trail per chaos window")
     os1.set_defaults(fn=cmd_observe)
     os2 = osub.add_parser("tail", help="print the last N raw events")
     os2.add_argument("run_dir")

@@ -46,13 +46,29 @@ def resolve_events_path(target):
         "events.jsonl — was the command run with --output/--obs-dir?)")
 
 
+def resolve_events_paths(target):
+    """The full rotated trail in emission order (``events.NNN.jsonl``
+    rotations sorted, then the live file) — duplicated from report.py
+    on purpose, same zero-import discipline as above."""
+    live = resolve_events_path(target)
+    d = os.path.dirname(live)
+    if os.path.basename(live) != "events.jsonl":
+        return [live]
+    rotated = sorted(
+        f for f in os.listdir(d)
+        if f.startswith("events.") and f.endswith(".jsonl")
+        and f != "events.jsonl")
+    return [os.path.join(d, f) for f in rotated] + [live]
+
+
 def load_events(target):
     events = []
-    with open(resolve_events_path(target)) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                events.append(json.loads(line))
+    for path in resolve_events_paths(target):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
     return events
 
 

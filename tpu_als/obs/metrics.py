@@ -45,6 +45,49 @@ BUCKET_BOUNDS = tuple(10.0 ** (e / 4.0) for e in range(-24, 25))
 # the test suite) must not grow without bound; finalize() reports drops
 _MAX_EVENTS = 100_000
 
+# events.jsonl rotation bound (bytes).  When an incremental finalize
+# would grow the file past this, the current file is renamed to the
+# next events.NNN.jsonl and a fresh events.jsonl starts — long-horizon
+# soaks finalize per chaos window, so one trail never grows unbounded.
+# Env-overridable; 0 disables rotation.
+ROTATE_ENV = "TPU_ALS_OBS_ROTATE_BYTES"
+_ROTATE_BYTES = 8 << 20
+
+
+def _rotate_bound():
+    raw = os.environ.get(ROTATE_ENV)
+    if raw is None:
+        return _ROTATE_BYTES
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return _ROTATE_BYTES
+
+
+def maybe_rotate(run_dir, bound=None):
+    """Rotate ``<run_dir>/events.jsonl`` to ``events.NNN.jsonl`` when it
+    has reached ``bound`` bytes.  Returns the rotated-to path or None.
+    Readers (report/explain/verdict) list ``events.*.jsonl`` sorted and
+    read them before the live file, so rotation is transparent."""
+    if bound is None:
+        bound = _rotate_bound()
+    if not bound:
+        return None
+    live = os.path.join(run_dir, "events.jsonl")
+    try:
+        if os.path.getsize(live) < bound:
+            return None
+    except OSError:
+        return None
+    n = 0
+    while True:
+        cand = os.path.join(run_dir, f"events.{n:03d}.jsonl")
+        if not os.path.exists(cand):
+            break
+        n += 1
+    os.replace(live, cand)
+    return cand
+
 
 def _labels_key(labels):
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
@@ -306,6 +349,8 @@ class MetricsRegistry:
         events to ``events.jsonl`` (with a final ``snapshot`` event),
         rewrite ``metrics.prom`` and ``run_manifest.json``.  Idempotent
         — a second call appends only events recorded since the first.
+        A full ``events.jsonl`` (``TPU_ALS_OBS_ROTATE_BYTES``) rotates
+        to ``events.NNN.jsonl`` first — see :func:`maybe_rotate`.
         Multi-process: only process 0 writes (peers share the dir)."""
         with self._lock:
             run_dir = self._run_dir
@@ -331,6 +376,7 @@ class MetricsRegistry:
         from tpu_als.obs.manifest import late_device_info
 
         manifest.update(late_device_info())
+        maybe_rotate(run_dir)
         with open(os.path.join(run_dir, "events.jsonl"), "a") as f:
             for ev in pending:
                 f.write(json.dumps(ev) + "\n")

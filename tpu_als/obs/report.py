@@ -26,15 +26,60 @@ def resolve_events_path(target):
         "events.jsonl — was the command run with --output/--obs-dir?)")
 
 
+def resolve_events_paths(target):
+    """Every file of a possibly-rotated trail, in emission order: the
+    ``events.NNN.jsonl`` rotations sorted numerically, then the live
+    ``events.jsonl`` (obs.metrics.maybe_rotate writes them that way).
+    A bare file target reads as a one-file trail."""
+    live = resolve_events_path(target)
+    d = os.path.dirname(live)
+    base = os.path.basename(live)
+    if base != "events.jsonl":
+        return [live]
+    rotated = sorted(
+        f for f in os.listdir(d)
+        if f.startswith("events.") and f.endswith(".jsonl")
+        and f != "events.jsonl")
+    return [os.path.join(d, f) for f in rotated] + [live]
+
+
 def load_events(target):
-    path = resolve_events_path(target)
     events = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                events.append(json.loads(line))
+    for path in resolve_events_paths(target):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
     return events
+
+
+def filter_window(events, since=None, window=None):
+    """Slice a trail by RELATIVE seconds from its first event's ts:
+    ``since=S`` keeps events at/after t0+S; ``window="A:B"`` keeps
+    ``t0+A <= ts < t0+B`` (either side of the colon may be empty).
+    Soak trails are sliced per chaos window with exactly this."""
+    if since is None and window is None:
+        return events
+    if not events:
+        return events
+    t0 = events[0].get("ts") or 0.0
+    lo = hi = None
+    if since is not None:
+        lo = t0 + float(since)
+    if window is not None:
+        a, sep, b = str(window).partition(":")
+        if not sep:
+            raise ValueError(
+                f"--window takes 'A:B' relative seconds, got {window!r}")
+        if a.strip():
+            wlo = t0 + float(a)
+            lo = wlo if lo is None else max(lo, wlo)
+        if b.strip():
+            hi = t0 + float(b)
+    return [ev for ev in events
+            if (lo is None or (ev.get("ts") or 0.0) >= lo)
+            and (hi is None or (ev.get("ts") or 0.0) < hi)]
 
 
 def load_manifest(target):
@@ -194,8 +239,9 @@ def render_summary(summary, manifest=None):
     return "\n".join(lines)
 
 
-def cmd_summarize(target, as_json=False):
-    events = load_events(target)
+def cmd_summarize(target, as_json=False, since=None, window=None):
+    events = filter_window(load_events(target), since=since,
+                           window=window)
     summary = summarize_events(events)
     manifest = load_manifest(target)
     if as_json:

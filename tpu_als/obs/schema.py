@@ -167,6 +167,22 @@ METRICS = {
         "elastic mesh reformations: a mid-fit device loss was detected, "
         "the ring re-formed on the surviving mesh and training resumed "
         "from the last atomic checkpoint (resilience.elastic)"),
+    "soak.windows": (
+        "counter", "windows",
+        "soak windows completed by the production-week orchestrator "
+        "(tpu_als.soak.orchestrator)"),
+    "soak.injections": (
+        "counter", "injections",
+        "chaos injections whose fault observably fired during a soak "
+        "(the soak_injection event carries the evidence)"),
+    "soak.recoveries": (
+        "counter", "recoveries",
+        "chaos injections that fired AND left recovery evidence in the "
+        "trail before their window closed"),
+    "soak.window_seconds": (
+        "histogram", "seconds",
+        "wall-clock duration of one soak window (traffic replay + "
+        "chaos actions + joins; the schedule's window_s is the floor)"),
 }
 
 # metric name -> label keys its writers may attach.  Any key outside
@@ -439,6 +455,31 @@ EVENTS = {
         "absent|component_absent|corrupt) — a probe walk follows and "
         "its verdict is banked; 'corrupt' means the entry file was "
         "quarantined to .corrupt/ first"),
+    "soak_start": (
+        ("windows", "window_s", "tenants", "seed"),
+        "a production-week soak began: the compressed timeline "
+        "(windows x window_s seconds), the tenant mix, and the traffic "
+        "seed; 'scheduled_injections' (extra field) is the chaos "
+        "schedule's size — the verdict's injections_observed check "
+        "compares against it (tpu_als.soak.orchestrator)"),
+    "soak_window": (
+        ("window", "offered", "answered", "shed", "errors"),
+        "one soak window's serve outcome totals plus a 'tenants' extra "
+        "field mapping tenant -> {offered, answered, shed, errors, "
+        "p99_ms} — the verdict judges victim-free tenants from these "
+        "per-window records alone"),
+    "soak_injection": (
+        ("window", "action", "fired", "recovered"),
+        "one scheduled chaos injection's outcome: the window it landed "
+        "in, the action performed, whether the fault observably fired, "
+        "and whether its recovery evidence made it into the trail "
+        "before the window closed; 'victim' and 'spec' ride as extra "
+        "fields"),
+    "soak_verdict": (
+        ("passed", "survived_minutes", "checks"),
+        "the soak's SLO verdict as judged from the trail (tpu_als/soak/"
+        "verdict.py — stdlib-only, so the same verdict re-derives "
+        "offline from events.jsonl alone)"),
 }
 
 

@@ -247,6 +247,10 @@ def parse_spec(spec):
 _rules = None
 _lock = threading.Lock()
 
+# saved rule tables for push_spec/pop_spec (scoped arming windows — the
+# scenario runner's per-phase specs and the soak chaos schedule)
+_stack = []
+
 
 def install(spec):
     """Arm the harness: ``spec`` is a grammar string or a pre-parsed
@@ -256,6 +260,45 @@ def install(spec):
     with _lock:
         _rules = rules
     return rules
+
+
+def push_spec(spec):
+    """Arm ``spec`` as a scoped OVERLAY over the current rule table and
+    save the previous table for :func:`pop_spec`.
+
+    Overlay semantics: points named by ``spec`` get fresh rules; every
+    other armed point keeps its existing ``_Rule`` object (hit counters
+    and all), so a chaos *window* can re-arm ``serving.publish`` while
+    a scenario-level ``solve.gram`` rule stays live underneath.  LIFO:
+    every ``push_spec`` must be paired with exactly one ``pop_spec`` —
+    the scenario runner and the soak chaos scheduler both restore in a
+    ``finally`` so a failing window never leaks its rules."""
+    global _rules
+    rules = parse_spec(spec) if isinstance(spec, str) else dict(spec)
+    with _lock:
+        _stack.append(_rules)
+        base = dict(_rules) if _rules else {}
+        base.update(rules)
+        _rules = base
+    return rules
+
+
+def pop_spec():
+    """Restore the rule table saved by the matching :func:`push_spec`
+    (``None`` restores the disarmed state).  Raises ``RuntimeError`` on
+    an unbalanced pop — a silent no-op here would leave chaos armed."""
+    global _rules
+    with _lock:
+        if not _stack:
+            raise RuntimeError(
+                "faults.pop_spec() without a matching push_spec()")
+        _rules = _stack.pop()
+
+
+def push_depth():
+    """How many scoped specs are currently pushed (test/debug aid)."""
+    with _lock:
+        return len(_stack)
 
 
 def install_from_env(environ=None):
@@ -269,10 +312,13 @@ def install_from_env(environ=None):
 
 
 def clear():
-    """Disarm every fault point."""
+    """Disarm every fault point.  Also discards any scoped specs still
+    pushed (a full disarm resets the push/pop stack — tests that clear
+    in teardown must not hand stale saved tables to the next test)."""
     global _rules
     with _lock:
         _rules = None
+        _stack.clear()
 
 
 def active():

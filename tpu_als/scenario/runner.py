@@ -13,10 +13,12 @@ leaving a complete obs trail behind —
 production day's story, and the assertions are *re-derivable* from the
 events alone.
 
-Fault arming is scoped: the spec's ``fault_spec`` is installed before
-phase 1 and the environment's own ``TPU_ALS_FAULT_SPEC`` (or a clean
-disarm) is restored afterwards, failures included — a failing scenario
-must never leak chaos into the next one.  Causal tracing
+Fault arming is scoped and STACKED: the spec's ``fault_spec`` is pushed
+(``faults.push_spec``) before phase 1, each phase's own ``fault_spec``
+is pushed as an overlay around just that phase, and every push is
+popped LIFO afterwards, failures included — so chaos windows can re-arm
+mid-scenario (the soak chaos schedule) and a failing scenario never
+leaks rules into the next one or the enclosing process.  Causal tracing
 (``obs.tracing``) is armed over the same window with the same restore
 discipline, so every scenario's trail carries complete ``trace_span``
 trees (``observe explain`` on a scenario run dir) without flipping the
@@ -87,12 +89,20 @@ def run_scenario(spec, config=None, registry=None, workdir=None,
     t_start = now()
     phase_records = []
     tracing_was = tracing.tracing_armed()
+    pushed = 0
     try:
         tracing.enable_tracing()
         if spec.fault_spec:
-            faults.install(spec.fault_spec)
+            faults.push_spec(spec.fault_spec)
+            pushed += 1
         for phase in spec.phases:
             t0 = now()
+            # phase-scoped chaos window: push as an overlay over the
+            # scenario-level spec, pop in the finally — LIFO restore,
+            # so a failing phase never leaks its rules forward
+            if phase.fault_spec:
+                faults.push_spec(phase.fault_spec)
+                pushed += 1
             try:
                 phase.run(ctx)
             except Exception as e:   # noqa: BLE001 — typed + obs-visible
@@ -101,6 +111,10 @@ def run_scenario(spec, config=None, registry=None, workdir=None,
                               passed=False, seconds=now() - t_start,
                               error=str(err))
                 raise err from e
+            finally:
+                if phase.fault_spec:
+                    faults.pop_spec()
+                    pushed -= 1
             phase_records.append(
                 {"phase": phase.name, "seconds": round(now() - t0, 4)})
             registry.emit("scenario_phase", scenario=spec.name,
@@ -109,8 +123,9 @@ def run_scenario(spec, config=None, registry=None, workdir=None,
     finally:
         # restore the pre-scenario fault state (the env spec, if any)
         # BEFORE teardown so engine drains don't hit armed points
-        if spec.fault_spec:
-            faults.install_from_env()
+        while pushed:
+            faults.pop_spec()
+            pushed -= 1
         for e in ctx.run_cleanups():
             registry.emit("warning", what="scenario.cleanup",
                           reason=f"{type(e).__name__}: {e}")

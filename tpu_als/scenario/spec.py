@@ -100,11 +100,18 @@ class Phase:
     """One named step of a scenario.  ``run`` receives the RunContext;
     anything it must hand later phases goes in ``ctx.state`` (arrays,
     engines), anything an assertion judges goes in ``ctx.facts``
-    (JSON-serializable scalars only)."""
+    (JSON-serializable scalars only).
+
+    ``fault_spec`` arms a PHASE-scoped chaos window: the runner pushes
+    it (``faults.push_spec``, overlaying the scenario-level spec) just
+    before ``run`` and pops it in a ``finally`` — so a chaos window can
+    re-arm mid-scenario without leaking rules into later phases or the
+    enclosing process."""
 
     name: str
     run: object          # callable(ctx) -> None
     doc: str = ""
+    fault_spec: str = None
 
 
 @dataclass(frozen=True)
@@ -145,9 +152,10 @@ class ScenarioSpec:
     """A complete scenario: identity + chaos arming + phases + judgments.
 
     ``fault_spec`` is a ``TPU_ALS_FAULT_SPEC`` grammar string the runner
-    installs before phase 1 and disarms after the last phase — the
-    scenario's whole chaos schedule is visible here, declaratively, not
-    buried in phase bodies.  ``defaults`` seed the run config; CLI
+    pushes before phase 1 and pops after the last phase (phases may
+    push their own overlays — see :class:`Phase`) — the scenario's
+    whole chaos schedule is visible here, declaratively, not buried in
+    phase bodies.  ``defaults`` seed the run config; CLI
     flags / ``run_scenario(config=...)`` override per key.
     """
 

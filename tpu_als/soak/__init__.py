@@ -1,0 +1,24 @@
+"""The production-week soak subsystem (ROADMAP item 5).
+
+Four pieces, composed by :func:`tpu_als.soak.orchestrator.run_soak`:
+
+- ``traffic``      — the fully seeded synthetic workload model (zipfian
+  catalog with growth, diurnal load at compressed timescale, per-tenant
+  mixes, poisoned rating arrivals), replayable byte-for-byte from
+  ``(seed, schedule)``.
+- ``chaos``        — the declarative chaos schedule: every existing
+  fault point sequenced onto the soak timeline, armed per-window
+  through ``faults.push_spec`` with LIFO restore.
+- ``orchestrator`` — drives multi-tenant serve + per-tenant live
+  fold-in + periodic refit concurrently under the traffic model, one
+  ``soak_window`` / ``soak_injection`` event per window.
+- ``verdict``      — stdlib-only SLO judge, re-derivable from
+  events.jsonl alone (the ``observe explain`` discipline).
+
+See docs/soak.md for the knobs, the chaos grammar, and the verdict
+semantics.
+"""
+
+from tpu_als.soak.traffic import TrafficConfig  # noqa: F401
+from tpu_als.soak.chaos import ChaosSchedule, ChaosWindow  # noqa: F401
+from tpu_als.soak.orchestrator import run_soak  # noqa: F401

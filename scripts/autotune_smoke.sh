@@ -1,0 +1,119 @@
+#!/bin/bash
+# Self-tuning-kernel smoke: the measure -> plan -> re-plan loop's CI
+# gate, CPU-only (no accelerator, no network).  Four stages, fail-fast:
+#
+#   1. the autotune test tier — search-space determinism, the
+#      never-slower acceptance rule, the _tiles_solve typed-error
+#      knee, the AUTOTUNE-off jaxpr byte pin, never-override, and the
+#      floor_audit red/green negatives (tests/test_autotune.py),
+#   2. the static checks — the obs-schema shim (plan_tuned must stay
+#      declared AND emitted from planner.py — check_plan_vocabulary)
+#      plus the analysis gate (scripts/lint_smoke.sh stage 2 verifies
+#      floor_audit by name over the committed BENCH_autotune_cpu.json),
+#   3. one END-TO-END cold-tune-vs-warm-read through the real CLI in a
+#      fresh cache dir: run 1 must time real kernels and bank
+#      (tune_trial + plan_tuned in its trail), run 2 must return the
+#      SAME config with ZERO tuning executions (plan_cache_hit present,
+#      tune_trial absent), and `plan show` must render the
+#      model-vs-measured column.  The space is restricted to the depth
+#      axis — interpret-mode trials cost seconds each; the FULL space
+#      is exercised where it matters, banking BENCH_autotune_cpu.json,
+#   4. the bench regression gate over the committed result banks —
+#      BENCH_autotune_cpu.json rides the same provenance rules as
+#      every other bank (scripts/bench_gate.sh).
+#
+# Usage: scripts/autotune_smoke.sh   (from the repo root; ~2 min on CPU)
+set -u
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+fail=0
+
+echo "== autotune smoke 1/4: autotune test tier =="
+python -m pytest tests/test_autotune.py \
+    -q -m 'not slow' -p no:cacheprovider || fail=1
+
+echo "== autotune smoke 2/4: static checks (obs schema + analysis gate) =="
+python scripts/check_obs_schema.py || fail=1
+scripts/lint_smoke.sh || fail=1
+
+echo "== autotune smoke 3/4: end-to-end cold-tune vs warm-read =="
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+export TPU_ALS_PLAN_CACHE="$work/plan"
+python -m tpu_als.cli plan tune --rank 16 --n 24 --w 16 --reps 1 \
+    --space '{"depth": [2, 8]}' \
+    --obs-dir "$work/obs_cold" >"$work/cold.json" 2>"$work/cold.log" \
+    || { echo "FAIL: cold plan tune exited nonzero" >&2; fail=1; }
+python -m tpu_als.cli plan tune --rank 16 --n 24 --w 16 --reps 1 \
+    --space '{"depth": [2, 8]}' \
+    --obs-dir "$work/obs_warm" >"$work/warm.json" 2>"$work/warm.log" \
+    || { echo "FAIL: warm plan tune exited nonzero" >&2; fail=1; }
+python -m tpu_als.cli plan show >"$work/show.json" 2>>"$work/warm.log" \
+    || { echo "FAIL: plan show exited nonzero" >&2; fail=1; }
+python - "$work" <<'EOF' || fail=1
+import json, os, sys
+
+work = sys.argv[1]
+
+def trail(run):
+    with open(os.path.join(work, run, "events.jsonl")) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+def of(evs, t):
+    return [e for e in evs if e["type"] == t]
+
+cold, warm = trail("obs_cold"), trail("obs_warm")
+problems = []
+if not of(cold, "tune_trial"):
+    problems.append("cold tune emitted no tune_trial (nothing was timed)")
+if not of(cold, "plan_tuned"):
+    problems.append("cold tune emitted no plan_tuned (nothing banked)")
+if of(warm, "tune_trial"):
+    problems.append(f"warm read executed {len(of(warm, 'tune_trial'))} "
+                    "tuning trials — the zero-tuning warm-read contract "
+                    "is broken")
+hits = [e for e in of(warm, "plan_cache_hit")
+        if e["component"] == "kernel_config"]
+if not hits:
+    problems.append("warm read emitted no kernel_config plan_cache_hit")
+cold_doc = json.load(open(os.path.join(work, "cold.json")))
+warm_doc = json.load(open(os.path.join(work, "warm.json")))
+if cold_doc["config"] != warm_doc["config"]:
+    problems.append(f"cold and warm returned DIFFERENT configs: "
+                    f"{cold_doc['config']} != {warm_doc['config']}")
+prov = cold_doc["provenance"]
+if prov["measured_seconds"] > prov["default_seconds"]:
+    problems.append("tuned config is slower than the defaults on its "
+                    "own A/B — the never-slower rule is broken")
+show = json.load(open(os.path.join(work, "show.json")))
+mvm = None
+for e in show["entries"]:
+    kc = e.get("components", {}).get("kernel_config")
+    if kc:
+        mvm = kc.get("model_vs_measured")
+if not mvm:
+    problems.append("plan show rendered no model-vs-measured column "
+                    "for the tuned kernel_config")
+elif not (mvm["measured_s"] > 0 and mvm["prediction_s"] > 0
+          and mvm["ratio"] > 0):
+    problems.append(f"model-vs-measured column is degenerate: {mvm}")
+for p in problems:
+    print(f"FAIL: autotune smoke e2e: {p}", file=sys.stderr)
+if not problems:
+    print(f"autotune e2e: cold tune {prov['trials']} trials "
+          f"({cold_doc['resolve_seconds']}s) -> warm read "
+          f"({warm_doc['resolve_seconds']}s) tuning-free, "
+          f"measured/modeled {prov['ratio']:.1f}")
+sys.exit(1 if problems else 0)
+EOF
+unset TPU_ALS_PLAN_CACHE
+
+echo "== autotune smoke 4/4: bench regression gate =="
+bash scripts/bench_gate.sh || fail=1
+
+if [ "$fail" -ne 0 ]; then
+    echo "autotune smoke: FAIL" >&2
+    exit 1
+fi
+echo "autotune smoke: OK"

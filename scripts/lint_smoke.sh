@@ -11,8 +11,12 @@
 #   2. the jaxpr contract registry — the named byte pins (ne_audit,
 #      fused_solve_audit, guardrails_disarmed, tracing_disarmed,
 #      plan_cache_off, comm_audit, ring_substrate, live_delta_index,
-#      serve_comm_audit, elastic_disarmed) re-verified through the real
-#      CLI on an 8-device CPU backend.
+#      serve_comm_audit, elastic_disarmed, floor_audit) re-verified
+#      through the real CLI on an 8-device CPU backend.  floor_audit is
+#      a bank pin, not a jaxpr pin: the committed BENCH_autotune_cpu.json
+#      must keep tuned <= default and measured-vs-modeled inside its
+#      band (TPU_ALS_FLOOR_BAND), so the roofline gap cannot silently
+#      reopen.
 #
 # Usage: scripts/lint_smoke.sh   (from the repo root; ~1 min on CPU)
 set -u

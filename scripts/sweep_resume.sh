@@ -95,6 +95,17 @@ DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
 #   banks MULTICHIP_*.json (whole-mesh iters/sec at rank 256, banked_at
 #   provenance) the moment a slice is reachable.
 STEPS=(
+  # PR 20, FRONT of the queue: tune-then-headline in one process.  The
+  # TPU_ALS_AUTOTUNE=1 gate makes the first armed resolve run the
+  # measured kernel autotune ON-CHIP (banked into the plan cache with
+  # source "device" — which interpret-mode re-tunes can never override),
+  # then the SAME process measures the tuned headline.  Leading the
+  # queue means every later step's armed resolves ride the banked
+  # config as pure cache reads; `plan tune --bank-out
+  # sweep_logs/BENCH_autotune_tpu.json` afterwards exports the device
+  # A/B without re-tuning.  (env-prefix form: the runner's unquoted
+  # `timeout $to $cmd` cannot chain commands or set variables itself.)
+  "tune_then_headline|900|env TPU_ALS_AUTOTUNE=1 python bench.py --no-auto-config --iters 5 --probe-attempts 1"
   "ring_fused_headline|700|python bench.py --no-auto-config --iters 5 --ab ring_fused --ab-dir sweep_logs --probe-attempts 1"
   "multichip_ring|900|python bench.py --no-auto-config --mode multichip --rank 256 --iters 3 --probe-attempts 1"
   "gather_solve_headline|700|python bench.py --no-auto-config --iters 5 --ab gather_solve --ab-dir sweep_logs --probe-attempts 1"

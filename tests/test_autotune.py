@@ -1,0 +1,435 @@
+"""Self-tuning kernels (tpu_als.perf.autotune + the planner's
+kernel_config component, docs/roofline.md): the measure -> plan ->
+re-plan loop.
+
+The load-bearing pins:
+
+- NEVER SLOWER: the defaults are trial 0 and the winner is the strict
+  measured minimum with ties going to the earlier trial, so the tuned
+  config can never lose its own A/B.
+- DETERMINISM: same seed + same timer => same trial list => same
+  winning config.
+- ZERO TUNING WARM: a banked, non-invalidated kernel_config resolves as
+  a pure cache read — ``plan_cache_hit`` present, ``tune_trial`` absent.
+- OFF IS FREE: with ``TPU_ALS_AUTOTUNE`` unset the training step's
+  traced jaxpr is byte-identical to the disarmed planner, even with a
+  non-default config banked (the ne_audit/plan_cache_off discipline);
+  with it set, the banked config actually changes the trace.
+- NEVER OVERRIDE: an interpret-sourced verdict never replaces a banked
+  on-chip (device) measurement, even under ``force``.
+- FLOOR AUDIT: the committed CPU A/B bank must keep measured-vs-modeled
+  inside its band — doctored banks turn the contract red.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_als import obs, plan
+from tpu_als.analysis import contracts
+from tpu_als.core.als import AlsConfig, init_factors, make_step
+from tpu_als.core.ratings import build_csr_buckets
+from tpu_als.ops.pallas_gather_ne import (TileBudgetError, _tiles_solve,
+                                          gather_fused_solve_explicit)
+from tpu_als.perf import autotune
+from tpu_als.plan import cache as plan_cache
+from tpu_als.plan.cache import ENV_VAR
+from tpu_als.utils import platform
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan_state(monkeypatch, tmp_path):
+    monkeypatch.setenv(ENV_VAR, str(tmp_path / "plan"))
+    monkeypatch.delenv(plan.AUTOTUNE_ENV, raising=False)
+    platform.clear_probe_caches()
+    obs.reset()
+    yield
+    platform.clear_probe_caches()
+    obs.reset()
+
+
+def _events(etype):
+    return [e for e in obs.default_registry()._events if e["type"] == etype]
+
+
+def _fake_timer(score, interpret=True):
+    """Deterministic injectable timer: ``score(config) -> seconds``."""
+    def timer(config):
+        return float(score(config))
+    timer.interpret = bool(interpret)
+    return timer
+
+
+# -- search space and enumeration ------------------------------------------
+
+def test_enumerate_default_first_and_deterministic():
+    trials = autotune.enumerate_configs()
+    assert trials[0] == autotune.DEFAULT_CONFIG
+    # 1 default + one-at-a-time alternatives: 2+3+2+2+1
+    assert len(trials) == 11
+    assert trials == autotune.enumerate_configs()
+    # every trial differs from the default in at most one knob
+    for t in trials[1:]:
+        diffs = [k for k in t if t[k] != autotune.DEFAULT_CONFIG[k]]
+        assert len(diffs) == 1
+
+
+def test_enumerate_restricted_space_and_unknown_knob():
+    # the default (8) is inside the space, so the base keeps it and the
+    # alternative is the only extra trial
+    trials = autotune.enumerate_configs({"depth": (2, 8)})
+    assert [t["depth"] for t in trials] == [8, 2]
+    # the default is NOT in the space: the base snaps to the space's
+    # first value so trial 0 stays a member of the searched space
+    trials = autotune.enumerate_configs({"depth": (2, 4)})
+    assert [t["depth"] for t in trials] == [2, 4]
+    assert autotune.enumerate_configs({}) == [autotune.DEFAULT_CONFIG]
+    with pytest.raises(ValueError, match="unknown autotune knob"):
+        autotune.enumerate_configs({"tile_rows": (8,)})
+
+
+def test_feasible_respects_panel_divisibility_and_budget():
+    assert autotune.feasible(autotune.DEFAULT_CONFIG, 128)
+    bad_panel = dict(autotune.DEFAULT_CONFIG, panel=48)
+    assert not autotune.feasible(bad_panel, 128)    # 128 % 48 != 0
+    starved = dict(autotune.DEFAULT_CONFIG, vmem_budget=1 << 12)
+    assert not autotune.feasible(starved, 512)
+
+
+# -- the satellite: _tiles_solve typed error + edge shapes -----------------
+
+def test_tiles_solve_default_pins_unchanged():
+    # the hand-picked historical behavior IS the untuned fallback —
+    # these exact triples are what the tuned-off path must keep
+    assert _tiles_solve(128, 256) == (16, 256, 256)
+    assert _tiles_solve(128, 64) == (32, 64, 64)
+    assert _tiles_solve(128, 8, panel=8, vmem_budget=1 << 16) == (16, 8, 8)
+
+
+def test_tiles_solve_rank256_panel32_edge():
+    # rank 256 / panel 32 at the default budget sits exactly ON the
+    # 8-row knee: cap = 2^17 // (32*256) = 16 -> tn clamps to 8, no raise
+    tn, wc, w_pad = _tiles_solve(256, 32, panel=32)
+    assert tn == 8 and wc == 32
+
+
+def test_tiles_solve_below_knee_is_typed_error():
+    with pytest.raises(TileBudgetError, match="panel-efficiency knee"):
+        _tiles_solve(1024, 8, vmem_budget=1 << 15)
+    # the message names the fix: the minimal sufficient budget
+    with pytest.raises(TileBudgetError, match=str(8 * 32 * 1024)):
+        _tiles_solve(1024, 8, vmem_budget=1 << 15)
+    # TileBudgetError is a ValueError: existing callers' except clauses
+    # keep working
+    assert issubclass(TileBudgetError, ValueError)
+
+
+# -- tune(): determinism, never-slower, budget, events ---------------------
+
+def test_tune_same_seed_same_config():
+    score = lambda c: 1.0 + 0.1 * c["panel"] / (1 + c["depth"])
+    a = autotune.tune(rank=128, timer=_fake_timer(score))
+    b = autotune.tune(rank=128, timer=_fake_timer(score))
+    assert a["config"] == b["config"]
+    assert [t["config"] for t in a["trials"]] \
+        == [t["config"] for t in b["trials"]]
+
+
+def test_tune_default_wins_ties_and_is_never_slower():
+    flat = autotune.tune(rank=128, timer=_fake_timer(lambda c: 1.0))
+    assert flat["config"] == autotune.DEFAULT_CONFIG   # tie -> trial 0
+    score = lambda c: 0.5 if c["depth"] == 2 else 1.0
+    tuned = autotune.tune(rank=128, timer=_fake_timer(score))
+    assert tuned["config"]["depth"] == 2
+    assert tuned["measured_seconds"] <= tuned["default_seconds"]
+    assert flat["measured_seconds"] <= flat["default_seconds"]
+
+
+def test_tune_emits_trial_events_and_skips_infeasible():
+    autotune.tune(rank=128, timer=_fake_timer(lambda c: 1.0),
+                  space={"panel": (16, 48)})      # 48 infeasible at 128
+    ev = _events("tune_trial")
+    assert len(ev) == 1 and ev[0]["config"]["panel"] == 16
+
+
+def test_tune_budget_keeps_default_trial():
+    slow = _fake_timer(lambda c: 1.0)
+    out = autotune.tune(rank=128, timer=slow, budget_s=0.0)
+    # budget exhausts after trial 0 — the defaults still got timed
+    assert len(out["trials"]) == 1
+    assert out["config"] == autotune.DEFAULT_CONFIG
+
+
+def test_tune_source_follows_timer_interpret_flag():
+    assert autotune.tune(rank=128, timer=_fake_timer(lambda c: 1.0)
+                         )["source"] == "interpret"
+    assert autotune.tune(rank=128,
+                         timer=_fake_timer(lambda c: 1.0, interpret=False)
+                         )["source"] == "device"
+
+
+def test_drift_band():
+    assert not autotune.drifted(10.0, 15.0, band=2.0)
+    assert autotune.drifted(10.0, 25.0, band=2.0)
+    assert autotune.drifted(10.0, 4.0, band=2.0)
+    assert not autotune.drifted(None, 5.0)
+    assert not autotune.drifted(5.0, None)
+
+
+# -- tuned-vs-untuned kernel equivalence -----------------------------------
+
+def _instance(rank=16, n=24, w=16, seed=3):
+    rng = np.random.default_rng(seed)
+    N = 96
+    V = jnp.asarray(rng.normal(size=(N, rank)).astype(np.float32))
+    cols = jnp.asarray(rng.integers(0, N, size=(n, w)).astype(np.int32))
+    vals = jnp.asarray(rng.normal(size=(n, w)).astype(np.float32))
+    mask = jnp.asarray((rng.random((n, w)) < 0.8).astype(np.float32))
+    return V, cols, vals, mask
+
+
+def test_depth_and_max_wc_are_bitwise_neutral():
+    V, cols, vals, mask = _instance()
+    ref = gather_fused_solve_explicit(V, cols, vals, mask, 0.1,
+                                      interpret=True)
+    for kw in ({"depth": 2}, {"depth": 4}, {"max_wc": 128},
+               {"max_wc": 512}):
+        out = gather_fused_solve_explicit(V, cols, vals, mask, 0.1,
+                                          interpret=True, **kw)
+        assert jnp.array_equal(ref, out), kw
+
+
+def test_panel_and_budget_change_stays_allclose():
+    V, cols, vals, mask = _instance()
+    ref = gather_fused_solve_explicit(V, cols, vals, mask, 0.1,
+                                      interpret=True)
+    for kw in ({"panel": 8}, {"panel": 32}, {"vmem_budget": 1 << 16},
+               {"vmem_budget": 1 << 19}):
+        out = gather_fused_solve_explicit(V, cols, vals, mask, 0.1,
+                                          interpret=True, **kw)
+        assert jnp.allclose(ref, out, atol=1e-3, rtol=1e-2), kw
+
+
+# -- planner integration: bank, warm read, invalidate, never-override ------
+
+def _bank(score=lambda c: 0.5 if c["panel"] == 32 else 1.0,
+          interpret=True, **kw):
+    return plan.resolve_kernel_config(
+        rank=4, tune=True, timer=_fake_timer(score, interpret), **kw)
+
+
+def test_cold_tune_banks_then_warm_reads_with_zero_tuning():
+    cfg = _bank()
+    assert cfg["panel"] == 32
+    assert _events("plan_tuned") and _events("tune_trial")
+    obs.reset()
+    again = plan.resolve_kernel_config(rank=4)
+    assert again == cfg
+    hits = [e for e in _events("plan_cache_hit")
+            if e["component"] == "kernel_config"]
+    assert hits and not _events("tune_trial")     # ZERO tuning warm
+    src = [e["source"] for e in _events("plan_resolved")
+           if e["component"] == "kernel_config"]
+    assert src == ["cache"]
+
+
+def test_untuned_miss_returns_none_without_autotune_env(monkeypatch):
+    assert plan.resolve_kernel_config(rank=4) is None
+    assert not _events("tune_trial")
+    monkeypatch.setenv(plan.AUTOTUNE_ENV, "1")
+    assert plan.autotune_enabled()
+    cfg = plan.resolve_kernel_config(
+        rank=4, timer=_fake_timer(lambda c: 1.0))
+    assert cfg == autotune.DEFAULT_CONFIG        # auto-tune-on-miss
+
+
+def test_invalidate_triggers_retune_on_next_armed_resolve():
+    _bank()
+    assert plan.invalidate_kernel_config(rank=4, reason="drift")
+    assert plan.resolve_kernel_config(rank=4) is None   # stale: not trusted
+    obs.reset()
+    cfg = _bank(score=lambda c: 0.5 if c["depth"] == 2 else 1.0)
+    assert cfg["depth"] == 2 and _events("tune_trial")
+    key = plan.plan_key(rank=4, dtype="float32")
+    prov = plan_cache.load_entry(key)["components"]["kernel_config"][
+        "provenance"]
+    assert not prov.get("invalidated")
+    assert not plan.invalidate_kernel_config(rank=99)   # absent -> False
+
+
+def test_interpret_never_overrides_device_bank():
+    dev = _bank(interpret=False)
+    key = plan.plan_key(rank=4, dtype="float32")
+    assert plan_cache.load_entry(key)["components"]["kernel_config"][
+        "provenance"]["source"] == "device"
+    obs.reset()
+    got = _bank(score=lambda c: 0.1 if c["depth"] == 2 else 1.0,
+                interpret=True, force=True)
+    assert got == dev                            # fresh verdict discarded
+    prov = plan_cache.load_entry(key)["components"]["kernel_config"][
+        "provenance"]
+    assert prov["source"] == "device"
+    assert any("never-override" in e.get("reason", "")
+               for e in _events("warning"))
+
+
+def test_execution_plan_carries_kernel_config():
+    plan.resolve_kernel_config(rank=16, tune=True,
+                               timer=_fake_timer(lambda c: 1.0))
+    ep = plan.resolve_execution_plan(rank=16, compute_dtype="float32",
+                                     solve_backend="auto", cg_iters=0)
+    assert ep.kernel_config == autotune.DEFAULT_CONFIG
+    assert "kernel_config" in ep.summary()
+
+
+# -- OFF IS FREE: the jaxpr pin --------------------------------------------
+
+def _trace_step(rank=4):
+    jax.clear_caches()      # the pjit trace cache would otherwise hand
+    # back the previous env's jaxpr for identical statics
+    gen = np.random.default_rng(0)
+    nU, nI, nnz = 60, 40, 800
+    u = gen.integers(0, nU, nnz)
+    i = gen.integers(0, nI, nnz)
+    r = gen.uniform(0.5, 5.0, nnz).astype(np.float32)
+    ucsr = build_csr_buckets(u, i, r, nU, min_width=4, chunk_elems=1 << 12)
+    icsr = build_csr_buckets(i, u, r, nI, min_width=4, chunk_elems=1 << 12)
+    cfg = AlsConfig(rank=rank, max_iter=2,
+                    solve_backend="gather_fused_solve")
+    ub = jax.device_put(ucsr.device_buckets())
+    ib = jax.device_put(icsr.device_buckets())
+    ku, kv = jax.random.split(jax.random.PRNGKey(cfg.seed))
+    U0 = init_factors(ku, nU, cfg.rank)
+    V0 = init_factors(kv, nI, cfg.rank)
+    step = make_step(ub, ib, nU, nI, cfg,
+                     ucsr.chunk_elems, icsr.chunk_elems)
+    return str(jax.make_jaxpr(step)(U0, V0))
+
+
+def test_autotune_off_jaxpr_byte_identical_and_on_diverges(monkeypatch,
+                                                           tmp_path):
+    # bank a config that differs from the defaults in a trace-visible
+    # knob (panel changes the kernel tiling)
+    _bank(score=lambda c: 0.5 if c["panel"] == 32 else 1.0)
+
+    monkeypatch.setenv(ENV_VAR, "off")
+    disarmed = _trace_step()
+
+    monkeypatch.setenv(ENV_VAR, str(tmp_path / "plan"))
+    monkeypatch.delenv(plan.AUTOTUNE_ENV, raising=False)
+    armed_off = _trace_step()
+    assert armed_off == disarmed     # the ne_audit-style byte pin
+
+    monkeypatch.setenv(plan.AUTOTUNE_ENV, "1")
+    armed_on = _trace_step()
+    assert armed_on != disarmed      # the banked config reached the trace
+
+
+# -- the floor_audit contract ----------------------------------------------
+
+def _consistent_bank(tmp_path, **overrides):
+    config = dict(autotune.DEFAULT_CONFIG)
+    shape = {"rank": 128, "n": 256, "w": 64, "k": 3, "seed": 0}
+    model_s = autotune.model_seconds(config, 128, 256, 64)
+    tuned = model_s * 10.0
+    default = tuned * 1.25
+    doc = {"metric": "autotune_fused_solve_speedup_cpu",
+           "value": default / tuned, "unit": "x",
+           "kernel": "gather_solve", "source": "interpret",
+           "config": config, "default_seconds": default,
+           "tuned_seconds": tuned, "model_seconds": model_s,
+           "tune_seconds": 1.0, "shape": shape,
+           "banked_at": "2026-08-07T00:00:00+00:00"}
+    doc.update(overrides)
+    (tmp_path / contracts.FLOOR_AUDIT_BANK).write_text(json.dumps(doc))
+    return doc
+
+
+def _floor_verdict(monkeypatch, tmp_path):
+    monkeypatch.setenv(contracts.FLOOR_AUDIT_ROOT_ENV, str(tmp_path))
+    return contracts._REGISTRY["floor_audit"].verify()
+
+
+def test_floor_audit_registered_and_green_on_consistent_bank(
+        monkeypatch, tmp_path):
+    assert "floor_audit" in contracts._REGISTRY
+    _consistent_bank(tmp_path)
+    res = _floor_verdict(monkeypatch, tmp_path)
+    assert res.ok, res.detail
+    assert "inside its band" in res.detail
+
+
+def test_floor_audit_red_on_doctored_banks(monkeypatch, tmp_path):
+    good = _consistent_bank(tmp_path)
+    # (a) tuned slower than default: never-slower rule broken
+    _consistent_bank(tmp_path,
+                     tuned_seconds=good["default_seconds"] * 1.2,
+                     value=1.0 / 1.2)
+    assert not _floor_verdict(monkeypatch, tmp_path).ok
+    # (b) interpret timing at/below the HBM floor: physically impossible
+    _consistent_bank(tmp_path, tuned_seconds=good["model_seconds"] * 0.5,
+                     value=good["default_seconds"]
+                     / (good["model_seconds"] * 0.5))
+    assert not _floor_verdict(monkeypatch, tmp_path).ok
+    # (c) banked model_seconds drifted from the closed form
+    _consistent_bank(tmp_path, model_seconds=good["model_seconds"] * 3)
+    assert not _floor_verdict(monkeypatch, tmp_path).ok
+    # (d) speedup value inconsistent with its own timings
+    _consistent_bank(tmp_path, value=good["value"] * 2)
+    assert not _floor_verdict(monkeypatch, tmp_path).ok
+
+
+def test_floor_audit_green_on_shipped_tree(monkeypatch):
+    monkeypatch.delenv(contracts.FLOOR_AUDIT_ROOT_ENV, raising=False)
+    assert os.path.exists(os.path.join(REPO, contracts.FLOOR_AUDIT_BANK)), \
+        "the committed CPU A/B bank is missing"
+    res = contracts._REGISTRY["floor_audit"].verify()
+    assert res.ok, res.detail
+
+
+# -- the CLI surface -------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_plan_tune_cold_warm_and_show(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TPU_ALS_PLAN_CACHE=str(tmp_path / "plan"))
+    env.pop(plan.AUTOTUNE_ENV, None)
+    base = [sys.executable, "-m", "tpu_als.cli", "plan", "tune",
+            "--rank", "8", "--n", "16", "--w", "8", "--reps", "1",
+            "--space", "{}"]
+    cold = json.loads(subprocess.run(
+        base + ["--bank-out", str(tmp_path / "bank.json")],
+        capture_output=True, text=True, env=env, cwd=REPO,
+        check=True).stdout.splitlines()[0])
+    assert cold["config"] == autotune.DEFAULT_CONFIG
+    assert cold["provenance"]["trials"] == 1
+    assert cold["provenance"]["source"] == "interpret"
+    bank = json.loads((tmp_path / "bank.json").read_text())
+    assert bank["metric"] == "autotune_fused_solve_speedup_cpu"
+    assert bank["value"] >= 1.0          # never slower, tie allowed
+
+    warm = json.loads(subprocess.run(
+        base, capture_output=True, text=True, env=env, cwd=REPO,
+        check=True).stdout.splitlines()[0])
+    assert warm["config"] == cold["config"]
+    assert warm["resolve_seconds"] < cold["resolve_seconds"]
+
+    show = json.loads(subprocess.run(
+        [sys.executable, "-m", "tpu_als.cli", "plan", "show"],
+        capture_output=True, text=True, env=env, cwd=REPO,
+        check=True).stdout)
+    comp = show["entries"][0]["components"]["kernel_config"]
+    mvm = comp["model_vs_measured"]
+    assert mvm["tuned_config"] == cold["config"]
+    assert mvm["measured_s"] > 0 and mvm["prediction_s"] > 0
+    assert mvm["ratio"] == pytest.approx(
+        mvm["measured_s"] / mvm["prediction_s"])

@@ -38,6 +38,12 @@ against the claimant's own inputs:
   (per-shard candidate lists live only in kernel scratch), and its
   merged top-k is BITWISE equal to single-device
   ``chunked_topk_scores`` on an adversarial tie catalog.
+- ``floor_audit``         — the committed autotune bank
+  (``BENCH_autotune_cpu.json``): the tuned config is never slower than
+  the hand-picked defaults, the banked ``model_seconds`` equals the
+  ``fused_solve_kernel_bytes`` closed form re-derived at the banked
+  config/shape, and the measured-vs-modeled ratio stays inside its
+  band — so the roofline gap can never silently reopen in CI.
 
 Before this registry the four pins lived in four test files with no
 shared vocabulary; a kernel author adding a fifth had to rediscover the
@@ -836,6 +842,90 @@ def _pin_elastic_disarmed(a):
             f"({len(a['disarmed'])} chars)")
 
 
+# -- floor_audit: the banked autotune A/B stays inside its roofline band ----
+
+# the committed autotune bank this contract audits; an override root lets
+# the red-path test (and a TPU re-bank rehearsal) point at a doctored copy
+FLOOR_AUDIT_ROOT_ENV = "TPU_ALS_FLOOR_AUDIT_ROOT"
+FLOOR_AUDIT_BANK = "BENCH_autotune_cpu.json"
+# measured/modeled band for DEVICE-sourced banks: the headline sits ~24x
+# off the revised roofline floor (ROADMAP), so 32x is the "gap silently
+# reopened" tripwire; interpret-sourced banks only pin ratio > 1 (the
+# CPU interpreter cannot beat the v5e closed-form floor)
+FLOOR_BAND_ENV = "TPU_ALS_FLOOR_BAND"
+DEFAULT_FLOOR_BAND = 32.0
+# never-slower tolerance: one regress noise band (obs.regress default)
+FLOOR_AUDIT_NOISE = 0.10
+
+
+def _build_floor_audit():
+    import json
+
+    from tpu_als.perf import autotune
+
+    root = os.environ.get(FLOOR_AUDIT_ROOT_ENV) or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "..")
+    path = os.path.join(os.path.abspath(root), FLOOR_AUDIT_BANK)
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    shape = doc["shape"]
+    # re-derive the prediction from THE closed form at the banked config
+    # and shapes — the bank's own model_seconds field is provenance, the
+    # formula is authority (the ne_audit discipline applied to a bank)
+    model_s = autotune.model_seconds(doc["config"], shape["rank"],
+                                     shape["n"], shape["w"])
+    try:
+        band = float(os.environ.get(FLOOR_BAND_ENV, "")
+                     or DEFAULT_FLOOR_BAND)
+    except ValueError:
+        band = DEFAULT_FLOOR_BAND
+    return {"doc": doc, "model_s": model_s, "band": band,
+            "path": os.path.basename(path)}
+
+
+def _pin_floor_audit(a):
+    doc, model_s, band = a["doc"], a["model_s"], a["band"]
+    tuned_s = float(doc["tuned_seconds"])
+    default_s = float(doc["default_seconds"])
+    source = doc.get("source", "interpret")
+    _require(tuned_s > 0 and default_s > 0 and model_s > 0,
+             f"{a['path']}: non-positive timing "
+             f"(tuned {tuned_s}, default {default_s}, model {model_s})")
+    _require(tuned_s <= default_s * (1.0 + FLOOR_AUDIT_NOISE),
+             f"{a['path']}: the banked tuned config is SLOWER than the "
+             f"hand-picked defaults ({tuned_s:.6f}s vs {default_s:.6f}s, "
+             f"tolerance {FLOOR_AUDIT_NOISE:.0%}) — the autotuner's "
+             "never-slower acceptance rule is broken")
+    banked_model = doc.get("model_seconds")
+    if banked_model is not None:
+        _require(abs(float(banked_model) - model_s)
+                 <= 1e-6 * max(float(banked_model), model_s),
+                 f"{a['path']}: banked model_seconds "
+                 f"{float(banked_model):.3e} != fused_solve_kernel_bytes "
+                 f"closed form {model_s:.3e} at the banked config/shape "
+                 "— the bank drifted from the roofline model")
+    ratio = tuned_s / model_s
+    if source == "device":
+        _require(0.9 <= ratio <= band,
+                 f"{a['path']}: device measured/modeled ratio {ratio:.2f} "
+                 f"outside [0.9, {band:g}] — the roofline gap silently "
+                 "reopened (or the measurement beat physics); re-tune "
+                 "and re-bank")
+    else:
+        _require(ratio > 1.0,
+                 f"{a['path']}: interpret-mode measured/modeled ratio "
+                 f"{ratio:.2f} <= 1 — the CPU interpreter cannot beat "
+                 "the v5e HBM floor; the bank is doctored or mis-derived")
+    speedup = default_s / tuned_s
+    _require(abs(float(doc["value"]) - speedup)
+             <= 1e-6 * max(float(doc["value"]), speedup),
+             f"{a['path']}: banked speedup value {doc['value']} != "
+             f"default_seconds/tuned_seconds {speedup:.6f}")
+    return (f"banked {source} A/B: tuned {tuned_s:.4f}s <= default "
+            f"{default_s:.4f}s (speedup {speedup:.2f}x), "
+            f"measured/modeled {ratio:.1f} inside its band")
+
+
 _REGISTRY = {
     c.name: c for c in (
         Contract("ne_audit", _build_ne_audit, _pin_ne_audit,
@@ -870,6 +960,8 @@ _REGISTRY = {
         Contract("elastic_disarmed", _build_elastic_disarmed,
                  _pin_elastic_disarmed,
                  "tests/test_resilience.py, PR 18"),
+        Contract("floor_audit", _build_floor_audit, _pin_floor_audit,
+                 "tests/test_autotune.py, PR 20"),
     )
 }
 

@@ -105,11 +105,12 @@ DEFAULT_ROOTS = ("tpu_als", "scripts", "bench.py")
 
 # the execution planner's event vocabulary is a cross-process CONTRACT:
 # the warm-start tests assert trails like "plan_cache_hit present,
-# plan_probe absent", so a renamed/undeclared literal would silently
-# void those assertions.  Pin all four here, over and above the generic
-# call-site validation.
+# plan_probe absent" (and autotune_smoke asserts "plan_tuned on cold
+# tune, absent on warm"), so a renamed/undeclared literal would
+# silently void those assertions.  Pin all five here, over and above
+# the generic call-site validation.
 PLAN_EVENTS = ("plan_resolved", "plan_probe", "plan_cache_hit",
-               "plan_cache_miss")
+               "plan_cache_miss", "plan_tuned")
 
 # the tenancy contract pins the LABEL vocabulary the same way: every
 # serving.*/live.* series must declare the tenant label (the tenant-
@@ -164,7 +165,7 @@ def load_registries(repo=REPO):
 
 
 def check_plan_vocabulary(repo=REPO):
-    """The four plan_* events must be declared in the schema AND emitted
+    """The five plan_* events must be declared in the schema AND emitted
     by tpu_als/plan/planner.py (an emit that moved elsewhere without a
     declaration update fails the generic pass; a declaration whose emit
     vanished fails here)."""
@@ -175,7 +176,7 @@ def check_plan_vocabulary(repo=REPO):
             errors.append(
                 f"tpu_als/obs/schema.py: planner event {name!r} is not "
                 "declared in EVENTS (the tpu_als.plan contract pins all "
-                f"four of {', '.join(PLAN_EVENTS)})")
+                f"of {', '.join(PLAN_EVENTS)})")
     planner_py = os.path.join(repo, "tpu_als", "plan", "planner.py")
     if os.path.exists(planner_py):
         with open(planner_py, encoding="utf-8") as f:

@@ -1657,8 +1657,11 @@ def cmd_plan(args):
     files included, flagged); ``warm`` resolves the full ExecutionPlan
     for one configuration eagerly (cold: probes run and the verdicts
     bank; warm: zero probe executions) and prints it with the resolve
-    wall-clock; ``clear`` drops the on-disk entries and the in-process
-    probe registry."""
+    wall-clock; ``tune`` runs the measured-timing kernel autotune
+    (cold: real kernel timings bank; warm: pure cache read with zero
+    tuning executions; ``--force`` re-tunes, ``--bank-out`` writes the
+    regress/floor_audit direct bank); ``clear`` drops the on-disk
+    entries and the in-process probe registry."""
     import time
 
     from tpu_als import plan as plan_pkg
@@ -1678,6 +1681,19 @@ def cmd_plan(args):
                         "probes_executed": prov.get("probes_executed"),
                         "model": prov.get("model"),
                     }
+                    # the model-vs-measured column the re-plan loop
+                    # reads: present on measured-timing components
+                    # (kernel_config), rendered from the provenance the
+                    # cache already banks
+                    if prov.get("measured_seconds") is not None:
+                        comps[name]["model_vs_measured"] = {
+                            "prediction_s": prov.get("model_seconds"),
+                            "measured_s": prov.get("measured_seconds"),
+                            "ratio": prov.get("ratio"),
+                            "source": prov.get("source"),
+                            "tuned_config": comp["resolved"],
+                            "invalidated": prov.get("invalidated"),
+                        }
                 entries.append({"path": path, "plan_key": doc["plan_key"],
                                 "probes": doc["probes"],
                                 "components": comps})
@@ -1698,6 +1714,56 @@ def cmd_plan(args):
         out = ep.summary()
         out["resolve_seconds"] = round(time.perf_counter() - t0, 4)
         out["mode"] = plan_pkg.mode()
+        print(json.dumps(out, default=str))
+        return out
+
+    if args.plan_cmd == "tune":
+        if not plan_pkg.armed():
+            print(json.dumps({"error": "plan cache is off "
+                              "(TPU_ALS_PLAN_CACHE=off) — nothing to "
+                              "tune against"}))
+            raise SystemExit(2)
+        space = None
+        if args.space is not None:
+            try:
+                space = json.loads(args.space)
+            except json.JSONDecodeError as e:
+                print(f"tpu_als: --space is not valid JSON: {e}",
+                      file=sys.stderr)
+                raise SystemExit(2) from e
+        t0 = time.perf_counter()
+        config = plan_pkg.resolve_kernel_config(
+            rank=args.rank, compute_dtype=args.dtype, tune=True,
+            force=args.force, budget_s=args.budget_s, space=space,
+            n=args.n, w=args.w, k=args.reps, seed=args.seed)
+        key = plan_pkg.plan_key(rank=int(args.rank),
+                                dtype=str(args.dtype))
+        entry = plan_cache.load_entry(key)
+        comp = (entry or {}).get("components", {}).get("kernel_config")
+        prov = (comp or {}).get("provenance") or {}
+        out = {"mode": plan_pkg.mode(), "config": config,
+               "provenance": prov,
+               "resolve_seconds": round(time.perf_counter() - t0, 4)}
+        if args.bank_out is not None and prov:
+            bank = {"metric": "autotune_fused_solve_speedup_"
+                              + ("cpu" if prov["source"] == "interpret"
+                                 else "tpu"),
+                    "value": (prov["default_seconds"]
+                              / prov["measured_seconds"]),
+                    "unit": "x",
+                    "kernel": "gather_solve",
+                    "source": prov["source"],
+                    "config": comp["resolved"],
+                    "default_seconds": prov["default_seconds"],
+                    "tuned_seconds": prov["measured_seconds"],
+                    "model_seconds": prov["model_seconds"],
+                    "tune_seconds": prov["tune_seconds"],
+                    "shape": prov["model"]["shape"],
+                    "banked_at": prov["banked_at"]}
+            with open(args.bank_out, "w") as f:
+                json.dump(bank, f, indent=2)
+                f.write("\n")
+            out["bank_out"] = args.bank_out
         print(json.dumps(out, default=str))
         return out
 
@@ -2244,6 +2310,38 @@ def main(argv=None):
     plw.add_argument("--items", type=int, default=None)
     plw.add_argument("--devices", type=int, default=1)
     plw.set_defaults(fn=cmd_plan)
+    plt = plsub.add_parser(
+        "tune", parents=[obs_common],
+        help="measured-timing kernel autotune at one shape class — "
+             "cold: times real kernels min-of-k and banks the winner "
+             "into the plan entry; warm: reads the banked config with "
+             "zero tuning executions (--force re-tunes)")
+    plt.add_argument("--rank", type=int, default=128)
+    plt.add_argument("--dtype", default="float32",
+                     choices=["float32", "bfloat16"])
+    plt.add_argument("--budget-s", type=float, default=None,
+                     help="wall-clock tuning budget in seconds; the "
+                          "trial loop stops when exceeded (default: "
+                          "120)")
+    plt.add_argument("--space", default=None,
+                     help="JSON dict restricting the search space, "
+                          "e.g. '{\"depth\": [2, 8]}' — unknown knobs "
+                          "are a typed error")
+    plt.add_argument("--n", type=int, default=256,
+                     help="timing-harness item count")
+    plt.add_argument("--w", type=int, default=64,
+                     help="timing-harness gather width")
+    plt.add_argument("--reps", type=int, default=3,
+                     help="min-of-k repetitions per trial")
+    plt.add_argument("--seed", type=int, default=0)
+    plt.add_argument("--force", action="store_true",
+                     help="re-tune even when a valid banked config "
+                          "exists (device-sourced banks still refuse "
+                          "interpret-mode overwrites)")
+    plt.add_argument("--bank-out", default=None,
+                     help="also write a BENCH-style direct bank "
+                          "(regress/floor_audit format) to this path")
+    plt.set_defaults(fn=cmd_plan)
     plc = plsub.add_parser(
         "clear", help="drop the on-disk entries and the in-process "
                       "probe registry (.corrupt/ evidence is kept)")

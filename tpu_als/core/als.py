@@ -129,6 +129,30 @@ def resolve_solve_path(cfg: AlsConfig, rank, matfree_capable=True):
     return _resolve_solve_path_walk(cfg, rank, matfree_capable)
 
 
+def _tuned_kernel_kwargs(cfg: AlsConfig, rank):
+    """``(kernel_kwargs, table_dtype)`` from the banked autotune config,
+    or ``({}, None)`` — the untuned fallback.  STRICTLY gated on the
+    planner being armed AND ``TPU_ALS_AUTOTUNE=1``: with the gate off
+    nothing is consulted and the fused-solve call sites receive no
+    extra kwargs, so the training-step jaxpr stays byte-identical to
+    the pre-autotune tree (tests pin this the plan_cache_off way).
+    ``table_dtype`` is the tuned factor-table residency dtype (the bf16
+    knob); None means "keep cfg.compute_dtype"."""
+    from tpu_als import plan as _plan
+
+    if not (_plan.armed() and _plan.autotune_enabled()):
+        return {}, None
+    kcfg = _plan.resolve_kernel_config(rank=int(rank),
+                                       compute_dtype=cfg.compute_dtype)
+    if not kcfg:
+        return {}, None
+    kwargs = {"panel": int(kcfg["panel"]), "max_wc": int(kcfg["max_wc"]),
+              "vmem_budget": int(kcfg["vmem_budget"]),
+              "depth": int(kcfg["depth"])}
+    tdt = str(kcfg.get("dtype") or cfg.compute_dtype)
+    return kwargs, (None if tdt == str(cfg.compute_dtype) else tdt)
+
+
 def _resolve_solve_path_walk(cfg: AlsConfig, rank, matfree_capable=True):
     """The probe walk behind :func:`resolve_solve_path` (VERDICT r1 weak
     #3: record *resolved* backends, not requested ones).
@@ -318,6 +342,12 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
                                                  "gatherfused_ring")
     gather = resolved["resolved_solve_path"].startswith("gatherfused+")
     gather_interpret = not resolved["on_tpu"]
+    # banked autotune knobs for the fused-solve kernel ({} unless armed
+    # AND TPU_ALS_AUTOTUNE=1 — the byte-identical-jaxpr-off contract);
+    # a tuned table dtype overrides the kernel's stream dtype only
+    tuned_kw, tuned_dt = (_tuned_kernel_kwargs(cfg, r) if gsolve
+                          else ({}, None))
+    kdt = jnp.dtype(tuned_dt) if tuned_dt else cdt
     cg = (cfg.cg_iters > 0 and not cfg.nonnegative
           and not (gather or gsolve))
     if cfg.cg_mode not in ("matfree", "dense"):
@@ -351,14 +381,16 @@ def local_half_step(V_full, buckets, num_rows, cfg: AlsConfig, YtY=None,
                 with jax.named_scope("gather_fused_solve"):
                     if cfg.implicit_prefs:
                         return gather_fused_solve_implicit(
-                            V_comp, c, v.astype(cdt), m.astype(cdt),
+                            V_comp.astype(kdt), c, v.astype(kdt),
+                            m.astype(kdt),
                             cfg.reg_param, cfg.alpha,
                             YtY.astype(jnp.float32),
-                            jitter=cfg.jitter,
+                            jitter=cfg.jitter, **tuned_kw,
                             interpret=gather_interpret)
                     return gather_fused_solve_explicit(
-                        V_comp, c, v.astype(cdt), m.astype(cdt),
-                        cfg.reg_param, jitter=cfg.jitter,
+                        V_comp.astype(kdt), c, v.astype(kdt),
+                        m.astype(kdt),
+                        cfg.reg_param, jitter=cfg.jitter, **tuned_kw,
                         interpret=gather_interpret)
             if gather:
                 from tpu_als.ops.pallas_gather_ne import (

@@ -455,6 +455,21 @@ EVENTS = {
         "absent|component_absent|corrupt) — a probe walk follows and "
         "its verdict is banked; 'corrupt' means the entry file was "
         "quarantined to .corrupt/ first"),
+    "plan_tuned": (
+        ("key", "component", "source", "config", "measured_seconds",
+         "model_seconds"),
+        "the measured-timing autotuner banked a kernel config into the "
+        "plan entry: the winning knobs (panel/vmem_budget/max_wc/depth/"
+        "dtype), the min-of-k measured seconds next to the roofline "
+        "closed-form prediction, and whether the timings came from the "
+        "'device' or the CPU 'interpret' path — interpret verdicts "
+        "never override an on-chip one (tpu_als.plan.planner)"),
+    "tune_trial": (
+        ("kernel", "config", "seconds"),
+        "one autotune search trial: the kernel timed, the candidate "
+        "config, and its min-of-k seconds (tpu_als.perf.autotune); a "
+        "warm kernel-config resolve emits none — autotune_smoke pins "
+        "exactly that"),
     "soak_start": (
         ("windows", "window_s", "tenants", "seed"),
         "a production-week soak began: the compressed timeline "

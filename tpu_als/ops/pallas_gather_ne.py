@@ -72,6 +72,15 @@ from tpu_als.ops.solve import DEFAULT_JITTER, implicit_weights
 _DMA_SLOTS = rb.DMA_SLOTS
 
 
+class TileBudgetError(ValueError):
+    """The VMEM budget forces the fused-solve row tile below the
+    panel-efficiency knee (TN < 8, a degenerate 1-row-tile grid whose
+    factorization panels can no longer amortize their scoped-VMEM
+    temporaries).  Raised instead of silently clamping — callers (the
+    autotuner's search loop, or a hand-picked override) should treat the
+    config as infeasible and widen ``vmem_budget`` or shrink ``panel``."""
+
+
 def _gather_gram_kernel(cols_ref, aw_ref, bw_ref, V_hbm, A_ref, b_ref,
                         Vg, S, bacc, sem, *, n_wc, two_sided):
     """One (row-tile, width-chunk) grid step.
@@ -275,7 +284,7 @@ def gather_normal_eq_implicit(V, cols, vals, mask, reg, alpha, YtY, *,
 
 def _gather_solve_kernel(cols_ref, aw_ref, bw_ref, cw_ref, YtY_ref, V_hbm,
                          x_ref, Vg, S, LT, bacc, cnt, sem, *, n_wc,
-                         two_sided, panel, reg, jitter):
+                         two_sided, panel, reg, jitter, depth=None):
     """One (row-tile, width-chunk) grid step of the fully fused half-step.
 
     Same DMA-gather + Gram front end as :func:`_gather_gram_kernel`, plus
@@ -304,7 +313,7 @@ def _gather_solve_kernel(cols_ref, aw_ref, bw_ref, cw_ref, YtY_ref, V_hbm,
         return rb.local_copy(
             V_hbm.at[cols_ref[t, k]], Vg.at[t, k], sem.at[slot])
 
-    rb.pump(n_e, _copy)
+    rb.pump(n_e, _copy, depth=depth)
 
     Vg_t = Vg[:]
     aw = aw_ref[:]
@@ -351,25 +360,42 @@ def _gather_solve_kernel(cols_ref, aw_ref, bw_ref, cw_ref, YtY_ref, V_hbm,
         x_ref[:] = substitute(LT, bacc[:], tn=tn, r=r, panel=panel)
 
 
-def _tiles_solve(r_pad, w8, panel=16, max_wc=256):
+def _tiles_solve(r_pad, w8, panel=16, max_wc=256, vmem_budget=1 << 17):
     """(TN, WC, W_PAD) for the fused-solve kernel: the gather kernel's
     tiling, shrunk further for the second [TN, r, r] scratch (LT) and
     capped so the factorization's scoped-VMEM stack (the ~20 live
     [TN, panel, r] temporaries at its deepest point — the pallas_fused
     round's measured overflow at rank 32 / TN 256) stays under the 16 MiB
-    limit.  TN stays a sublane (8) multiple."""
+    limit.  TN stays a sublane (8) multiple.
+
+    ``vmem_budget`` is the factorization-stack element budget the cap is
+    derived from (historically the hard-coded ``1 << 17``; now an
+    autotuner knob).  A budget that forces the cap below the sublane
+    minimum (TN < 8) is a degenerate grid, not a smaller tile — raise
+    :class:`TileBudgetError` instead of silently clamping to 8 rows of a
+    tile the factorization can't panel efficiently."""
     tn, wc, w_pad = _tiles(r_pad, w8, max_wc)
     while tn > 8 and tn * (2 * r_pad * r_pad + 3 * wc * r_pad) > (1 << 21):
         tn //= 2
-    tn = min(tn, max(8, (1 << 17) // (max(panel, 32) * r_pad)))
+    cap = int(vmem_budget) // (max(panel, 32) * r_pad)
+    if cap < 8:
+        raise TileBudgetError(
+            f"vmem_budget {vmem_budget} caps the fused-solve row tile at "
+            f"{cap} rows for r_pad={r_pad} panel={panel} — below the "
+            f"8-row panel-efficiency knee; raise vmem_budget to at least "
+            f"{8 * max(panel, 32) * r_pad} or shrink panel")
+    tn = min(tn, cap)
     tn = max(8, (tn // 8) * 8)
     return tn, wc, w_pad
 
 
 @functools.partial(jax.jit, static_argnames=("two_sided", "reg", "jitter",
-                                             "panel", "interpret"))
+                                             "panel", "max_wc",
+                                             "vmem_budget", "depth",
+                                             "interpret"))
 def gather_solve(V, cols, aw, bw, cw, YtY=None, *, two_sided, reg,
-                 jitter=DEFAULT_JITTER, panel=16, interpret=False):
+                 jitter=DEFAULT_JITTER, panel=16, max_wc=256,
+                 vmem_budget=1 << 17, depth=None, interpret=False):
     """Whole-iteration fused half-step core: DMA-gather ``V[cols]`` rows
     straight into VMEM, accumulate the weighted Gram, apply the ridge/YtY/
     empty-guard tail and solve — returns ``x [n, r]`` f32 only.  Neither
@@ -380,13 +406,20 @@ def gather_solve(V, cols, aw, bw, cw, YtY=None, *, two_sided, reg,
     the wrappers compute them with the reference builders' exact
     expressions).  ``reg``/``jitter`` are static floats baked into the
     kernel tail (the ``solve_spd`` pre-regularization, applied in VMEM).
+
+    ``panel``/``max_wc``/``vmem_budget``/``depth`` are the autotuner's
+    tiling knobs (perf.autotune); their defaults ARE the historical
+    hand-picked constants, so an untuned call traces byte-identically to
+    the pre-knob kernel.  ``depth=None`` keeps the substrate's own
+    multiple-buffering depth (``ring_buffer.dma_slots``).
     """
     N, r = V.shape
     n, w = cols.shape
     r_pad = max(128, -(-r // 128) * 128)
     if r_pad % panel:
         raise ValueError(f"panel {panel} must divide padded rank {r_pad}")
-    tn, wc, w_pad = _tiles_solve(r_pad, -(-w // 8) * 8, panel=panel)
+    tn, wc, w_pad = _tiles_solve(r_pad, -(-w // 8) * 8, panel=panel,
+                                 max_wc=max_wc, vmem_budget=vmem_budget)
     assert wc == w_pad or (wc % 128 == 0 and w_pad % wc == 0), (wc, w_pad)
     n_pad = -(-n // tn) * tn
     V_p = jnp.pad(V, ((0, 0), (0, r_pad - r)))
@@ -405,9 +438,11 @@ def gather_solve(V, cols, aw, bw, cw, YtY=None, *, two_sided, reg,
     from tpu_als.perf.roofline import fused_solve_kernel_bytes
 
     db = jnp.dtype(V.dtype).itemsize
+    eff_depth = (None if depth is None
+                 else max(1, min(int(depth), rb.dma_slots(tn * wc))))
     kernel = functools.partial(
         _gather_solve_kernel, n_wc=n_wc, two_sided=two_sided, panel=panel,
-        reg=float(reg), jitter=float(jitter))
+        reg=float(reg), jitter=float(jitter), depth=eff_depth)
     x = pl.pallas_call(
         kernel,
         grid=(n_pad // tn, n_wc),
@@ -452,20 +487,27 @@ def gather_solve(V, cols, aw, bw, cw, YtY=None, *, two_sided, reg,
 
 
 def gather_fused_solve_explicit(V, cols, vals, mask, reg, *,
-                                jitter=DEFAULT_JITTER, interpret=False):
+                                jitter=DEFAULT_JITTER, panel=16, max_wc=256,
+                                vmem_budget=1 << 17, depth=None,
+                                interpret=False):
     """Fused-gather drop-in for ``normal_eq_explicit(V[cols], …)`` +
     ``solve_spd`` — returns ``x`` only; A/b/Vg never exist in HBM.  The
     weights are the reference builder's exact expressions; the ridge/
-    empty-guard tail runs in-kernel with the same arithmetic."""
+    empty-guard tail runs in-kernel with the same arithmetic.  The tiling
+    knobs default to the historical constants (see :func:`gather_solve`)."""
     aw = mask
     bw = vals * mask
     cw = mask
     return gather_solve(V, cols, aw, bw, cw, two_sided=True,
-                        reg=float(reg), jitter=jitter, interpret=interpret)
+                        reg=float(reg), jitter=jitter, panel=panel,
+                        max_wc=max_wc, vmem_budget=vmem_budget, depth=depth,
+                        interpret=interpret)
 
 
 def gather_fused_solve_implicit(V, cols, vals, mask, reg, alpha, YtY, *,
-                                jitter=DEFAULT_JITTER, interpret=False):
+                                jitter=DEFAULT_JITTER, panel=16, max_wc=256,
+                                vmem_budget=1 << 17, depth=None,
+                                interpret=False):
     """Fused-gather drop-in for ``normal_eq_implicit(V[cols], …)`` +
     ``solve_spd`` — returns ``x`` only.  Confidence/preference come from
     the shared :func:`implicit_weights`; the YtY + weighted-λ tail applies
@@ -475,7 +517,9 @@ def gather_fused_solve_implicit(V, cols, vals, mask, reg, alpha, YtY, *,
     bw = (1.0 + conf_m1) * pref * mask
     cw = pref * mask
     return gather_solve(V, cols, aw, bw, cw, YtY, two_sided=False,
-                        reg=float(reg), jitter=jitter, interpret=interpret)
+                        reg=float(reg), jitter=jitter, panel=panel,
+                        max_wc=max_wc, vmem_budget=vmem_budget, depth=depth,
+                        interpret=interpret)
 
 
 # --------------------------------------------------------------------------
@@ -494,7 +538,7 @@ def _gather_solve_ring_kernel(cols_ref, aw_ref, bw_ref, cw_ref, YtY_ref,
                               V_hbm, x_ref, buf0, buf1, Vg, S, LT, bacc,
                               cnt, sem, send_sem, recv_sem, ack_sem, *,
                               axis_name, n_shards, n_wc, two_sided, panel,
-                              reg, jitter, sync):
+                              reg, jitter, sync, depth=None):
     """One (row-tile, ring-step, width-chunk) grid cell of the fused-comm
     half-step.  Grid dims ``(i, t, j)``: per row tile ``i``, ring step
     ``t`` streams source shard ``(me - t) % S`` — held in ``V_hbm`` at
@@ -584,7 +628,7 @@ def _gather_solve_ring_kernel(cols_ref, aw_ref, bw_ref, cw_ref, YtY_ref,
             return rb.local_copy(
                 src.at[cols_ref[0, tt, k]], Vg.at[tt, k], sem.at[slot])
 
-        rb.pump(n_e, _copy)
+        rb.pump(n_e, _copy, depth=depth)
 
     if n_shards == 1:
         _gather_from(V_hbm)
@@ -663,6 +707,7 @@ def _gather_solve_ring_kernel(cols_ref, aw_ref, bw_ref, cw_ref, YtY_ref,
 
 def gather_solve_ring(V_shard, cols, aw, bw, cw, YtY=None, *, two_sided,
                       reg, axis_name=None, jitter=DEFAULT_JITTER, panel=16,
+                      max_wc=256, vmem_budget=1 << 17, depth=None,
                       interpret=False):
     """Fused-comm half-step core (inside ``shard_map``): one kernel call
     per bucket runs the WHOLE distributed iteration — the inter-chip ring
@@ -689,7 +734,8 @@ def gather_solve_ring(V_shard, cols, aw, bw, cw, YtY=None, *, two_sided,
     r_pad = max(128, -(-r // 128) * 128)
     if r_pad % panel:
         raise ValueError(f"panel {panel} must divide padded rank {r_pad}")
-    tn, wc, w_pad = _tiles_solve(r_pad, -(-w // 8) * 8, panel=panel)
+    tn, wc, w_pad = _tiles_solve(r_pad, -(-w // 8) * 8, panel=panel,
+                                 max_wc=max_wc, vmem_budget=vmem_budget)
     assert wc == w_pad or (wc % 128 == 0 and w_pad % wc == 0), (wc, w_pad)
     n_pad = -(-n // tn) * tn
     V_p = jnp.pad(V_shard, ((0, 0), (0, r_pad - r)))
@@ -728,10 +774,12 @@ def gather_solve_ring(V_shard, cols, aw, bw, cw, YtY=None, *, two_sided,
 
     db = jnp.dtype(V_shard.dtype).itemsize
     sync = not interpret and n_shards > 1
+    eff_depth = (None if depth is None
+                 else max(1, min(int(depth), rb.dma_slots(tn * wc))))
     kernel = functools.partial(
         _gather_solve_ring_kernel, axis_name=axis_name, n_shards=n_shards,
         n_wc=n_wc, two_sided=two_sided, panel=panel, reg=float(reg),
-        jitter=float(jitter), sync=sync)
+        jitter=float(jitter), sync=sync, depth=eff_depth)
     x = pl.pallas_call(
         kernel,
         grid=(n_rt, n_shards, n_wc),
@@ -787,7 +835,8 @@ def gather_solve_ring(V_shard, cols, aw, bw, cw, YtY=None, *, two_sided,
 
 def gather_fused_ring_explicit(V_shard, cols, vals, mask, reg, *,
                                axis_name=None, jitter=DEFAULT_JITTER,
-                               interpret=False):
+                               panel=16, max_wc=256, vmem_budget=1 << 17,
+                               depth=None, interpret=False):
     """Fused-comm drop-in for one explicit ring half-step: the reference
     builders' exact weight expressions over the UNROTATED [S, n, w] bucket
     arrays, then one :func:`gather_solve_ring` call.  At ``S == 1`` this
@@ -798,12 +847,15 @@ def gather_fused_ring_explicit(V_shard, cols, vals, mask, reg, *,
     cw = mask
     return gather_solve_ring(V_shard, cols, aw, bw, cw, two_sided=True,
                              reg=float(reg), axis_name=axis_name,
-                             jitter=jitter, interpret=interpret)
+                             jitter=jitter, panel=panel, max_wc=max_wc,
+                             vmem_budget=vmem_budget, depth=depth,
+                             interpret=interpret)
 
 
 def gather_fused_ring_implicit(V_shard, cols, vals, mask, reg, alpha, YtY,
                                *, axis_name=None, jitter=DEFAULT_JITTER,
-                               interpret=False):
+                               panel=16, max_wc=256, vmem_budget=1 << 17,
+                               depth=None, interpret=False):
     """Fused-comm drop-in for one implicit ring half-step — weights from
     the shared :func:`implicit_weights`, YtY + weighted-λ tail in-kernel."""
     conf_m1, pref = implicit_weights(vals, mask, alpha)
@@ -813,6 +865,8 @@ def gather_fused_ring_implicit(V_shard, cols, vals, mask, reg, alpha, YtY,
     return gather_solve_ring(V_shard, cols, aw, bw, cw, YtY,
                              two_sided=False, reg=float(reg),
                              axis_name=axis_name, jitter=jitter,
+                             panel=panel, max_wc=max_wc,
+                             vmem_budget=vmem_budget, depth=depth,
                              interpret=interpret)
 
 

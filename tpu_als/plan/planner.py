@@ -39,6 +39,7 @@ distributed hang waiting to happen.  The cache entry is provenance for
 from __future__ import annotations
 
 import math
+import os
 import time
 from dataclasses import dataclass, field
 from datetime import datetime, timezone
@@ -47,6 +48,19 @@ from tpu_als import obs
 from tpu_als.plan import cache as plan_cache
 
 PlanCacheCorrupt = plan_cache.PlanCacheCorrupt
+
+# auto-tune-on-miss opt-in: with TPU_ALS_AUTOTUNE=1 an armed resolve
+# whose entry has no banked kernel config runs the measured-timing
+# search (perf.autotune) and banks the winner; anything else keeps the
+# hand-picked kernel constants — and with the gate off the dispatch
+# sites never even consult the bank, so the training-step jaxpr stays
+# byte-identical to the pre-autotune tree (tests pin this the
+# plan_cache_off way)
+AUTOTUNE_ENV = "TPU_ALS_AUTOTUNE"
+
+
+def autotune_enabled():
+    return os.environ.get(AUTOTUNE_ENV, "") == "1"
 
 # tie-break preference when the comm model scores candidates equal — a
 # SUBSET of parallel.trainer.GATHER_STRATEGIES (the authoritative
@@ -373,6 +387,137 @@ def resolve_serving_buckets(*, rank=0, requested=None, observed=None):
     return tuple(int(b) for b in resolved)
 
 
+def resolve_kernel_config(*, rank, compute_dtype="float32", budget_s=None,
+                          space=None, force=False, tune=None, timer=None,
+                          n=256, w=64, k=3, seed=0):
+    """The measured-timing autotune component (``"kernel_config"``):
+    the fused-solve kernel knobs (panel / vmem_budget / max_wc / pump
+    depth / factor-table dtype) resolved through the plan cache.
+
+    Warm path: a banked, non-invalidated config returns as a pure cache
+    read — ``plan_cache_hit`` + ``plan_resolved(source="cache")``, ZERO
+    tuning executions (autotune_smoke pins the trail).  Cold path: only
+    when tuning is requested (``tune=True``, the ``plan tune`` CLI, or
+    the ``TPU_ALS_AUTOTUNE=1`` auto-tune-on-miss gate) the search runs
+    (``perf.autotune.tune``), the winner is banked with measured-vs-
+    modeled provenance, and ``plan_tuned`` +
+    ``plan_resolved(source="measured")`` are emitted.  Returns None —
+    "keep the hand-picked constants" — when disarmed, or when nothing
+    is banked and tuning was not requested.
+
+    The never-override rule: an ``interpret``-sourced verdict (CPU
+    interpreter timings) never replaces a banked ``device`` (on-chip)
+    measurement — the fresh result is discarded with a warning and the
+    banked config stands, even under ``force``.
+    """
+    if not armed():
+        return None
+    if tune is None:
+        tune = autotune_enabled()
+    key = plan_key(rank=int(rank), dtype=str(compute_dtype))
+    entry, _ = _load_or_quarantine(key)
+    comp = (entry or {}).get("components", {}).get("kernel_config")
+    prov = (comp or {}).get("provenance") or {}
+    if comp is not None and not prov.get("invalidated") and not force:
+        obs.emit("plan_cache_hit", key=_key_str(key),
+                 component="kernel_config",
+                 path=plan_cache.entry_path(key), seeded=0)
+        obs.emit("plan_resolved", key=_key_str(key),
+                 component="kernel_config", source="cache",
+                 resolved=_summ(comp["resolved"]))
+        return dict(comp["resolved"])
+    if not tune:
+        return dict(comp["resolved"]) if comp is not None \
+            and not prov.get("invalidated") else None
+
+    from tpu_als.perf import autotune
+
+    obs.emit("plan_cache_miss", key=_key_str(key),
+             component="kernel_config",
+             reason="invalidated" if prov.get("invalidated")
+             else ("forced" if (force and comp is not None)
+                   else ("component_absent" if entry is not None
+                         else "absent")))
+    kwargs = dict(rank=int(rank), compute_dtype=str(compute_dtype),
+                  space=space, timer=timer, n=n, w=w, k=k, seed=seed)
+    if budget_s is not None:
+        kwargs["budget_s"] = float(budget_s)
+    verdict = autotune.tune(**kwargs)
+    if prov.get("source") == "device" and verdict["source"] == "interpret":
+        obs.emit("warning", what="plan_cache",
+                 reason="interpret-mode autotune verdict discarded — the "
+                        "banked on-chip kernel config stands "
+                        "(never-override rule)")
+        return dict(comp["resolved"])
+    if entry is None:
+        entry = {"schema_version": plan_cache.SCHEMA_VERSION,
+                 "plan_key": key, "probes": {}, "components": {}}
+    ratio = (verdict["measured_seconds"] / verdict["model_seconds"]
+             if verdict["model_seconds"] else None)
+    entry["components"]["kernel_config"] = {
+        "resolved": _jsonable(verdict["config"]),
+        "provenance": {
+            "banked_at": _now(),
+            "source": verdict["source"],
+            "measured_seconds": verdict["measured_seconds"],
+            "model_seconds": verdict["model_seconds"],
+            "default_seconds": verdict["default_seconds"],
+            "ratio": ratio,
+            "tune_seconds": round(verdict["tune_seconds"], 6),
+            "trials": len(verdict["trials"]),
+            "walk_seconds": round(verdict["tune_seconds"], 6),
+            "probes_executed": [],
+            "model": {"shape": verdict["shape"],
+                      "reason": "one-at-a-time measured search over "
+                                "perf.autotune.SPACE; model_seconds is "
+                                "the fused_solve_kernel_bytes closed "
+                                "form at the winning config's padded "
+                                "shapes"},
+        },
+    }
+    try:
+        plan_cache.store_entry(key, entry)
+    except OSError as e:
+        obs.emit("warning", what="plan_cache",
+                 reason=f"could not bank tuned kernel config: {e}")
+    obs.emit("plan_tuned", key=_key_str(key), component="kernel_config",
+             source=verdict["source"], config=_jsonable(verdict["config"]),
+             measured_seconds=verdict["measured_seconds"],
+             model_seconds=verdict["model_seconds"])
+    obs.emit("plan_resolved", key=_key_str(key), component="kernel_config",
+             source="measured", resolved=_summ(verdict["config"]))
+    return dict(verdict["config"])
+
+
+def invalidate_kernel_config(*, rank, compute_dtype="float32",
+                             reason="drift"):
+    """The re-plan trigger: mark the banked kernel config stale (the
+    measured/modeled ratio left its band — ``observe regress --trend``
+    or the attribution gap table) so the next armed resolve re-tunes
+    instead of riding it.  Returns True when an entry was invalidated."""
+    if not armed():
+        return False
+    key = plan_key(rank=int(rank), dtype=str(compute_dtype))
+    entry, _ = _load_or_quarantine(key)
+    comp = (entry or {}).get("components", {}).get("kernel_config")
+    if comp is None:
+        return False
+    prov = comp.setdefault("provenance", {})
+    if prov.get("invalidated"):
+        return False
+    prov["invalidated"] = {"at": _now(), "reason": str(reason)}
+    try:
+        plan_cache.store_entry(key, entry)
+    except OSError as e:
+        obs.emit("warning", what="plan_cache",
+                 reason=f"could not mark kernel config stale: {e}")
+        return False
+    obs.emit("warning", what="plan_cache",
+             reason=f"kernel config invalidated ({reason}) — next armed "
+                    "resolve re-tunes")
+    return True
+
+
 # live-pipeline cadence: micro-batch accumulation + index compaction.
 # The defaults are the measured sweet spot on CPU (fold-in p50 82 ms
 # amortizes over ~256 events; a quarter-catalog delta segment keeps the
@@ -470,6 +615,7 @@ class ExecutionPlan:
     probe_budget_s: float
     probe_budget_reason: str
     notes: dict = field(default_factory=dict)
+    kernel_config: dict | None = None  # tuned knobs (None = hand-picked)
 
     def summary(self):
         return {
@@ -481,6 +627,7 @@ class ExecutionPlan:
             "serving_buckets": list(self.serving_buckets),
             "probe_budget_s": self.probe_budget_s,
             "probe_budget_reason": self.probe_budget_reason,
+            "kernel_config": self.kernel_config,
         }
 
 
@@ -513,10 +660,16 @@ def resolve_execution_plan(*, rank=128, compute_dtype="float32",
             requested="auto", n_users=int(n_users), n_items=int(n_items),
             rank=int(rank), n_devices=int(n_devices))
     buckets = resolve_serving_buckets(rank=int(rank))
+    # warm read always when armed; the measured search itself only runs
+    # behind the TPU_ALS_AUTOTUNE=1 opt-in (resolve_kernel_config)
+    kcfg = (resolve_kernel_config(rank=int(rank),
+                                  compute_dtype=compute_dtype)
+            if armed() else None)
     budget, why = plan_cache.suggested_probe_budget(default_probe_budget_s)
     return ExecutionPlan(
         key=plan_key(rank=int(rank), dtype=compute_dtype),
         solve=solve, topk_backend=topk, gather_strategy=gather,
         serving_buckets=buckets, probe_budget_s=budget,
         probe_budget_reason=why,
-        notes={"mode": mode()})
+        notes={"mode": mode()},
+        kernel_config=kcfg)
